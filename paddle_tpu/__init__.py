@@ -1,0 +1,22 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+early-2018 PaddlePaddle (reference: zhye5230/Paddle), redesigned for JAX/XLA.
+
+Architecture (vs the reference):
+  - The reference builds a protobuf ProgramDesc from Python and interprets it
+    op-by-op with a C++ Executor dispatching CUDA kernels
+    (reference: paddle/fluid/framework/executor.cc:133).
+  - Here the same Program IR is built from Python, but the Executor is a
+    *compiler client*: each block is lowered to ONE XLA computation via JAX
+    tracing of per-op emitters, jit-compiled and cached, with all state
+    (parameters, optimizer accumulators, BN stats) resident in device HBM.
+  - Multi-device data/model parallelism is expressed with jax.sharding over a
+    device Mesh; XLA inserts ICI collectives where the reference inserted
+    NCCLAllReduceOpHandle (reference:
+    paddle/fluid/framework/details/multi_devices_graph_builder.cc:167).
+"""
+
+__version__ = "0.1.0"
+
+from . import fluid  # noqa: F401
+from . import dataset, reader  # noqa: F401
+from .reader import batch  # noqa: F401

@@ -1,0 +1,36 @@
+"""CIFAR-10/100 (reference python/paddle/dataset/cifar.py). Synthetic
+fallback: [3072] floats in [0,1], labels with a planted channel-mean signal."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+
+def _reader_creator(split: str, num_classes: int):
+    def reader():
+        g = common.rng(f"cifar{num_classes}", split)
+        n = 1024
+        images = g.random((n, 3 * 32 * 32), dtype=np.float32)
+        labels = g.integers(0, num_classes, size=n)
+        images[np.arange(n), labels % 3072] += 0.5
+        for i in range(n):
+            yield images[i], int(labels[i])
+
+    return reader
+
+
+def train10():
+    return _reader_creator("train", 10)
+
+
+def test10():
+    return _reader_creator("test", 10)
+
+
+def train100():
+    return _reader_creator("train", 100)
+
+
+def test100():
+    return _reader_creator("test", 100)

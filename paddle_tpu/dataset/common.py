@@ -1,0 +1,16 @@
+"""Shared dataset helpers (reference python/paddle/dataset/common.py, minus
+the downloader — no egress; synthetic fallbacks are deterministic)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA", os.path.expanduser("~/.cache/paddle_tpu/dataset")
+)
+
+
+def rng(name: str, split: str) -> np.random.Generator:
+    seed = abs(hash((name, split))) % (2**31)
+    return np.random.default_rng(seed)

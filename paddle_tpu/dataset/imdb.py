@@ -1,0 +1,38 @@
+"""IMDB sentiment (reference python/paddle/dataset/imdb.py): sequences of
+word ids + binary label. Synthetic fallback with class-correlated ids."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+VOCAB_SIZE = 5147
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(VOCAB_SIZE)}
+
+
+def _reader_creator(split: str):
+    def reader():
+        g = common.rng("imdb", split)
+        n = 512
+        for _ in range(n):
+            label = int(g.integers(0, 2))
+            length = int(g.integers(8, 120))
+            base = g.integers(0, VOCAB_SIZE, size=length)
+            if label == 1:
+                base[: length // 3] = base[: length // 3] % 100
+            else:
+                base[: length // 3] = 100 + base[: length // 3] % 100
+            yield base.tolist(), label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader_creator("train")
+
+
+def test(word_idx=None):
+    return _reader_creator("test")

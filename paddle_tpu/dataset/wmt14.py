@@ -1,0 +1,40 @@
+"""WMT14 fr-en (reference python/paddle/dataset/wmt14.py): (src_ids,
+trg_ids, trg_next_ids) triples. Synthetic fallback with copy-task structure
+so seq2seq models can learn."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+DICT_SIZE = 30000
+START_ID = 0
+END_ID = 1
+UNK_ID = 2
+
+
+def _reader_creator(split: str, dict_size: int):
+    def reader():
+        g = common.rng("wmt14", split)
+        for _ in range(512):
+            length = int(g.integers(3, 30))
+            src = g.integers(3, dict_size, size=length).tolist()
+            trg = src[::-1]  # reversal copy-task
+            yield src, [START_ID] + trg, trg + [END_ID]
+
+    return reader
+
+
+def train(dict_size=DICT_SIZE):
+    return _reader_creator("train", dict_size)
+
+
+def test(dict_size=DICT_SIZE):
+    return _reader_creator("test", dict_size)
+
+
+def get_dict(dict_size=DICT_SIZE, reverse=False):
+    src = {i: f"w{i}" for i in range(dict_size)}
+    return (src, src) if reverse else (
+        {v: k for k, v in src.items()}, {v: k for k, v in src.items()}
+    )

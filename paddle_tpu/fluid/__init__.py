@@ -1,0 +1,60 @@
+"""fluid-compatible API surface (reference python/paddle/fluid/__init__.py)."""
+from . import core  # noqa: F401
+from . import ops as _ops  # registers all op emitters  # noqa: F401
+from . import (  # noqa: F401
+    backward,
+    clip,
+    initializer,
+    io,
+    layers,
+    nets,
+    optimizer,
+    param_attr,
+    profiler,
+    regularizer,
+    unique_name,
+)
+from .backward import append_backward, calc_gradient  # noqa: F401
+from .clip import (  # noqa: F401
+    ErrorClipByValue,
+    GradientClipByGlobalNorm,
+    GradientClipByNorm,
+    GradientClipByValue,
+    set_gradient_clip,
+)
+from .core import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
+from .executor import (  # noqa: F401
+    Executor,
+    Scope,
+    fetch_var,
+    global_scope,
+    scope_guard,
+)
+from .framework import (  # noqa: F401
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+from .initializer import Constant, MSRA, Normal, Uniform, Xavier  # noqa: F401
+from .io import (  # noqa: F401
+    load_checkpoint,
+    load_inference_model,
+    load_params,
+    load_persistables,
+    load_vars,
+    save_checkpoint,
+    save_inference_model,
+    save_params,
+    save_persistables,
+    save_vars,
+)
+from .parallel_executor import ParallelExecutor  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+Tensor = None  # runtime tensors are jax.Arrays; alias kept for API scripts

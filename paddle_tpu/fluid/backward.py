@@ -1,0 +1,230 @@
+"""append_backward — reverse-mode autodiff at the Program-IR level.
+
+Capability-parity with the reference `python/paddle/fluid/backward.py:425`:
+walks the block in reverse, appends one `<type>_grad` op per forward op on
+the loss path, accumulates repeated-output gradients with `sum` ops
+(reference _addup_repetitive_outputs_:117), prunes branches that cannot reach
+a trainable input (_remove_no_grad_branch_:167), and returns (param, grad)
+pairs for the optimizer.
+
+Unlike the reference there is no per-op C++ GradOpDescMaker: the generated
+grad op carries the forward op's metadata and the executor runs it through
+jax.vjp of the forward emitter (see registry.run_grad), with per-op custom
+grad emitters as the override point.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import core, unique_name
+from .framework import Parameter, Program, Variable, grad_var_name
+from .registry import FWD_META_ATTR, OPS
+
+# op types that never participate in differentiation
+_NON_DIFF_OPS = {
+    "feed", "fetch", "fill_constant", "gaussian_random", "uniform_random",
+    "sgd", "momentum", "adam", "adamax", "adagrad", "adadelta", "rmsprop",
+    "decayed_adagrad", "ftrl", "increment", "assign_value",
+}
+
+_FLOAT_DTYPES = {"float16", "bfloat16", "float32", "float64"}
+
+
+def _is_float_var(block, name: str) -> bool:
+    var = block._var_recursive(name)
+    return var is not None and var.dtype in _FLOAT_DTYPES
+
+
+def _forward_need_grad_vars(block, ops, no_grad_set: Set[str]) -> Set[str]:
+    """Vars transitively computed from trainable params / non-stop-gradient
+    float leaves (forward sweep)."""
+    need: Set[str] = set()
+    for name, var in block.vars.items():
+        if name in no_grad_set or var.stop_gradient:
+            continue
+        if isinstance(var, Parameter) and var.trainable:
+            need.add(name)
+        elif not var.persistable and var.op is None and _is_float_var(block, name):
+            # leaf data vars: differentiable unless stop_gradient (data vars
+            # default stop_gradient=True via layers.data)
+            need.add(name)
+    for op in ops:
+        if op.desc.type in _NON_DIFF_OPS:
+            continue
+        if any(n in need for n in op.desc.input_names()):
+            for n in op.desc.output_names():
+                if n and n not in no_grad_set and _is_float_var(block, n):
+                    var = block._var_recursive(n)
+                    if var is None or not var.stop_gradient:
+                        need.add(n)
+    return need
+
+
+def _create_grad_var(block, fwd_name: str, uniquify: bool = False) -> Variable:
+    fwd = block._var_recursive(fwd_name)
+    name = grad_var_name(fwd_name)
+    if uniquify or block.has_var(name):
+        name = unique_name.generate(name)
+    return block.create_var(
+        name=name,
+        shape=fwd.shape if fwd is not None else None,
+        dtype=fwd.dtype if fwd is not None else "float32",
+        persistable=False,
+        stop_gradient=True,
+    )
+
+
+def _materialize_grad(block, var_name: str, contribs: List[str]) -> Optional[str]:
+    """Resolve the accumulated gradient for `var_name` from its contribution
+    list, inserting a `sum` op when there are several (reference
+    _addup_repetitive_outputs_)."""
+    if not contribs:
+        return None
+    if len(contribs) == 1:
+        return contribs[0]
+    out = _create_grad_var(block, var_name, uniquify=True)
+    block.append_op(
+        type="sum", inputs={"X": list(contribs)}, outputs={"Out": [out.name]},
+    )
+    return out.name
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[Sequence[str]] = None,
+    no_grad_set: Optional[Set[str]] = None,
+    callbacks=None,
+) -> List[Tuple[Variable, Variable]]:
+    block = loss.block
+    program: Program = block.program
+    no_grad = set(no_grad_set or ())
+
+    fwd_ops = list(block.ops)
+    need_grad = _forward_need_grad_vars(block, fwd_ops, no_grad)
+
+    # seed d(loss)/d(loss) = 1
+    loss_grad = _create_grad_var(block, loss.name)
+    block.append_op(
+        type="fill_constant",
+        outputs={"Out": [loss_grad.name]},
+        attrs={
+            "shape": list(loss.shape or [1]),
+            "value": 1.0,
+            "dtype": loss.dtype,
+        },
+    )
+
+    contributions: Dict[str, List[str]] = {loss.name: [loss_grad.name]}
+
+    for op in reversed(fwd_ops):
+        od = op.desc
+        if od.type in _NON_DIFF_OPS or od.type.endswith("_grad"):
+            continue
+        info = OPS.get(od.type)
+        if info is None:
+            continue
+        out_has_grad = any(
+            contributions.get(n) for n in od.output_names()
+        )
+        diff_inputs = [
+            n for n in od.input_names() if n in need_grad and n not in no_grad
+        ]
+        if not out_has_grad or not diff_inputs:
+            continue
+
+        # materialize output grads
+        grad_in: Dict[str, List[str]] = {}
+        any_out_grad = False
+        for slot, names in od.outputs.items():
+            grads = []
+            for n in names:
+                g = _materialize_grad(block, n, contributions.get(n, [])) if n else None
+                grads.append(g or "")
+                any_out_grad = any_out_grad or bool(g)
+            grad_in["GRAD@" + slot] = grads
+        if not any_out_grad:
+            continue
+
+        # grad op outputs: a fresh grad var per differentiable input
+        grad_out: Dict[str, List[str]] = {}
+        new_contribs: List[Tuple[str, str]] = []
+        for slot, names in od.inputs.items():
+            if slot in (info.no_grad or ()):
+                grad_out["GRAD@" + slot] = [""] * len(names)
+                continue
+            outs = []
+            for n in names:
+                if n and n in need_grad and n not in no_grad and _is_float_var(block, n):
+                    gv = _create_grad_var(block, n, uniquify=True)
+                    outs.append(gv.name)
+                    new_contribs.append((n, gv.name))
+                else:
+                    outs.append("")
+            grad_out["GRAD@" + slot] = outs
+        if not any(n for lst in grad_out.values() for n in lst):
+            continue
+
+        grad_ins: Dict[str, List[str]] = {s: list(ns) for s, ns in od.inputs.items()}
+        for slot, names in od.outputs.items():
+            grad_ins["Out@" + slot] = list(names)
+        grad_ins.update(grad_in)
+
+        block.append_op(
+            type=od.type + "_grad",
+            inputs=grad_ins,
+            outputs=grad_out,
+            attrs={
+                FWD_META_ATTR: {
+                    "type": od.type,
+                    "attrs": dict(od.attrs),
+                    "in_slots": list(od.inputs.keys()),
+                    "out_slots": list(od.outputs.keys()),
+                }
+            },
+        )
+        for n, g in new_contribs:
+            contributions.setdefault(n, []).append(g)
+
+    # finalize parameter gradients
+    if parameter_list is not None:
+        params = [block._var_recursive(p) if isinstance(p, str) else p
+                  for p in parameter_list]
+    else:
+        params = [p for p in block.all_parameters() if p.trainable]
+
+    params_grads: List[Tuple[Variable, Variable]] = []
+    for p in params:
+        if p.name in no_grad:
+            continue
+        g_name = _materialize_grad(block, p.name, contributions.get(p.name, []))
+        if g_name is None:
+            continue
+        canonical = grad_var_name(p.name)
+        if g_name != canonical:
+            if not block.has_var(canonical):
+                gv = block.create_var(
+                    name=canonical, shape=p.shape, dtype=p.dtype,
+                    stop_gradient=True,
+                )
+            block.append_op(
+                type="assign", inputs={"X": [g_name]}, outputs={"Out": [canonical]},
+            )
+            g_name = canonical
+        params_grads.append((p, block.var(g_name)))
+    return params_grads
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference fluid.gradients / calc_gradient — grads of targets wrt inputs."""
+    if isinstance(targets, Variable):
+        targets = [targets]
+    if isinstance(inputs, Variable):
+        inputs = [inputs]
+    assert len(targets) == 1, "calc_gradient currently supports a single target"
+    pg = append_backward(
+        targets[0],
+        parameter_list=[i.name for i in inputs],
+        no_grad_set=no_grad_set,
+    )
+    by_name = {p.name: g for p, g in pg}
+    return [by_name.get(i.name) for i in inputs]

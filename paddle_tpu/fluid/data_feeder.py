@@ -1,0 +1,76 @@
+"""DataFeeder (reference python/paddle/fluid/data_feeder.py:69) — converts
+per-sample python/numpy data into batched feed arrays."""
+from __future__ import annotations
+
+import numpy as np
+
+from .core import convert_dtype
+from .framework import Variable
+
+
+class DataToLoDTensorConverter:
+    def __init__(self, place, lod_level, shape, dtype):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = [d for d in shape]
+        self.dtype = dtype
+        self.data = []
+        self.lod = [[0] for _ in range(lod_level)]
+
+    def feed(self, data):
+        self._feed_impl_(data, self.lod, self.lod_level)
+
+    def _feed_impl_(self, data, lod, lod_level):
+        if lod_level == 0:
+            self.data.append(data)
+        else:
+            lod[0].append(lod[0][-1] + len(data))
+            for each_data in data:
+                self._feed_impl_(each_data, lod[1:], lod_level - 1)
+
+    def done(self):
+        arr = np.array(self.data, dtype=self.dtype)
+        shape = [d if d >= 0 else -1 for d in self.shape]
+        if self.lod_level == 0 and shape and any(d == -1 for d in shape):
+            arr = arr.reshape([arr.shape[0]] + [d for d in shape[1:]])
+        return arr
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        from .framework import default_main_program
+
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        program = program or default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("feed_list should contain Variables or names")
+            self.feed_dtypes.append(np.dtype(convert_dtype(each_var.dtype))
+                                    if each_var.dtype != "bfloat16" else np.float32)
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            self.feed_shapes.append(each_var.shape)
+        self.place = place
+
+    def feed(self, iterable):
+        converters = [
+            DataToLoDTensorConverter(self.place, lod_level, shape, dtype)
+            for lod_level, shape, dtype in zip(
+                self.feed_lod_level, self.feed_shapes, self.feed_dtypes
+            )
+        ]
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), (
+                f"sample has {len(each_sample)} slots, expected {len(converters)}"
+            )
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        return {
+            name: conv.done()
+            for name, conv in zip(self.feed_names, converters)
+        }

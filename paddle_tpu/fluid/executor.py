@@ -1,0 +1,263 @@
+"""Executor: lowers a Program block to ONE jitted XLA computation.
+
+Capability-parity with the reference Executor (`paddle/fluid/framework/
+executor.cc:133`, `python/paddle/fluid/executor.py:181`), rebuilt as a
+compiler client:
+
+  - The reference interprets ops one-by-one per minibatch (executor.cc:344).
+    Here `_lower()` traces all op emitters in program order into a single
+    Python function, jit-compiles it once per (program version, feed
+    signature), and replays the compiled XLA executable per step. XLA fuses
+    elementwise chains into the matmuls/convs — the op boundary exists only
+    in the IR.
+  - Scope (reference scope.h:39) maps var name -> device-resident jax.Array.
+    Persistable vars (params, optimizer accumulators, BN stats) stay in HBM
+    across steps; written state buffers are donated so updates are in-place
+    at the XLA level.
+  - Feed/fetch: numpy in, numpy out (reference feed_op/fetch_op become jit
+    arguments/results).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import core
+from .flags import FLAGS
+from .framework import Program, Variable, default_main_program
+from .registry import OPS, EmitCtx, run_forward, run_grad
+
+_SKIP_OP_TYPES = {"feed", "fetch"}
+
+
+class Scope:
+    """name -> device array map (reference framework/scope.h:39)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Any] = {}
+        self.parent = parent
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        return self.find_var(name) is not None
+
+    def set_var(self, name: str, value):
+        self._vars[name] = value
+
+    def drop_var(self, name: str):
+        self._vars.pop(name, None)
+
+    def var_names(self):
+        return list(self._vars)
+
+    def new_scope(self) -> "Scope":
+        return Scope(parent=self)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+def fetch_var(name: str, scope: Optional[Scope] = None, return_numpy: bool = True):
+    scope = scope or global_scope()
+    v = scope.find_var(name)
+    if v is None:
+        raise ValueError(f"var '{name}' not found in scope")
+    return np.asarray(v) if return_numpy else v
+
+
+def _as_name(v) -> str:
+    return v.name if isinstance(v, Variable) else str(v)
+
+
+def _block_io(block, feed_names: set, scope: Scope):
+    """Classify vars of a block: state read (from scope), state written
+    (persistable -> survives the run), and which must exist beforehand."""
+    produced = set(feed_names)
+    state_in: List[str] = []
+    state_out: List[str] = []
+    persistable = {
+        name for name, var in block.vars.items() if var.persistable
+    }
+    for op in block.ops:
+        if op.desc.type in _SKIP_OP_TYPES:
+            continue
+        for n in op.desc.input_names():
+            if n and n not in produced and n not in state_in:
+                state_in.append(n)
+        for n in op.desc.output_names():
+            if n:
+                produced.add(n)
+                if n in persistable and n not in state_out:
+                    state_out.append(n)
+    return state_in, state_out
+
+
+def _lower(block, feed_names: Tuple[str, ...], fetch_names: Tuple[str, ...],
+           state_in: Tuple[str, ...], state_out: Tuple[str, ...]):
+    """Build the pure function feed, state_ro, state_rw, key -> fetches, new_state."""
+    ops = [op.desc for op in block.ops if op.desc.type not in _SKIP_OP_TYPES]
+    ro_names = tuple(n for n in state_in if n not in state_out)
+    rw_names = tuple(n for n in state_in if n in state_out)
+
+    def fn(feeds: Dict[str, Any], state_ro: Dict[str, Any],
+           state_rw: Dict[str, Any], key):
+        with jax.default_matmul_precision(FLAGS["matmul_precision"]):
+            return _body(feeds, state_ro, state_rw, key)
+
+    def _body(feeds, state_ro, state_rw, key):
+        env: Dict[str, Any] = {}
+        env.update(state_ro)
+        env.update(state_rw)
+        env.update(feeds)
+        ctx = EmitCtx(root_key=key)
+        for od in ops:
+            ins = {
+                slot: [env.get(n) if n else None for n in names]
+                for slot, names in od.inputs.items()
+            }
+            if od.type.endswith("_grad") and "__fwd__" in od.attrs:
+                outs = run_grad(ctx, ins, od.attrs)
+            else:
+                outs = run_forward(ctx, od.type, ins, od.attrs)
+            for slot, names in od.outputs.items():
+                vals = outs.get(slot, [])
+                for i, n in enumerate(names):
+                    if not n:
+                        continue
+                    if i < len(vals) and vals[i] is not None:
+                        env[n] = vals[i]
+        fetches = []
+        for n in fetch_names:
+            if n not in env:
+                raise ValueError(f"fetch target '{n}' was not produced by the block")
+            fetches.append(env[n])
+        new_state = {n: env[n] for n in state_out if n in env}
+        return fetches, new_state
+
+    return fn, ro_names, rw_names
+
+
+class Executor:
+    """Reference python/paddle/fluid/executor.py:181 — same run() contract."""
+
+    def __init__(self, place: Optional[core.Place] = None):
+        import weakref
+
+        self.place = place or core.default_place()
+        # outer weak map keyed by the live Program object (avoids id() reuse
+        # after GC); inner dict keyed by (version, feed signature, fetches)
+        self._cache: "weakref.WeakKeyDictionary[Program, Dict[Any, Any]]" = (
+            weakref.WeakKeyDictionary()
+        )
+
+    def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence[Any]] = None,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+    ):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+
+        block = program.global_block()
+        fetch_names = tuple(_as_name(v) for v in fetch_list)
+        feed_arrays = {
+            k: jnp.asarray(v) if not isinstance(v, jax.Array) else v
+            for k, v in feed.items()
+        }
+        feed_sig = tuple(
+            sorted((k, tuple(v.shape), str(v.dtype)) for k, v in feed_arrays.items())
+        )
+        cache_key = (program._version, feed_sig, fetch_names)
+
+        prog_cache = self._cache.setdefault(program, {})
+        entry = prog_cache.get(cache_key) if use_program_cache else None
+        if entry is None:
+            state_in, state_out = _block_io(block, set(feed_arrays), scope)
+            missing = [n for n in state_in if not scope.has_var(n)]
+            if missing:
+                raise RuntimeError(
+                    f"vars {missing} are read by the program but not initialized in "
+                    "scope — run the startup program first or feed them"
+                )
+            fn, ro_names, rw_names = _lower(
+                block, tuple(feed_arrays), fetch_names, tuple(state_in),
+                tuple(state_out),
+            )
+            donate = (2,) if FLAGS["donate_state"] else ()
+            jfn = jax.jit(fn, donate_argnums=donate)
+            entry = (jfn, ro_names, rw_names, tuple(state_out))
+            if use_program_cache:
+                prog_cache[cache_key] = entry
+
+        jfn, ro_names, rw_names, state_out = entry
+        state_ro = {n: scope.find_var(n) for n in ro_names}
+        state_rw = {n: scope.find_var(n) for n in rw_names}
+        seed = program.random_seed or 0
+        key = jax.random.key(seed + _step_counter.next())
+        import time as _time
+
+        t0 = _time.perf_counter() if FLAGS["benchmark"] else 0.0
+        fetches, new_state = jfn(feed_arrays, state_ro, state_rw, key)
+        if FLAGS["benchmark"]:
+            jax.block_until_ready(fetches)
+            print(f"[benchmark] run took {(_time.perf_counter()-t0)*1000:.3f} ms")
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        if FLAGS["check_nan_inf"]:
+            # reference FLAGS_check_nan_inf sweep (executor.cc:352-360)
+            for name, v in list(new_state.items()) + list(zip(fetch_names, fetches)):
+                arr = np.asarray(v)
+                if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+                    raise FloatingPointError(f"var '{name}' contains NaN/Inf")
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def close(self):
+        self._cache.clear()
+
+
+class _StepCounter:
+    def __init__(self):
+        self._n = 0
+
+    def next(self) -> int:
+        self._n += 1
+        return self._n
+
+
+_step_counter = _StepCounter()

@@ -1,0 +1,46 @@
+"""Global runtime flags — the reference exposes gflags to Python
+(reference python/paddle/fluid/__init__.py:121, framework/init.cc:31:
+check_nan_inf, benchmark, fraction_of_gpu_memory_to_use, ...). Same shape
+here, with TPU-relevant knobs."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+FLAGS: Dict[str, Any] = {
+    # numeric precision of matmul/conv inside lowered blocks:
+    #   'highest' = fp32 accumulate+multiply (reference fp32 CUDA parity)
+    #   'high'    = bf16x3 on TPU
+    #   'default' = bf16 multiply (fastest on MXU)
+    "matmul_precision": "highest",
+    # sweep outputs for NaN/Inf after each run (reference FLAGS_check_nan_inf,
+    # executor.cc:27)
+    "check_nan_inf": False,
+    # log per-run timing (reference FLAGS_benchmark, executor.cc:348)
+    "benchmark": False,
+    # donate state buffers to jit for in-place HBM updates
+    "donate_state": True,
+}
+
+
+def set_flags(d: Dict[str, Any]):
+    for k, v in d.items():
+        if k not in FLAGS:
+            raise KeyError(f"unknown flag {k!r}; known: {sorted(FLAGS)}")
+        FLAGS[k] = v
+
+
+def get_flag(name: str):
+    return FLAGS[name]
+
+
+def init_gflags(args=None):
+    """reference core.init_gflags (pybind.cc:465) — accepts '--name=value'."""
+    for a in args or []:
+        a = a.lstrip("-")
+        if "=" in a:
+            k, v = a.split("=", 1)
+            if v in ("true", "True"):
+                v = True
+            elif v in ("false", "False"):
+                v = False
+            set_flags({k: v})

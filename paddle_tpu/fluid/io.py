@@ -1,0 +1,217 @@
+"""Model persistence (reference python/paddle/fluid/io.py: save_vars:66,
+save_params:132, save_persistables:145, load_*:158-234,
+save_inference_model:298, load_inference_model:383).
+
+Artifact layout matches the reference's contract: a `__model__` file holding
+the serialized (pruned) ProgramDesc plus parameter payloads — here a single
+`__params__.npz` (the save_combine path) or one .npy per var (save_vars
+path). Checkpoints carry a crc32 in META (the Go pserver's checkpoint trick,
+go/pserver/service.go:53)."""
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import List, Optional
+
+import numpy as np
+
+from .executor import Executor, Scope, global_scope
+from .framework import Parameter, Program, Variable, default_main_program
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables",
+    "load_vars", "load_params", "load_persistables",
+    "save_inference_model", "load_inference_model", "get_inference_program",
+    "save_checkpoint", "load_checkpoint",
+]
+
+MODEL_FILENAME = "__model__"
+PARAMS_FILENAME = "__params__.npz"
+
+
+def _norm_npz(filename: str) -> str:
+    # np.savez appends '.npz' when missing; normalize so load matches save
+    return filename if filename.endswith(".npz") else filename + ".npz"
+
+
+def _collect(program: Program, predicate) -> List[Variable]:
+    return [v for v in program.list_vars() if predicate(v)]
+
+
+def is_persistable(var: Variable) -> bool:
+    return var.persistable
+
+
+def is_parameter(var: Variable) -> bool:
+    return isinstance(var, Parameter)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None, scope: Optional[Scope] = None):
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = _collect(main_program, predicate or is_persistable)
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else str(v)
+        val = scope.find_var(name)
+        if val is None:
+            raise RuntimeError(f"var '{name}' not found in scope while saving")
+        arrays[name] = np.asarray(val)
+    if filename is not None:
+        np.savez(os.path.join(dirname, _norm_npz(filename)), **arrays)
+    else:
+        for name, arr in arrays.items():
+            np.save(os.path.join(dirname, name.replace("/", "__")), arr)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=is_parameter,
+              filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=is_persistable,
+              filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None, scope: Optional[Scope] = None):
+    import jax.numpy as jnp
+
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = _collect(main_program, predicate or is_persistable)
+    if filename is not None:
+        payload = np.load(os.path.join(dirname, _norm_npz(filename)))
+        for v in vars:
+            name = v.name if isinstance(v, Variable) else str(v)
+            scope.set_var(name, jnp.asarray(payload[name]))
+        return
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else str(v)
+        path = os.path.join(dirname, name.replace("/", "__") + ".npy")
+        scope.set_var(name, jnp.asarray(np.load(path)))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_parameter,
+              filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_persistable,
+              filename=filename)
+
+
+def _prune_for_inference(program: Program, feeded_var_names, target_vars):
+    """Backward-slice the global block to ops needed for the targets
+    (reference Program.prune + inference_optimize)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = {v.name if isinstance(v, Variable) else str(v) for v in target_vars}
+    keep = []
+    for op in reversed(block.ops):
+        if set(op.desc.output_names()) & needed:
+            keep.append(op)
+            needed.update(n for n in op.desc.input_names() if n)
+    keep.reverse()
+    block.ops = keep
+    used = set()
+    for op in keep:
+        used.update(op.desc.input_names())
+        used.update(op.desc.output_names())
+    used.update(feeded_var_names)
+    block.vars = {n: v for n, v in block.vars.items() if n in used}
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    """reference io.py:298 — prune to feed/fetch targets, serialize program
+    to `__model__`, save params."""
+    main_program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    pruned = _prune_for_inference(main_program, feeded_var_names, target_vars)
+    meta = {
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [
+            v.name if isinstance(v, Variable) else str(v) for v in target_vars
+        ],
+    }
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "wb") as f:
+        f.write(pruned.to_bytes())
+    with open(os.path.join(dirname, "__meta__.json"), "w") as f:
+        json.dump(meta, f)
+    params = [v for v in pruned.list_vars() if isinstance(v, Parameter) or v.persistable]
+    save_vars(None, dirname, main_program, vars=params,
+              filename=params_filename or PARAMS_FILENAME)
+    return meta["fetch_names"]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """reference io.py:383 — returns (program, feed_names, fetch_vars)."""
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "rb") as f:
+        program = Program.parse_from_bytes(f.read())
+    with open(os.path.join(dirname, "__meta__.json")) as f:
+        meta = json.load(f)
+    persistables = [v for v in program.list_vars() if v.persistable]
+    load_vars(executor, dirname, program, vars=persistables,
+              filename=params_filename or PARAMS_FILENAME)
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars
+
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    return _prune_for_inference(main_program, [], target_vars)
+
+
+# --- checkpoint/resume with integrity check (Go pserver capability,
+#     go/pserver/service.go:119-227) ------------------------------------
+def save_checkpoint(dirname, main_program=None, step: int = 0,
+                    scope: Optional[Scope] = None):
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    payload_path = os.path.join(dirname, f"ckpt_{step}.npz")
+    vars_ = _collect(main_program, is_persistable)
+    arrays = {}
+    for v in vars_:
+        val = scope.find_var(v.name)
+        if val is not None:
+            arrays[v.name] = np.asarray(val)
+    np.savez(payload_path, **arrays)
+    with open(payload_path, "rb") as f:
+        crc = zlib.crc32(f.read())
+    meta = {"step": step, "payload": os.path.basename(payload_path), "crc32": crc}
+    tmp = os.path.join(dirname, "META.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(dirname, "META"))  # atomic, like the Go pserver
+    return payload_path
+
+
+def load_checkpoint(dirname, main_program=None, scope: Optional[Scope] = None):
+    import jax.numpy as jnp
+
+    scope = scope or global_scope()
+    with open(os.path.join(dirname, "META")) as f:
+        meta = json.load(f)
+    payload_path = os.path.join(dirname, meta["payload"])
+    with open(payload_path, "rb") as f:
+        data = f.read()
+    if zlib.crc32(data) != meta["crc32"]:
+        raise IOError(f"checkpoint {payload_path} is corrupt (crc mismatch)")
+    payload = np.load(payload_path)
+    for name in payload.files:
+        scope.set_var(name, jnp.asarray(payload[name]))
+    return meta["step"]

@@ -1,0 +1,34 @@
+"""Input layers (reference python/paddle/fluid/layers/io.py — data:28)."""
+from __future__ import annotations
+
+from ..framework import default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+
+__all__ = ["data"]
+
+
+def data(
+    name,
+    shape,
+    append_batch_size: bool = True,
+    dtype="float32",
+    lod_level: int = 0,
+    type=None,
+    stop_gradient: bool = True,
+):
+    """Feed placeholder (reference layers/io.py:28)."""
+    helper = LayerHelper("data")
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = helper.main_program.current_block()
+    if block.has_var(name):
+        return block.var(name)
+    return block.create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        lod_level=lod_level,
+        stop_gradient=stop_gradient,
+        persistable=False,
+    )

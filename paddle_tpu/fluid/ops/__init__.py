@@ -1,0 +1,18 @@
+"""Op library: JAX/XLA emitters registered by type name.
+
+Parity target: the reference op zoo `paddle/fluid/operators/` (~125 op types,
+SURVEY.md §2.4). Importing this package registers all ops.
+"""
+from . import (  # noqa: F401
+    activations,
+    compare_ops,
+    elementwise,
+    loss_ops,
+    math_ops,
+    metric_ops,
+    nn_ops,
+    optimizer_ops,
+    random_ops,
+    reduce_ops,
+    tensor_ops,
+)

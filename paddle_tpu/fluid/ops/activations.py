@@ -1,0 +1,63 @@
+"""Activation ops (reference paddle/fluid/operators/activation_op.cc — 20+
+functors registered via macros; here a table of lambdas)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+def _unary(name, fn, ref="paddle/fluid/operators/activation_op.cc"):
+    @register_op(name, ref=ref)
+    def _op(ctx, ins, attrs, _fn=fn):
+        return {"Out": _fn(one(ins, "X"), attrs)}
+
+    return _op
+
+
+_unary("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_unary("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_unary("exp", lambda x, a: jnp.exp(x))
+_unary("relu", lambda x, a: jax.nn.relu(x))
+_unary("tanh", lambda x, a: jnp.tanh(x))
+_unary("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_unary("sqrt", lambda x, a: jnp.sqrt(x))
+_unary("abs", lambda x, a: jnp.abs(x))
+_unary("ceil", lambda x, a: jnp.ceil(x))
+_unary("floor", lambda x, a: jnp.floor(x))
+_unary("round", lambda x, a: jnp.round(x))
+_unary("reciprocal", lambda x, a: 1.0 / x)
+_unary("log", lambda x, a: jnp.log(x))
+_unary("square", lambda x, a: jnp.square(x))
+_unary("softplus", lambda x, a: jax.nn.softplus(x))
+_unary("softsign", lambda x, a: jax.nn.soft_sign(x))
+_unary("softshrink", lambda x, a: jnp.where(
+    x > float(a.get("lambda", 0.5)), x - float(a.get("lambda", 0.5)),
+    jnp.where(x < -float(a.get("lambda", 0.5)), x + float(a.get("lambda", 0.5)), 0.0)))
+_unary("brelu", lambda x, a: jnp.clip(
+    x, float(a.get("t_min", 0.0)), float(a.get("t_max", 24.0))))
+_unary("leaky_relu", lambda x, a: jax.nn.leaky_relu(x, float(a.get("alpha", 0.02))))
+_unary("soft_relu", lambda x, a: jnp.log(
+    1 + jnp.exp(jnp.clip(x, -float(a.get("threshold", 40.0)),
+                         float(a.get("threshold", 40.0))))))
+_unary("elu", lambda x, a: jax.nn.elu(x, float(a.get("alpha", 1.0))))
+_unary("relu6", lambda x, a: jnp.clip(x, 0.0, float(a.get("threshold", 6.0))))
+_unary("pow", lambda x, a: jnp.power(x, float(a.get("factor", 1.0))))
+_unary("stanh", lambda x, a: float(a.get("scale_b", 1.7159)) * jnp.tanh(
+    float(a.get("scale_a", 2.0 / 3.0)) * x))
+_unary("hard_sigmoid", lambda x, a: jnp.clip(
+    float(a.get("slope", 0.2)) * x + float(a.get("offset", 0.5)), 0.0, 1.0))
+_unary("swish", lambda x, a: x * jax.nn.sigmoid(float(a.get("beta", 1.0)) * x))
+_unary("thresholded_relu", lambda x, a: jnp.where(
+    x > float(a.get("threshold", 1.0)), x, 0.0))
+_unary("hard_shrink", lambda x, a: jnp.where(
+    jnp.abs(x) > float(a.get("threshold", 0.5)), x, 0.0))
+_unary("gelu", lambda x, a: jax.nn.gelu(x, approximate=False))
+
+
+@register_op("prelu", ref="paddle/fluid/operators/prelu_op.cc")
+def prelu(ctx, ins, attrs):
+    x, alpha = one(ins, "X"), one(ins, "Alpha")
+    return {"Out": jnp.where(x > 0, x, alpha.reshape(()) * x)}

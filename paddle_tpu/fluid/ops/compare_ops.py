@@ -1,0 +1,42 @@
+"""Comparison + logical ops (reference paddle/fluid/operators/compare_op.cc,
+logical_op.cc) — these feed While conditions."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+def _cmp(name, fn):
+    @register_op(name, no_grad=("X", "Y"),
+                 ref="paddle/fluid/operators/compare_op.cc")
+    def _op(ctx, ins, attrs, _fn=fn):
+        return {"Out": _fn(one(ins, "X"), one(ins, "Y"))}
+
+    return _op
+
+
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+
+
+def _logical(name, fn, binary=True):
+    @register_op(name, no_grad=("X", "Y"),
+                 ref="paddle/fluid/operators/logical_op.cc")
+    def _op(ctx, ins, attrs, _fn=fn, _binary=binary):
+        if _binary:
+            return {"Out": _fn(one(ins, "X"), one(ins, "Y"))}
+        return {"Out": _fn(one(ins, "X"))}
+
+    return _op
+
+
+_logical("logical_and", jnp.logical_and)
+_logical("logical_or", jnp.logical_or)
+_logical("logical_xor", jnp.logical_xor)
+_logical("logical_not", jnp.logical_not, binary=False)

@@ -1,0 +1,30 @@
+"""Elementwise binary ops with Paddle axis-broadcast semantics.
+
+Reference: paddle/fluid/operators/elementwise_*_op.cc,
+elementwise_op_function.h. Gradients come from the generic vjp path (JAX
+sum-reduces broadcast dims, matching the reference's grad reduction).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import bcast_y, one
+
+
+def _binary(name, fn):
+    @register_op(name, ref="paddle/fluid/operators/elementwise_op_function.h")
+    def _op(ctx, ins, attrs, _fn=fn):
+        x, y = one(ins, "X"), one(ins, "Y")
+        return {"Out": _fn(x, bcast_y(x, y, int(attrs.get("axis", -1))))}
+
+    return _op
+
+
+_binary("elementwise_add", jnp.add)
+_binary("elementwise_sub", jnp.subtract)
+_binary("elementwise_mul", jnp.multiply)
+_binary("elementwise_div", jnp.divide)
+_binary("elementwise_max", jnp.maximum)
+_binary("elementwise_min", jnp.minimum)
+_binary("elementwise_pow", jnp.power)

@@ -1,0 +1,127 @@
+"""Loss ops (reference paddle/fluid/operators/*loss*, cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, ...)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+def _squeeze_label(label):
+    if label.ndim >= 2 and label.shape[-1] == 1:
+        return jnp.squeeze(label, -1)
+    return label
+
+
+@register_op("cross_entropy", no_grad=("Label",),
+             ref="paddle/fluid/operators/cross_entropy_op.cc")
+def cross_entropy(ctx, ins, attrs):
+    x, label = one(ins, "X"), one(ins, "Label")
+    if bool(attrs.get("soft_label", False)):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, 1e-20)), axis=-1, keepdims=True)
+    else:
+        lab = _squeeze_label(label)
+        picked = jnp.take_along_axis(x, lab[..., None].astype(jnp.int32), axis=-1)
+        loss = -jnp.log(jnp.maximum(picked, 1e-20))
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy", no_grad=("Label",),
+             ref="paddle/fluid/operators/softmax_with_cross_entropy_op.cc")
+def softmax_with_cross_entropy(ctx, ins, attrs):
+    logits, label = one(ins, "Logits"), one(ins, "Label")
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if bool(attrs.get("soft_label", False)):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lab = _squeeze_label(label)
+        picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32), axis=-1)
+        loss = -picked
+    return {"Softmax": jnp.exp(logp), "Loss": loss}
+
+
+@register_op("sigmoid_cross_entropy_with_logits",
+             ref="paddle/fluid/operators/sigmoid_cross_entropy_with_logits_op.cc")
+def sigmoid_cross_entropy_with_logits(ctx, ins, attrs):
+    x, label = one(ins, "X"), one(ins, "Label")
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": loss}
+
+
+@register_op("smooth_l1_loss", no_grad=("InsideWeight", "OutsideWeight"),
+             ref="paddle/fluid/operators/smooth_l1_loss_op.cc")
+def smooth_l1_loss(ctx, ins, attrs):
+    x, y = one(ins, "X"), one(ins, "Y")
+    iw, ow = one(ins, "InsideWeight"), one(ins, "OutsideWeight")
+    sigma = float(attrs.get("sigma", 1.0))
+    s2 = sigma * sigma
+    d = x - y
+    if iw is not None:
+        d = d * iw
+    ad = jnp.abs(d)
+    diff = jnp.where(ad < 1.0 / s2, 0.5 * s2 * d * d, ad - 0.5 / s2)
+    if ow is not None:
+        diff = diff * ow
+    out = jnp.sum(diff.reshape(diff.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": out, "Diff": d}
+
+
+@register_op("huber_loss", ref="paddle/fluid/operators/huber_loss_op.cc")
+def huber_loss(ctx, ins, attrs):
+    x, y = one(ins, "X"), one(ins, "Y")
+    delta = float(attrs.get("delta", 1.0))
+    r = y - x
+    ar = jnp.abs(r)
+    out = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Out": out, "Residual": r}
+
+
+@register_op("log_loss", ref="paddle/fluid/operators/log_loss_op.cc")
+def log_loss(ctx, ins, attrs):
+    p, label = one(ins, "Predicted"), one(ins, "Labels")
+    eps = float(attrs.get("epsilon", 1e-4))
+    out = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": out}
+
+
+@register_op("hinge_loss", ref="paddle/fluid/operators/hinge_loss_op.cc")
+def hinge_loss(ctx, ins, attrs):
+    logits, label = one(ins, "Logits"), one(ins, "Labels")
+    return {"Loss": jnp.maximum(0.0, 1.0 - (2.0 * label - 1.0) * logits)}
+
+
+@register_op("rank_loss", ref="paddle/fluid/operators/rank_loss_op.cc")
+def rank_loss(ctx, ins, attrs):
+    label = one(ins, "Label")
+    left, right = one(ins, "Left"), one(ins, "Right")
+    d = left - right
+    return {"Out": jnp.log1p(jnp.exp(d)) - label * d}
+
+
+@register_op("margin_rank_loss", ref="paddle/fluid/operators/margin_rank_loss_op.cc")
+def margin_rank_loss(ctx, ins, attrs):
+    label = one(ins, "Label")
+    x1, x2 = one(ins, "X1"), one(ins, "X2")
+    margin = float(attrs.get("margin", 0.0))
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register_op("squared_l2_distance",
+             ref="paddle/fluid/operators/squared_l2_distance_op.cc")
+def squared_l2_distance(ctx, ins, attrs):
+    x, y = one(ins, "X"), one(ins, "Y")
+    d = x - y
+    return {"Out": jnp.sum(jnp.square(d), axis=-1, keepdims=True), "sub_result": d}
+
+
+@register_op("modified_huber_loss",
+             ref="paddle/fluid/operators/modified_huber_loss_op.cc")
+def modified_huber_loss(ctx, ins, attrs):
+    x, y = one(ins, "X"), one(ins, "Y")
+    z = (2.0 * y - 1.0) * x
+    out = jnp.where(z < -1.0, -4.0 * z,
+                    jnp.where(z < 1.0, jnp.square(1.0 - z), 0.0))
+    return {"Out": out, "IntermediateVal": z}

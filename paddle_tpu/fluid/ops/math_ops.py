@@ -1,0 +1,131 @@
+"""GEMM-family + misc math ops.
+
+Reference: paddle/fluid/operators/{mul,matmul,sum,mean,scale,clip}_op.* —
+these land on the MXU via jnp.dot / lax.dot_general.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import many, one
+
+
+def _flatten2(x, num_col_dims: int):
+    lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims > 0 else 1
+    return jnp.reshape(x, (lead, -1))
+
+
+@register_op("mul", ref="paddle/fluid/operators/mul_op.cc")
+def mul(ctx, ins, attrs):
+    x, y = one(ins, "X"), one(ins, "Y")
+    xn = int(attrs.get("x_num_col_dims", 1))
+    yn = int(attrs.get("y_num_col_dims", 1))
+    x2 = _flatten2(x, xn)
+    y2 = jnp.reshape(y, (int(np.prod(y.shape[:yn])), -1))
+    out = jnp.matmul(x2, y2)
+    out_shape = tuple(x.shape[:xn]) + tuple(y.shape[yn:])
+    return {"Out": jnp.reshape(out, out_shape)}
+
+
+@register_op("matmul", ref="paddle/fluid/operators/matmul_op.cc")
+def matmul(ctx, ins, attrs):
+    x, y = one(ins, "X"), one(ins, "Y")
+    tx, ty = bool(attrs.get("transpose_X", False)), bool(attrs.get("transpose_Y", False))
+    alpha = float(attrs.get("alpha", 1.0))
+    if x.ndim == 1:
+        x = x[None, :] if not tx else x[:, None]
+    if y.ndim == 1:
+        y = y[:, None] if not ty else y[None, :]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register_op("sum", ref="paddle/fluid/operators/sum_op.cc")
+def sum_op(ctx, ins, attrs):
+    xs = many(ins, "X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("mean", ref="paddle/fluid/operators/mean_op.cc")
+def mean(ctx, ins, attrs):
+    x = one(ins, "X")
+    return {"Out": jnp.mean(x).reshape((1,))}
+
+
+@register_op("scale", ref="paddle/fluid/operators/scale_op.cc")
+def scale(ctx, ins, attrs):
+    x = one(ins, "X")
+    s = float(attrs.get("scale", 1.0))
+    b = float(attrs.get("bias", 0.0))
+    if bool(attrs.get("bias_after_scale", True)):
+        return {"Out": x * s + b}
+    return {"Out": (x + b) * s}
+
+
+@register_op("clip", ref="paddle/fluid/operators/clip_op.cc")
+def clip(ctx, ins, attrs):
+    x = one(ins, "X")
+    return {"Out": jnp.clip(x, float(attrs["min"]), float(attrs["max"]))}
+
+
+@register_op("clip_by_norm", ref="paddle/fluid/operators/clip_by_norm_op.cc")
+def clip_by_norm(ctx, ins, attrs):
+    x = one(ins, "X")
+    max_norm = float(attrs["max_norm"])
+    norm = jnp.sqrt(jnp.sum(x * x))
+    return {"Out": jnp.where(norm > max_norm, x * (max_norm / norm), x)}
+
+
+@register_op("squared_l2_norm", ref="paddle/fluid/operators/squared_l2_norm_op.cc")
+def squared_l2_norm(ctx, ins, attrs):
+    x = one(ins, "X")
+    return {"Out": jnp.sum(x * x).reshape((1,))}
+
+
+@register_op("l1_norm", ref="paddle/fluid/operators/l1_norm_op.cc")
+def l1_norm(ctx, ins, attrs):
+    x = one(ins, "X")
+    return {"Out": jnp.sum(jnp.abs(x)).reshape((1,))}
+
+
+@register_op("cumsum", ref="paddle/fluid/operators/cum_op.h")
+def cumsum(ctx, ins, attrs):
+    x = one(ins, "X")
+    axis = int(attrs.get("axis", -1))
+    if bool(attrs.get("reverse", False)):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    else:
+        out = jnp.cumsum(x, axis=axis)
+    if bool(attrs.get("exclusive", False)):
+        out = out - x
+    return {"Out": out}
+
+
+@register_op("sign", ref="paddle/fluid/operators/sign_op.cc")
+def sign(ctx, ins, attrs):
+    return {"Out": jnp.sign(one(ins, "X"))}
+
+
+@register_op("minus", ref="paddle/fluid/operators/minus_op.cc")
+def minus(ctx, ins, attrs):
+    return {"Out": one(ins, "X") - one(ins, "Y")}
+
+
+@register_op("cos_sim", ref="paddle/fluid/operators/cos_sim_op.cc")
+def cos_sim(ctx, ins, attrs):
+    x, y = one(ins, "X"), one(ins, "Y")
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}

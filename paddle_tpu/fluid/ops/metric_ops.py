@@ -1,0 +1,81 @@
+"""Metric ops (reference paddle/fluid/operators/{accuracy,auc,edit_distance,
+precision_recall}_op.*)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+@register_op("accuracy", no_grad=("Out", "Indices", "Label"),
+             ref="paddle/fluid/operators/accuracy_op.cc")
+def accuracy(ctx, ins, attrs):
+    indices, label = one(ins, "Indices"), one(ins, "Label")
+    if label.ndim >= 2 and label.shape[-1] == 1:
+        label = jnp.squeeze(label, -1)
+    correct = jnp.any(indices == label[:, None], axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = jnp.asarray(indices.shape[0], dtype=jnp.int32)
+    acc = num_correct.astype(jnp.float32) / indices.shape[0]
+    return {
+        "Accuracy": acc.reshape((1,)),
+        "Correct": num_correct.reshape((1,)),
+        "Total": total.reshape((1,)),
+    }
+
+
+@register_op("auc", no_grad=("Out", "Indices", "Label"),
+             ref="paddle/fluid/operators/auc_op.cc")
+def auc(ctx, ins, attrs):
+    # single-batch AUC via thresholded TPR/FPR trapezoid (reference computes
+    # the same from confusion counts at `num_thresholds` levels)
+    out, label = one(ins, "Out"), one(ins, "Label")
+    num_t = int(attrs.get("num_thresholds", 200))
+    pos_score = out[:, 1] if out.ndim == 2 and out.shape[1] >= 2 else out.reshape(-1)
+    lab = label.reshape(-1).astype(jnp.bool_)
+    thresholds = jnp.linspace(0.0, 1.0, num_t)
+    pred = pos_score[None, :] > thresholds[:, None]
+    tp = jnp.sum(pred & lab[None, :], axis=1).astype(jnp.float32)
+    fp = jnp.sum(pred & ~lab[None, :], axis=1).astype(jnp.float32)
+    pos = jnp.maximum(jnp.sum(lab), 1)
+    neg = jnp.maximum(jnp.sum(~lab), 1)
+    tpr = tp / pos
+    fpr = fp / neg
+    auc_val = -jnp.trapezoid(tpr, fpr)
+    return {"AUC": auc_val.reshape((1,))}
+
+
+@register_op("edit_distance", no_grad=("Hyps", "Refs"),
+             ref="paddle/fluid/operators/edit_distance_op.cc")
+def edit_distance(ctx, ins, attrs):
+    import jax
+
+    hyps, refs = one(ins, "Hyps"), one(ins, "Refs")
+    normalized = bool(attrs.get("normalized", False))
+
+    def one_pair(h, r):
+        m, n = h.shape[0], r.shape[0]
+        row = jnp.arange(n + 1, dtype=jnp.float32)
+
+        def body(i, row):
+            def inner(j, acc):
+                prev_row, cur = acc
+                cost = jnp.where(h[i - 1] == r[j - 1], 0.0, 1.0)
+                val = jnp.minimum(
+                    jnp.minimum(cur[j - 1] + 1.0, prev_row[j] + 1.0),
+                    prev_row[j - 1] + cost,
+                )
+                return prev_row, cur.at[j].set(val)
+
+            new = jnp.zeros_like(row).at[0].set(i * 1.0)
+            _, new = jax.lax.fori_loop(1, n + 1, inner, (row, new))
+            return new
+
+        final = jax.lax.fori_loop(1, m + 1, body, row)
+        d = final[n]
+        return d / n if normalized else d
+
+    dists = jax.vmap(one_pair)(hyps, refs)
+    return {"Out": dists.reshape(-1, 1),
+            "SequenceNum": jnp.asarray([hyps.shape[0]], dtype=jnp.int64)}

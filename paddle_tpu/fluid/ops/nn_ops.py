@@ -1,0 +1,237 @@
+"""NN ops: conv, pool, norms, softmax — the MXU-bound kernels.
+
+Reference: paddle/fluid/operators/{conv_op,pool_op,batch_norm_op,
+layer_norm_op,softmax_op,conv_transpose_op,lrn_op}.* (cuDNN variants
+collapse into XLA convolution HLO, which TPU lowers onto the MXU).
+Layouts are NCHW user-facing (reference default); XLA's layout assignment
+re-tiles internally for the systolic array.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(v)] * n
+
+
+@register_op("conv2d", ref="paddle/fluid/operators/conv_op.cc")
+def conv2d(ctx, ins, attrs):
+    x, w = one(ins, "Input"), one(ins, "Filter")
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = int(attrs.get("groups", 1) or 1)
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d", ref="paddle/fluid/operators/conv_op.cc (depthwise)")
+def depthwise_conv2d(ctx, ins, attrs):
+    attrs = dict(attrs)
+    x = one(ins, "Input")
+    attrs["groups"] = x.shape[1]
+    return conv2d(ctx, ins, attrs)
+
+
+@register_op("conv3d", ref="paddle/fluid/operators/conv_op.cc")
+def conv3d(ctx, ins, attrs):
+    x, w = one(ins, "Input"), one(ins, "Filter")
+    strides = _pair(attrs.get("strides", [1, 1, 1]), 3)
+    paddings = _pair(attrs.get("paddings", [0, 0, 0]), 3)
+    dilations = _pair(attrs.get("dilations", [1, 1, 1]), 3)
+    groups = int(attrs.get("groups", 1) or 1)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in paddings],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": out}
+
+
+@register_op("conv2d_transpose", ref="paddle/fluid/operators/conv_transpose_op.cc")
+def conv2d_transpose(ctx, ins, attrs):
+    x, w = one(ins, "Input"), one(ins, "Filter")
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    # filter layout [in_c, out_c, kh, kw] (reference conv_transpose convention)
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=[1, 1],
+        padding=[
+            (dilations[0] * (w.shape[2] - 1) - paddings[0],
+             dilations[0] * (w.shape[2] - 1) - paddings[0]),
+            (dilations[1] * (w.shape[3] - 1) - paddings[1],
+             dilations[1] * (w.shape[3] - 1) - paddings[1]),
+        ],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True,
+    )
+    return {"Output": out}
+
+
+def _pool2d(x, pooling_type, ksize, strides, paddings, global_pooling, exclusive,
+            adaptive=False):
+    if global_pooling:
+        ksize = [x.shape[2], x.shape[3]]
+        paddings = [0, 0]
+        strides = [1, 1]
+    window = (1, 1, ksize[0], ksize[1])
+    wstrides = (1, 1, strides[0], strides[1])
+    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]), (paddings[1], paddings[1]))
+    if pooling_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, wstrides, pads)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, wstrides, pads)
+    if exclusive:
+        ones = jnp.ones((1, 1, x.shape[2], x.shape[3]), dtype=x.dtype)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, wstrides, pads)
+        return s / cnt
+    return s / float(ksize[0] * ksize[1])
+
+
+@register_op("pool2d", ref="paddle/fluid/operators/pool_op.cc")
+def pool2d(ctx, ins, attrs):
+    x = one(ins, "X")
+    out = _pool2d(
+        x,
+        str(attrs.get("pooling_type", "max")),
+        _pair(attrs.get("ksize", [2, 2])),
+        _pair(attrs.get("strides", [1, 1])),
+        _pair(attrs.get("paddings", [0, 0])),
+        bool(attrs.get("global_pooling", False)),
+        bool(attrs.get("exclusive", True)),
+    )
+    return {"Out": out}
+
+
+@register_op("batch_norm", ref="paddle/fluid/operators/batch_norm_op.cc")
+def batch_norm(ctx, ins, attrs):
+    x = one(ins, "X")
+    scale, bias = one(ins, "Scale"), one(ins, "Bias")
+    mean, var = one(ins, "Mean"), one(ins, "Variance")
+    eps = float(attrs.get("epsilon", 1e-5))
+    momentum = float(attrs.get("momentum", 0.9))
+    is_test = bool(attrs.get("is_test", False))
+    layout = str(attrs.get("data_layout", "NCHW"))
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = jnp.zeros_like(mean)
+        saved_var = jnp.zeros_like(var)
+    else:
+        batch_mean = jnp.mean(x, axis=axes)
+        batch_var = jnp.mean(jnp.square(x - batch_mean.reshape(bshape)), axis=axes)
+        use_mean, use_var = batch_mean, batch_var
+        mean_out = mean * momentum + batch_mean * (1.0 - momentum)
+        var_out = var * momentum + batch_var * (1.0 - momentum)
+        saved_mean = batch_mean
+        saved_var = 1.0 / jnp.sqrt(batch_var + eps)
+
+    inv = jax.lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * (inv * scale).reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": y,
+        "MeanOut": mean_out,
+        "VarianceOut": var_out,
+        "SavedMean": saved_mean,
+        "SavedVariance": saved_var,
+    }
+
+
+@register_op("layer_norm", ref="paddle/fluid/operators/layer_norm_op.cc")
+def layer_norm(ctx, ins, attrs):
+    x = one(ins, "X")
+    scale, bias = one(ins, "Scale"), one(ins, "Bias")
+    eps = float(attrs.get("epsilon", 1e-5))
+    begin = int(attrs.get("begin_norm_axis", 1))
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    norm_shape = [1] * begin + list(x.shape[begin:])
+    if scale is not None:
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape)
+    lead = int(np.prod(x.shape[:begin]))
+    return {
+        "Y": y,
+        "Mean": mean.reshape((lead,)),
+        "Variance": var.reshape((lead,)),
+    }
+
+
+@register_op("softmax", ref="paddle/fluid/operators/softmax_op.cc")
+def softmax(ctx, ins, attrs):
+    return {"Out": jax.nn.softmax(one(ins, "X"), axis=-1)}
+
+
+@register_op("sequence_softmax", ref="paddle/fluid/operators/sequence_softmax_op.cc")
+def sequence_softmax(ctx, ins, attrs):
+    return {"Out": jax.nn.softmax(one(ins, "X"), axis=-1)}
+
+
+@register_op("lrn", ref="paddle/fluid/operators/lrn_op.cc")
+def lrn(ctx, ins, attrs):
+    x = one(ins, "X")
+    n = int(attrs.get("n", 5))
+    k = float(attrs.get("k", 2.0))
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    sq = jnp.square(x)
+    half = n // 2
+    pads = ((0, 0), (half, half), (0, 0), (0, 0))
+    acc = jax.lax.reduce_window(sq, 0.0, jax.lax.add, (1, n, 1, 1), (1, 1, 1, 1), pads)
+    mid = k + alpha * acc
+    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+
+
+@register_op("l2_normalize", ref="paddle/fluid/operators/norm_op.cc")
+def l2_normalize(ctx, ins, attrs):
+    x = one(ins, "X")
+    axis = int(attrs.get("axis", -1))
+    eps = float(attrs.get("epsilon", 1e-10))
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return {"Out": x / jnp.maximum(norm, eps), "Norm": norm}
+
+
+@register_op("im2sequence", ref="paddle/fluid/operators/im2sequence_op.cc")
+def im2sequence(ctx, ins, attrs):
+    x = one(ins, "X")
+    kernels = _pair(attrs.get("kernels", [1, 1]))
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0, 0, 0])]
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (paddings[0], paddings[2]),
+                    (paddings[1], paddings[3])))
+    patches = jax.lax.conv_general_dilated_patches(
+        x, kernels, strides, padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh, ow = patches.shape[2], patches.shape[3]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kernels[0] * kernels[1])
+    return {"Out": out}

@@ -1,0 +1,161 @@
+"""Optimizer ops — device-side parameter updates, like the reference's
+optimizer kernels (paddle/fluid/operators/{sgd,momentum,adam,adagrad,adamax,
+adadelta,rmsprop,decayed_adagrad,ftrl}_op.*). Each returns the new state;
+the executor writes it back to the HBM-resident scope (donated buffers →
+in-place at the XLA level)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+@register_op("sgd", ref="paddle/fluid/operators/sgd_op.cc")
+def sgd(ctx, ins, attrs):
+    p, g, lr = one(ins, "Param"), one(ins, "Grad"), one(ins, "LearningRate")
+    return {"ParamOut": p - lr.reshape(()) * g}
+
+
+@register_op("momentum", ref="paddle/fluid/operators/momentum_op.cc")
+def momentum(ctx, ins, attrs):
+    p, g, v = one(ins, "Param"), one(ins, "Grad"), one(ins, "Velocity")
+    lr = one(ins, "LearningRate").reshape(())
+    mu = float(attrs.get("mu", 0.9))
+    nesterov = bool(attrs.get("use_nesterov", False))
+    v_new = mu * v + g
+    if nesterov:
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": p_new, "VelocityOut": v_new}
+
+
+@register_op("adam", ref="paddle/fluid/operators/adam_op.cc")
+def adam(ctx, ins, attrs):
+    p, g = one(ins, "Param"), one(ins, "Grad")
+    m1, m2 = one(ins, "Moment1"), one(ins, "Moment2")
+    b1p, b2p = one(ins, "Beta1Pow"), one(ins, "Beta2Pow")
+    lr = one(ins, "LearningRate").reshape(())
+    b1 = float(attrs.get("beta1", 0.9))
+    b2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    pn = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    return {
+        "ParamOut": pn, "Moment1Out": m1n, "Moment2Out": m2n,
+        "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2,
+    }
+
+
+@register_op("adagrad", ref="paddle/fluid/operators/adagrad_op.cc")
+def adagrad(ctx, ins, attrs):
+    p, g, m = one(ins, "Param"), one(ins, "Grad"), one(ins, "Moment")
+    lr = one(ins, "LearningRate").reshape(())
+    eps = float(attrs.get("epsilon", 1e-6))
+    mn = m + g * g
+    return {"ParamOut": p - lr * g / (jnp.sqrt(mn) + eps), "MomentOut": mn}
+
+
+@register_op("decayed_adagrad", ref="paddle/fluid/operators/decayed_adagrad_op.cc")
+def decayed_adagrad(ctx, ins, attrs):
+    p, g, m = one(ins, "Param"), one(ins, "Grad"), one(ins, "Moment")
+    lr = one(ins, "LearningRate").reshape(())
+    decay = float(attrs.get("decay", 0.95))
+    eps = float(attrs.get("epsilon", 1e-6))
+    mn = decay * m + (1 - decay) * g * g
+    return {"ParamOut": p - lr * g / (jnp.sqrt(mn) + eps), "MomentOut": mn}
+
+
+@register_op("adadelta", ref="paddle/fluid/operators/adadelta_op.cc")
+def adadelta(ctx, ins, attrs):
+    p, g = one(ins, "Param"), one(ins, "Grad")
+    avg_sq_g = one(ins, "AvgSquaredGrad")
+    avg_sq_u = one(ins, "AvgSquaredUpdate")
+    rho = float(attrs.get("rho", 0.95))
+    eps = float(attrs.get("epsilon", 1e-6))
+    asg = rho * avg_sq_g + (1 - rho) * g * g
+    update = -jnp.sqrt((avg_sq_u + eps) / (asg + eps)) * g
+    asu = rho * avg_sq_u + (1 - rho) * update * update
+    return {
+        "ParamOut": p + update,
+        "AvgSquaredGradOut": asg,
+        "AvgSquaredUpdateOut": asu,
+    }
+
+
+@register_op("adamax", ref="paddle/fluid/operators/adamax_op.cc")
+def adamax(ctx, ins, attrs):
+    p, g = one(ins, "Param"), one(ins, "Grad")
+    m, inf = one(ins, "Moment"), one(ins, "InfNorm")
+    b1p = one(ins, "Beta1Pow").reshape(())
+    lr = one(ins, "LearningRate").reshape(())
+    b1 = float(attrs.get("beta1", 0.9))
+    b2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    mn = b1 * m + (1 - b1) * g
+    infn = jnp.maximum(b2 * inf, jnp.abs(g))
+    pn = p - (lr / (1 - b1p)) * mn / (infn + eps)
+    return {"ParamOut": pn, "MomentOut": mn, "InfNormOut": infn}
+
+
+@register_op("rmsprop", ref="paddle/fluid/operators/rmsprop_op.cc")
+def rmsprop(ctx, ins, attrs):
+    p, g = one(ins, "Param"), one(ins, "Grad")
+    ms, mom = one(ins, "MeanSquare"), one(ins, "Moment")
+    lr = one(ins, "LearningRate").reshape(())
+    decay = float(attrs.get("decay", 0.9))
+    mu = float(attrs.get("momentum", 0.0))
+    eps = float(attrs.get("epsilon", 1e-10))
+    msn = decay * ms + (1 - decay) * g * g
+    momn = mu * mom + lr * g / jnp.sqrt(msn + eps)
+    return {"ParamOut": p - momn, "MeanSquareOut": msn, "MomentOut": momn}
+
+
+@register_op("ftrl", ref="paddle/fluid/operators/ftrl_op.cc")
+def ftrl(ctx, ins, attrs):
+    p, g = one(ins, "Param"), one(ins, "Grad")
+    sq, lin = one(ins, "SquaredAccumulator"), one(ins, "LinearAccumulator")
+    lr = one(ins, "LearningRate").reshape(())
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    power = float(attrs.get("lr_power", -0.5))
+    new_sq = sq + g * g
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    new_lin = lin + g - sigma * p
+    if power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -power) / lr + 2 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    pn = pre / denom
+    return {"ParamOut": pn, "SquaredAccumOut": new_sq, "LinearAccumOut": new_lin}
+
+
+@register_op("proximal_gd", ref="paddle/fluid/operators/proximal_gd_op.cc")
+def proximal_gd(ctx, ins, attrs):
+    p, g = one(ins, "Param"), one(ins, "Grad")
+    lr = one(ins, "LearningRate").reshape(())
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    prox = p - lr * g
+    pn = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    return {"ParamOut": pn}
+
+
+@register_op("proximal_adagrad", ref="paddle/fluid/operators/proximal_adagrad_op.cc")
+def proximal_adagrad(ctx, ins, attrs):
+    p, g, m = one(ins, "Param"), one(ins, "Grad"), one(ins, "Moment")
+    lr = one(ins, "LearningRate").reshape(())
+    l1 = float(attrs.get("l1", 0.0))
+    l2 = float(attrs.get("l2", 0.0))
+    mn = m + g * g
+    lr_t = lr / jnp.sqrt(mn)
+    prox = p - lr_t * g
+    pn = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0) / (1.0 + lr_t * l2)
+    return {"ParamOut": pn, "MomentOut": mn}

@@ -1,0 +1,62 @@
+"""RNG ops — XLA threefry PRNG replaces curand.
+
+Reference: paddle/fluid/operators/{uniform_random,gaussian_random,dropout}_op.*
+Determinism contract: each op instance carries a seed attr folded into the
+per-step key (registry.EmitCtx.rng), so grad-op re-traces reproduce masks.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import dtype_of, one
+
+
+@register_op("uniform_random", needs_rng=True,
+             ref="paddle/fluid/operators/uniform_random_op.cc")
+def uniform_random(ctx, ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    return {"Out": jax.random.uniform(
+        ctx.rng(attrs), shape, dtype=dtype_of(attrs),
+        minval=float(attrs.get("min", -1.0)), maxval=float(attrs.get("max", 1.0)))}
+
+
+@register_op("uniform_random_batch_size_like", needs_rng=True,
+             ref="paddle/fluid/operators/uniform_random_batch_size_like_op.cc")
+def uniform_random_batch_size_like(ctx, ins, attrs):
+    inp = one(ins, "Input")
+    shape = [int(s) for s in attrs["shape"]]
+    shape[int(attrs.get("output_dim_idx", 0))] = inp.shape[int(attrs.get("input_dim_idx", 0))]
+    return {"Out": jax.random.uniform(
+        ctx.rng(attrs), shape, dtype=dtype_of(attrs),
+        minval=float(attrs.get("min", -1.0)), maxval=float(attrs.get("max", 1.0)))}
+
+
+@register_op("gaussian_random", needs_rng=True,
+             ref="paddle/fluid/operators/gaussian_random_op.cc")
+def gaussian_random(ctx, ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    sample = jax.random.normal(ctx.rng(attrs), shape, dtype=dtype_of(attrs))
+    return {"Out": sample * float(attrs.get("std", 1.0)) + float(attrs.get("mean", 0.0))}
+
+
+@register_op("gaussian_random_batch_size_like", needs_rng=True,
+             ref="paddle/fluid/operators/gaussian_random_batch_size_like_op.cc")
+def gaussian_random_batch_size_like(ctx, ins, attrs):
+    inp = one(ins, "Input")
+    shape = [int(s) for s in attrs["shape"]]
+    shape[int(attrs.get("output_dim_idx", 0))] = inp.shape[int(attrs.get("input_dim_idx", 0))]
+    sample = jax.random.normal(ctx.rng(attrs), shape, dtype=dtype_of(attrs))
+    return {"Out": sample * float(attrs.get("std", 1.0)) + float(attrs.get("mean", 0.0))}
+
+
+@register_op("dropout", needs_rng=True, ref="paddle/fluid/operators/dropout_op.cc")
+def dropout(ctx, ins, attrs):
+    x = one(ins, "X")
+    p = float(attrs.get("dropout_prob", 0.5))
+    if bool(attrs.get("is_test", False)):
+        # reference-era "downgrade in infer": scale at test time
+        return {"Out": x * (1.0 - p), "Mask": jnp.ones_like(x)}
+    mask = jax.random.bernoulli(ctx.rng(attrs), 1.0 - p, x.shape).astype(x.dtype)
+    return {"Out": x * mask, "Mask": mask}

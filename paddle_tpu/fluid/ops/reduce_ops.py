@@ -1,0 +1,34 @@
+"""Reduction ops (reference paddle/fluid/operators/reduce_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+def _reduce(name, fn):
+    @register_op(name, ref="paddle/fluid/operators/reduce_op.cc")
+    def _op(ctx, ins, attrs, _fn=fn):
+        x = one(ins, "X")
+        if bool(attrs.get("reduce_all", False)):
+            dims = None
+        else:
+            dims = attrs.get("dim", [0])
+            if isinstance(dims, int):
+                dims = [dims]
+            dims = tuple(int(d) for d in dims)
+        keep = bool(attrs.get("keep_dim", False))
+        out = _fn(x, axis=dims, keepdims=keep)
+        if dims is None and not keep:
+            out = out.reshape((1,))
+        return {"Out": out}
+
+    return _op
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)

@@ -1,0 +1,217 @@
+"""Tensor-manipulation ops.
+
+Reference: paddle/fluid/operators/{reshape,transpose,concat,split,cast,
+fill_constant,assign,lookup_table,one_hot,top_k,expand,pad,gather,scatter,
+...}_op.*
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import dtype_of, many, one
+
+
+@register_op("reshape", ref="paddle/fluid/operators/reshape_op.cc")
+def reshape(ctx, ins, attrs):
+    x = one(ins, "X")
+    shape = [int(s) for s in attrs["shape"]]
+    # reference semantics: 0 means "copy this dim from input"
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return {"Out": jnp.reshape(x, shape)}
+
+
+@register_op("transpose", ref="paddle/fluid/operators/transpose_op.cc")
+def transpose(ctx, ins, attrs):
+    return {"Out": jnp.transpose(one(ins, "X"), [int(a) for a in attrs["axis"]])}
+
+
+@register_op("concat", ref="paddle/fluid/operators/concat_op.cc")
+def concat(ctx, ins, attrs):
+    return {"Out": jnp.concatenate(many(ins, "X"), axis=int(attrs.get("axis", 0)))}
+
+
+@register_op("split", ref="paddle/fluid/operators/split_op.cc")
+def split(ctx, ins, attrs):
+    x = one(ins, "X")
+    axis = int(attrs.get("axis", 0))
+    sections = attrs.get("sections") or []
+    num = int(attrs.get("num", 0))
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("cast", ref="paddle/fluid/operators/cast_op.cc")
+def cast(ctx, ins, attrs):
+    return {"Out": one(ins, "X").astype(dtype_of(attrs, "out_dtype"))}
+
+
+@register_op("assign", ref="paddle/fluid/operators/assign_op.cc")
+def assign(ctx, ins, attrs):
+    return {"Out": one(ins, "X")}
+
+
+@register_op("assign_value", ref="paddle/fluid/operators/assign_value_op.cc")
+def assign_value(ctx, ins, attrs):
+    vals = np.asarray(attrs["values"], dtype=dtype_of(attrs))
+    return {"Out": jnp.asarray(vals.reshape([int(s) for s in attrs["shape"]]))}
+
+
+@register_op("fill_constant", ref="paddle/fluid/operators/fill_constant_op.cc")
+def fill_constant(ctx, ins, attrs):
+    shape = [int(s) for s in attrs["shape"]]
+    return {"Out": jnp.full(shape, float(attrs.get("value", 0.0)), dtype=dtype_of(attrs))}
+
+
+@register_op("fill_constant_batch_size_like",
+             ref="paddle/fluid/operators/fill_constant_batch_size_like_op.cc")
+def fill_constant_batch_size_like(ctx, ins, attrs):
+    inp = one(ins, "Input")
+    shape = [int(s) for s in attrs["shape"]]
+    in_idx = int(attrs.get("input_dim_idx", 0))
+    out_idx = int(attrs.get("output_dim_idx", 0))
+    shape[out_idx] = inp.shape[in_idx]
+    return {"Out": jnp.full(shape, float(attrs.get("value", 0.0)), dtype=dtype_of(attrs))}
+
+
+@register_op("fill_zeros_like", ref="paddle/fluid/operators/fill_zeros_like_op.cc")
+def fill_zeros_like(ctx, ins, attrs):
+    return {"Out": jnp.zeros_like(one(ins, "X"))}
+
+
+@register_op("shape", ref="paddle/fluid/operators/shape_op.cc")
+def shape_op(ctx, ins, attrs):
+    return {"Out": jnp.asarray(one(ins, "Input").shape, dtype=jnp.int64)}
+
+
+@register_op("increment", ref="paddle/fluid/operators/increment_op.cc")
+def increment(ctx, ins, attrs):
+    x = one(ins, "X")
+    return {"Out": x + jnp.asarray(attrs.get("step", 1.0)).astype(x.dtype)}
+
+
+@register_op("lookup_table", no_grad=("Ids",),
+             ref="paddle/fluid/operators/lookup_table_op.cc")
+def lookup_table(ctx, ins, attrs):
+    w, ids = one(ins, "W"), one(ins, "Ids")
+    padding_idx = int(attrs.get("padding_idx", -1))
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx != -1:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return {"Out": out}
+
+
+@register_op("one_hot", ref="paddle/fluid/operators/one_hot_op.cc")
+def one_hot(ctx, ins, attrs):
+    x = one(ins, "X")
+    depth = int(attrs["depth"])
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = jnp.squeeze(x, -1)
+    return {"Out": jax.nn.one_hot(x, depth, dtype=jnp.float32)}
+
+
+@register_op("top_k", ref="paddle/fluid/operators/top_k_op.cc")
+def top_k(ctx, ins, attrs):
+    x = one(ins, "X")
+    k = int(attrs["k"])
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("expand", ref="paddle/fluid/operators/expand_op.cc")
+def expand(ctx, ins, attrs):
+    x = one(ins, "X")
+    times = [int(t) for t in attrs["expand_times"]]
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("pad", ref="paddle/fluid/operators/pad_op.cc")
+def pad(ctx, ins, attrs):
+    x = one(ins, "X")
+    p = [int(v) for v in attrs["paddings"]]
+    pairs = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pairs, constant_values=float(attrs.get("pad_value", 0.0)))}
+
+
+@register_op("crop", ref="paddle/fluid/operators/crop_op.cc")
+def crop(ctx, ins, attrs):
+    x = one(ins, "X")
+    offsets = [int(v) for v in attrs.get("offsets", [0] * x.ndim)]
+    shape = [int(v) for v in attrs["shape"]]
+    return {"Out": jax.lax.dynamic_slice(x, offsets, shape)}
+
+
+@register_op("gather", no_grad=("Index",), ref="paddle/fluid/operators/gather_op.cc")
+def gather(ctx, ins, attrs):
+    x, index = one(ins, "X"), one(ins, "Index")
+    if index.ndim >= 2 and index.shape[-1] == 1:
+        index = jnp.squeeze(index, -1)
+    return {"Out": jnp.take(x, index, axis=0)}
+
+
+@register_op("scatter", no_grad=("Ids",), ref="paddle/fluid/operators/scatter_op.cc")
+def scatter(ctx, ins, attrs):
+    x, ids, updates = one(ins, "X"), one(ins, "Ids"), one(ins, "Updates")
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    return {"Out": x.at[ids].set(updates)}
+
+
+@register_op("multiplex", no_grad=("Ids",),
+             ref="paddle/fluid/operators/multiplex_op.cc")
+def multiplex(ctx, ins, attrs):
+    ids = one(ins, "Ids")
+    xs = jnp.stack(many(ins, "X"), axis=0)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    return {"Out": jnp.take_along_axis(
+        xs, ids[None, :, None].astype(jnp.int32), axis=0)[0]}
+
+
+@register_op("label_smooth", ref="paddle/fluid/operators/label_smooth_op.cc")
+def label_smooth(ctx, ins, attrs):
+    x = one(ins, "X")
+    eps = float(attrs.get("epsilon", 0.0))
+    dist = one(ins, "PriorDist")
+    k = x.shape[-1]
+    if dist is not None:
+        return {"Out": (1 - eps) * x + eps * dist}
+    return {"Out": (1 - eps) * x + eps / k}
+
+
+@register_op("is_empty", ref="paddle/fluid/operators/is_empty_op.cc")
+def is_empty(ctx, ins, attrs):
+    x = one(ins, "X")
+    return {"Out": jnp.asarray(x.size == 0)}
+
+
+@register_op("arg_max", no_grad=("X",), ref="paddle/fluid/operators/arg_minmax (era: argmax via top_k)")
+def arg_max(ctx, ins, attrs):
+    return {"Out": jnp.argmax(one(ins, "X"), axis=int(attrs.get("axis", 0))).astype(jnp.int64)}
+
+
+@register_op("arg_min", no_grad=("X",), ref="paddle/fluid/operators/arg_minmax (era: argmin via top_k)")
+def arg_min(ctx, ins, attrs):
+    return {"Out": jnp.argmin(one(ins, "X"), axis=int(attrs.get("axis", 0))).astype(jnp.int64)}
+
+
+@register_op("sequence_mask", no_grad=("X",),
+             ref="paddle/fluid/operators/sequence_ops (era: created for padding)")
+def sequence_mask(ctx, ins, attrs):
+    x = one(ins, "X")
+    maxlen = int(attrs.get("maxlen", -1))
+    if maxlen < 0:
+        # XLA needs static shapes; the reference derives maxlen = max(lengths)
+        # at runtime, which has no static-shape equivalent
+        raise ValueError("sequence_mask requires a static `maxlen` attr on TPU")
+    dtype = dtype_of(attrs, "out_dtype", "int64")
+    rng = jnp.arange(maxlen)
+    return {"Y": (rng[None, :] < x[:, None]).astype(dtype)}

@@ -1,0 +1,143 @@
+"""ParallelExecutor — multi-chip data-parallel training.
+
+Capability-parity with the reference ParallelExecutor
+(`paddle/fluid/framework/parallel_executor.cc:50`,
+`python/paddle/fluid/parallel_executor.py:23`), redesigned for XLA SPMD:
+
+  - The reference replicates the op graph per GPU, seeds 1/N loss grads, and
+    inserts NCCLAllReduceOpHandle per param-grad into a threaded SSA dataflow
+    graph (multi_devices_graph_builder.cc:167).
+  - Here the SAME lowered block function is jit-compiled with
+    jax.sharding: feed arrays are sharded on the batch axis of a device
+    Mesh, persistable state is replicated, and XLA's SPMD partitioner
+    inserts the ICI all-reduces where the gradient computation crosses the
+    sharded batch dimension. The dataflow overlap the reference got from
+    threads, XLA gets from async collectives in one program.
+
+API preserved: ParallelExecutor(use_cuda, loss_name).run(fetch_list, feed).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import core
+from .executor import Scope, _block_io, _lower, _step_counter, global_scope
+from .framework import Program, Variable, default_main_program
+
+
+def _as_name(v) -> str:
+    return v.name if isinstance(v, Variable) else str(v)
+
+
+class ParallelExecutor:
+    def __init__(
+        self,
+        use_cuda: Optional[bool] = None,
+        loss_name: Optional[str] = None,
+        main_program: Optional[Program] = None,
+        num_threads: Optional[int] = None,
+        allow_op_delay: bool = False,
+        share_vars_from: Optional["ParallelExecutor"] = None,
+        devices: Optional[Sequence[Any]] = None,
+        use_tpu: Optional[bool] = None,
+    ):
+        self._program = main_program or default_main_program()
+        self._loss_name = loss_name
+        devs = list(devices) if devices is not None else jax.devices()
+        self._mesh = Mesh(np.asarray(devs), ("dp",))
+        self._scope = (
+            share_vars_from._scope if share_vars_from is not None else global_scope()
+        )
+        self._cache: Dict[Any, Any] = {}
+
+    @property
+    def device_count(self) -> int:
+        return self._mesh.devices.size
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy: bool = True):
+        feed = feed if feed is not None else feed_dict
+        feed = feed or {}
+        if isinstance(feed, (list, tuple)):
+            # reference accepts per-device feed dicts; concat on batch dim
+            merged: Dict[str, Any] = {}
+            for d in feed:
+                for k, v in d.items():
+                    merged.setdefault(k, []).append(np.asarray(v))
+            feed = {k: np.concatenate(v, axis=0) for k, v in merged.items()}
+
+        program = self._program
+        block = program.global_block()
+        fetch_names = tuple(_as_name(v) for v in fetch_list)
+        mesh = self._mesh
+
+        feed_arrays = {}
+        for k, v in feed.items():
+            arr = np.asarray(v)
+            if arr.shape and arr.shape[0] % mesh.devices.size == 0:
+                sharding = NamedSharding(mesh, P("dp", *([None] * (arr.ndim - 1))))
+            else:
+                sharding = NamedSharding(mesh, P(*([None] * arr.ndim)))
+            feed_arrays[k] = jax.device_put(arr, sharding)
+
+        feed_sig = tuple(
+            sorted((k, tuple(v.shape), str(v.dtype)) for k, v in feed_arrays.items())
+        )
+        cache_key = (id(program), program._version, feed_sig, fetch_names)
+        entry = self._cache.get(cache_key)
+        if entry is None:
+            state_in, state_out = _block_io(block, set(feed_arrays), self._scope)
+            missing = [n for n in state_in if not self._scope.has_var(n)]
+            if missing:
+                raise RuntimeError(
+                    f"vars {missing} not initialized — run the startup program "
+                    "with a plain Executor first"
+                )
+            fn, ro_names, rw_names = _lower(
+                block, tuple(feed_arrays), fetch_names, tuple(state_in),
+                tuple(state_out),
+            )
+            replicated = NamedSharding(mesh, P())
+            jfn = jax.jit(
+                fn,
+                donate_argnums=(2,),
+                out_shardings=(None, replicated),
+            )
+            entry = (jfn, ro_names, rw_names, tuple(state_out))
+            self._cache[cache_key] = entry
+
+        jfn, ro_names, rw_names, state_out = entry
+        replicated = NamedSharding(mesh, P())
+
+        def _rep(x):
+            x = jnp.asarray(x)
+            if not isinstance(getattr(x, "sharding", None), NamedSharding) or \
+               x.sharding.mesh != mesh:
+                return jax.device_put(x, NamedSharding(mesh, P(*([None] * x.ndim))))
+            return x
+
+        state_ro = {n: _rep(self._scope.find_var(n)) for n in ro_names}
+        state_rw = {n: _rep(self._scope.find_var(n)) for n in rw_names}
+        key = jax.random.key(program.random_seed + _step_counter.next())
+        fetches, new_state = jfn(feed_arrays, state_ro, state_rw, key)
+        for n, v in new_state.items():
+            self._scope.set_var(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def bcast_params(self):
+        """Parity with reference bcast_params (parallel_executor.py:149):
+        re-replicate scope params over the mesh."""
+        mesh = self._mesh
+        for name in list(self._scope.var_names()):
+            v = self._scope.find_var(name)
+            arr = jnp.asarray(v)
+            self._scope.set_var(
+                name,
+                jax.device_put(arr, NamedSharding(mesh, P(*([None] * arr.ndim)))),
+            )

@@ -1,0 +1,101 @@
+"""Profiler (reference python/paddle/fluid/profiler.py + platform/profiler.cc):
+host RecordEvent table + TPU trace export.
+
+The reference aggregates per-op host/CUDA timings into a table and exports
+chrome://tracing JSON via CUPTI (device_tracer.cc, tools/timeline.py). Under
+XLA the per-op boundary is fused away, so the equivalents are:
+  - RecordEvent/profiler(): host-side named spans, aggregated table output
+  - jax.profiler traces (xplane) for device timelines, viewable in
+    TensorBoard/Perfetto — the chrome-trace role.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Optional
+
+import jax
+
+_events = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])  # calls,total,min,max
+_enabled = False
+
+
+class RecordEvent:
+    """RAII span (reference platform/profiler.h:73)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if not _enabled:
+            return False
+        dt = (time.perf_counter() - self._t0) * 1000.0
+        rec = _events[self.name]
+        rec[0] += 1
+        rec[1] += dt
+        rec[2] = min(rec[2], dt)
+        rec[3] = max(rec[3], dt)
+        return False
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def _print_table(sorted_key: Optional[str]):
+    rows = [
+        (name, c, total, total / max(c, 1), mn, mx)
+        for name, (c, total, mn, mx) in _events.items()
+    ]
+    key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(
+        sorted_key or "total", 2
+    )
+    rows.sort(key=lambda r: r[key_idx], reverse=True)
+    print(f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Ave(ms)':>10}"
+          f"{'Min(ms)':>10}{'Max(ms)':>10}")
+    for name, c, total, ave, mn, mx in rows:
+        print(f"{name:<40}{c:>8}{total:>12.3f}{ave:>10.3f}{mn:>10.3f}{mx:>10.3f}")
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: Optional[str] = None):
+    """reference fluid/profiler.py:76. With profile_path, also captures a
+    jax.profiler device trace (xplane) into that directory."""
+    global _enabled
+    _enabled = True
+    reset_profiler()
+    trace_ctx = (
+        jax.profiler.trace(profile_path) if profile_path else contextlib.nullcontext()
+    )
+    with trace_ctx:
+        try:
+            yield
+        finally:
+            _enabled = False
+            _print_table(sorted_key)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """Name kept for reference API parity (fluid/profiler.py:33); maps to a
+    device trace under JAX."""
+    with jax.profiler.trace(output_file or "/tmp/paddle_tpu_trace"):
+        yield
+
+
+def start_profiler(state: str = "All"):
+    global _enabled
+    _enabled = True
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    global _enabled
+    _enabled = False
+    _print_table(sorted_key)

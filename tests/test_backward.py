@@ -1,0 +1,80 @@
+"""append_backward vs numeric gradients (reference backward.py tests +
+the op_test.py numeric-grad idea at program level)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.backward import append_backward
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def _numeric_grad(run_loss, x0, eps=1e-3):
+    g = np.zeros_like(x0)
+    it = np.nditer(x0, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x0.copy()
+        xp[idx] += eps
+        xm = x0.copy()
+        xm[idx] -= eps
+        g[idx] = (run_loss(xp) - run_loss(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_fc_grad_matches_numeric():
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[3], dtype="float32")
+            y = layers.fc(input=x, size=2, act="tanh",
+                          param_attr=fluid.ParamAttr(name="fcw"),
+                          bias_attr=fluid.ParamAttr(name="fcb"))
+            loss = layers.mean(y)
+            params_grads = append_backward(loss)
+        grad_map = {p.name: g for p, g in params_grads}
+        assert "fcw" in grad_map and "fcb" in grad_map
+
+        exe = fluid.Executor()
+        exe.run(startup)
+        a = np.random.RandomState(0).rand(4, 3).astype(np.float32)
+
+        g_w = exe.run(main, feed={"x": a}, fetch_list=[grad_map["fcw"]])[0]
+        w0 = np.asarray(scope.find_var("fcw"))
+        b0 = np.asarray(scope.find_var("fcb"))
+
+        def run_loss(w):
+            return np.mean(np.tanh(a @ w + b0))
+
+        g_num = _numeric_grad(run_loss, w0.astype(np.float64)).astype(np.float32)
+        np.testing.assert_allclose(g_w, g_num, rtol=1e-2, atol=1e-3)
+
+
+def test_grad_accumulation_shared_input():
+    # x used by two branches -> grads must sum
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            w = layers.create_parameter(shape=[4], dtype="float32", name="wacc")
+            y1 = layers.scale(w, scale=2.0)
+            y2 = layers.scale(w, scale=3.0)
+            s = layers.elementwise_add(y1, y2)
+            loss = layers.mean(s)
+            params_grads = append_backward(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        (g,) = exe.run(main, fetch_list=[params_grads[0][1]])
+        np.testing.assert_allclose(g, np.full(4, 5.0 / 4), rtol=1e-5)
+
+
+def test_stop_gradient_blocks_path():
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            w = layers.create_parameter(shape=[4], dtype="float32", name="wsg")
+            y = layers.scale(w, scale=2.0)
+            y.stop_gradient = True
+            z = layers.scale(y, scale=3.0)
+            loss = layers.mean(z)
+            params_grads = append_backward(loss)
+        assert params_grads == []

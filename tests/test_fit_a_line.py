@@ -1,0 +1,61 @@
+"""End-to-end fit_a_line (reference tests/book/test_fit_a_line.py:25-70):
+full train loop, assert loss decreases, save + reload inference model."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def test_fit_a_line():
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[13], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="float32")
+            y_predict = layers.fc(input=x, size=1, act=None)
+            cost = layers.square_error_cost(input=y_predict, label=y)
+            avg_cost = layers.mean(cost)
+            opt = fluid.optimizer.SGD(learning_rate=0.01)
+            opt.minimize(avg_cost)
+
+        train_reader = paddle_tpu.batch(
+            paddle_tpu.reader.shuffle(
+                paddle_tpu.dataset.uci_housing.train(), buf_size=500
+            ),
+            batch_size=20,
+        )
+        feeder = fluid.DataFeeder(place=fluid.CPUPlace(), feed_list=[x, y])
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+
+        losses = []
+        for epoch in range(12):
+            for data in train_reader():
+                (loss,) = exe.run(
+                    main, feed=feeder.feed(data), fetch_list=[avg_cost]
+                )
+                losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        assert np.isfinite(losses[-1])
+
+        # save + reload inference model, check same prediction
+        with tempfile.TemporaryDirectory() as tmp:
+            fluid.save_inference_model(tmp, ["x"], [y_predict], exe, main)
+            test_x = np.random.RandomState(1).rand(7, 13).astype(np.float32)
+            (ref_out,) = exe.run(
+                main, feed={"x": test_x, "y": np.zeros((7, 1), np.float32)},
+                fetch_list=[y_predict],
+            )
+            scope2 = fluid.Scope()
+            with fluid.scope_guard(scope2):
+                exe2 = fluid.Executor(fluid.CPUPlace())
+                prog2, feeds, fetches = fluid.load_inference_model(tmp, exe2)
+                (out2,) = exe2.run(
+                    prog2, feed={feeds[0]: test_x}, fetch_list=fetches
+                )
+            np.testing.assert_allclose(ref_out, out2, rtol=1e-5)

@@ -1,0 +1,69 @@
+"""Program/Block/Operator construction + serialization round-trip
+(reference tests: test_program.py, test_protobuf_descs.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def test_program_construction():
+    main = Program()
+    startup = Program()
+    with program_guard(main, startup):
+        x = layers.data(name="x", shape=[13], dtype="float32")
+        y = layers.fc(input=x, size=4, act="relu")
+        assert y.shape == (-1, 4)
+        out = layers.fc(input=y, size=1)
+        assert out.shape == (-1, 1)
+    block = main.global_block()
+    op_types = [op.type for op in block.ops]
+    assert "mul" in op_types
+    assert "elementwise_add" in op_types
+    assert "relu" in op_types
+    # params created in both programs
+    params = block.all_parameters()
+    assert len(params) == 4  # 2x weight + 2x bias
+    startup_types = [op.type for op in startup.global_block().ops]
+    assert "uniform_random" in startup_types  # xavier default
+    assert "fill_constant" in startup_types  # bias init
+
+
+def test_program_serialization_roundtrip():
+    main = Program()
+    startup = Program()
+    with program_guard(main, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.fc(input=x, size=2)
+    data = main.to_bytes()
+    clone = Program.parse_from_bytes(data)
+    assert clone.to_bytes() == data
+    assert [op.type for op in clone.global_block().ops] == [
+        op.type for op in main.global_block().ops
+    ]
+
+
+def test_clone_for_test_flips_is_test():
+    main = Program()
+    startup = Program()
+    with program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        d = layers.dropout(x, dropout_prob=0.5)
+    test_prog = main.clone(for_test=True)
+    drop_ops = [op for op in test_prog.global_block().ops if op.type == "dropout"]
+    assert drop_ops and drop_ops[0].attrs["is_test"] is True
+    # original untouched
+    drop_ops = [op for op in main.global_block().ops if op.type == "dropout"]
+    assert drop_ops[0].attrs["is_test"] is False
+
+
+def test_variable_shape_inference_conv():
+    main = Program()
+    startup = Program()
+    with program_guard(main, startup):
+        img = layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+        c = layers.conv2d(input=img, num_filters=8, filter_size=3, padding=1)
+        assert c.shape == (-1, 8, 32, 32)
+        p = layers.pool2d(input=c, pool_size=2, pool_stride=2)
+        assert p.shape == (-1, 8, 16, 16)
