@@ -1,0 +1,89 @@
+"""Benchmark driver contract: prints ONE JSON line
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+North-star metric (BASELINE.json): ResNet-50 ImageNet images/sec/chip on the
+reference's benchmark/fluid workload (resnet.py bs=32, momentum), run here on
+one TPU chip. Baseline denominator: V100-class fluid-era ResNet-50 throughput
+(~300 imgs/s fp32, bs=32) — the reference tree itself only commits CPU numbers
+(ResNet-50 81.69 imgs/s on Xeon 6148, BASELINE.md), so vs_baseline > 1.0 means
+faster than a V100 would have been.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+V100_BASELINE_IMGS_PER_SEC = 300.0
+
+BATCH = int(os.environ.get("BENCH_BATCH", "32"))
+ITERS = int(os.environ.get("BENCH_ITERS", "30"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
+
+
+def main():
+    import jax
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.flags import set_flags
+    from paddle_tpu.fluid.framework import Program, program_guard
+    from paddle_tpu.models import resnet
+
+    # bf16 matmul/conv on the MXU (f32 params/master weights), the standard
+    # TPU training configuration; numerics-sensitive paths keep f32 via dtypes
+    set_flags({"matmul_precision": "default"})
+
+    main_prog, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main_prog, startup):
+            img = layers.data(name="img", shape=[3, 224, 224], dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            avg_cost, acc, _ = resnet.build_train(
+                img, label, class_dim=1000, depth=50
+            )
+            fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(
+                avg_cost
+            )
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        # device-resident synthetic batch (the reference benchmark's
+        # --use_fake_data mode, resnet.py:44) — measures the training step,
+        # not the host->device tunnel
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(BATCH, 3, 224, 224).astype(np.float32))
+        y = jnp.asarray(rng.randint(0, 1000, size=(BATCH, 1)).astype(np.int64))
+        jax.block_until_ready(x)
+        feed = {"img": x, "label": y}
+        a_param = main_prog.global_block().all_parameters()[0].name
+
+        for _ in range(WARMUP):
+            exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                    return_numpy=False)
+        jax.block_until_ready(scope.find_var(a_param))
+
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            out = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                          return_numpy=False)
+        # force the full dependency chain incl. the last step's param update
+        jax.block_until_ready(scope.find_var(a_param))
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+
+        imgs_per_sec = BATCH * ITERS / dt
+        print(json.dumps({
+            "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+            "value": round(imgs_per_sec, 2),
+            "unit": "images/sec",
+            "vs_baseline": round(imgs_per_sec / V100_BASELINE_IMGS_PER_SEC, 3),
+        }))
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

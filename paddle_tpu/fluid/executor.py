@@ -226,8 +226,7 @@ class Executor:
         jfn, ro_names, rw_names, state_out = entry
         state_ro = {n: scope.find_var(n) for n in ro_names}
         state_rw = {n: scope.find_var(n) for n in rw_names}
-        seed = program.random_seed or 0
-        key = jax.random.key(seed + _step_counter.next())
+        key = _next_key(program)
         import time as _time
 
         t0 = _time.perf_counter() if FLAGS["benchmark"] else 0.0
@@ -261,3 +260,26 @@ class _StepCounter:
 
 
 _step_counter = _StepCounter()
+
+
+def _next_key(program: Program):
+    """Per-run RNG key. A seeded program is fully deterministic (its own run
+    counter); seed 0 draws from a process-global counter (reference: seed 0 =
+    fresh randomness each run).
+
+    The root key is salted with a content hash of the program so that two
+    *different* programs sharing one random_seed (e.g. startup + main, whose
+    op-seed counters both start at 1) draw from independent streams, while
+    two identical builds still match bit-for-bit."""
+    if program.random_seed:
+        import zlib
+
+        if getattr(program, "_rng_salt_version", None) != program._version:
+            program._rng_salt = zlib.crc32(program.to_bytes())
+            program._rng_salt_version = program._version
+        program._rng_tick += 1
+        root = jax.random.fold_in(
+            jax.random.key(program.random_seed), program._rng_salt
+        )
+        return jax.random.fold_in(root, program._rng_tick)
+    return jax.random.key(_step_counter.next())

@@ -128,8 +128,6 @@ class Operator:
     """Appends an OpDesc and runs emitter-based shape inference
     (reference framework.py:361)."""
 
-    _rng_seed_counter = [0]
-
     def __init__(
         self,
         block: "Block",
@@ -145,8 +143,10 @@ class Operator:
 
         info = OPS.get(type)
         if info is not None and info.needs_rng and RNG_SEED_ATTR not in attrs:
-            Operator._rng_seed_counter[0] += 1
-            attrs[RNG_SEED_ATTR] = Operator._rng_seed_counter[0]
+            # per-program counter so two identical graph builds draw identical
+            # randomness under the same program.random_seed
+            block.program._op_seed_counter += 1
+            attrs[RNG_SEED_ATTR] = block.program._op_seed_counter
 
         self.desc = OpDesc(type=type, inputs=in_names, outputs=out_names, attrs=attrs)
         if info is not None:
@@ -338,6 +338,8 @@ class Program:
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
         self.random_seed = 0
+        self._rng_tick = 0  # per-program run counter for seeded determinism
+        self._op_seed_counter = 0  # per-program op seed assignment
         self._version = 0  # bumped on any mutation; keys executor jit cache
         self._op_role_var: List[str] = []
 
@@ -444,6 +446,11 @@ def _rebuild_from_desc(desc: ProgramDesc) -> Program:
             op.desc = copy.deepcopy(od)
             blk.ops.append(op)
             blk._note_producers(op)
+            # keep the per-program op-seed counter ahead of any seeds carried
+            # in the descs, so ops appended post-clone get fresh seeds
+            carried = od.attrs.get(RNG_SEED_ATTR)
+            if carried is not None:
+                prog._op_seed_counter = max(prog._op_seed_counter, int(carried))
     if not prog.blocks:
         prog.blocks = [Block(prog, 0)]
     return prog

@@ -26,7 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import core
-from .executor import Scope, _block_io, _lower, _step_counter, global_scope
+from .executor import Scope, _block_io, _lower, _next_key, global_scope
 from .framework import Program, Variable, default_main_program
 
 
@@ -45,11 +45,19 @@ class ParallelExecutor:
         share_vars_from: Optional["ParallelExecutor"] = None,
         devices: Optional[Sequence[Any]] = None,
         use_tpu: Optional[bool] = None,
+        mesh: Optional[Mesh] = None,
+        sharding_plan=None,
     ):
+        from ..parallel import ShardingPlan
+
         self._program = main_program or default_main_program()
         self._loss_name = loss_name
-        devs = list(devices) if devices is not None else jax.devices()
-        self._mesh = Mesh(np.asarray(devs), ("dp",))
+        if mesh is not None:
+            self._mesh = mesh
+        else:
+            devs = list(devices) if devices is not None else jax.devices()
+            self._mesh = Mesh(np.asarray(devs), ("dp",))
+        self._plan = sharding_plan or ShardingPlan(batch_axis=self._mesh.axis_names[0])
         self._scope = (
             share_vars_from._scope if share_vars_from is not None else global_scope()
         )
@@ -75,11 +83,13 @@ class ParallelExecutor:
         fetch_names = tuple(_as_name(v) for v in fetch_list)
         mesh = self._mesh
 
+        batch_ax = self._plan.batch_axis
+        dp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(batch_ax, 1)
         feed_arrays = {}
         for k, v in feed.items():
             arr = np.asarray(v)
-            if arr.shape and arr.shape[0] % mesh.devices.size == 0:
-                sharding = NamedSharding(mesh, P("dp", *([None] * (arr.ndim - 1))))
+            if arr.shape and batch_ax and arr.shape[0] % dp_size == 0:
+                sharding = NamedSharding(mesh, self._plan.feed_spec(arr.ndim))
             else:
                 sharding = NamedSharding(mesh, P(*([None] * arr.ndim)))
             feed_arrays[k] = jax.device_put(arr, sharding)
@@ -101,28 +111,34 @@ class ParallelExecutor:
                 block, tuple(feed_arrays), fetch_names, tuple(state_in),
                 tuple(state_out),
             )
-            replicated = NamedSharding(mesh, P())
+            out_state_shardings = {
+                n: NamedSharding(
+                    mesh,
+                    self._plan.spec_for(n, np.ndim(self._scope.find_var(n))),
+                )
+                for n in state_out
+            }
             jfn = jax.jit(
                 fn,
                 donate_argnums=(2,),
-                out_shardings=(None, replicated),
+                out_shardings=(None, out_state_shardings),
             )
             entry = (jfn, ro_names, rw_names, tuple(state_out))
             self._cache[cache_key] = entry
 
         jfn, ro_names, rw_names, state_out = entry
-        replicated = NamedSharding(mesh, P())
 
-        def _rep(x):
+        def _place(name, x):
             x = jnp.asarray(x)
-            if not isinstance(getattr(x, "sharding", None), NamedSharding) or \
-               x.sharding.mesh != mesh:
-                return jax.device_put(x, NamedSharding(mesh, P(*([None] * x.ndim))))
-            return x
+            spec = self._plan.spec_for(name, x.ndim)
+            target = NamedSharding(mesh, spec)
+            if getattr(x, "sharding", None) == target:
+                return x
+            return jax.device_put(x, target)
 
-        state_ro = {n: _rep(self._scope.find_var(n)) for n in ro_names}
-        state_rw = {n: _rep(self._scope.find_var(n)) for n in rw_names}
-        key = jax.random.key(program.random_seed + _step_counter.next())
+        state_ro = {n: _place(n, self._scope.find_var(n)) for n in ro_names}
+        state_rw = {n: _place(n, self._scope.find_var(n)) for n in rw_names}
+        key = _next_key(program)
         fetches, new_state = jfn(feed_arrays, state_ro, state_rw, key)
         for n, v in new_state.items():
             self._scope.set_var(n, v)

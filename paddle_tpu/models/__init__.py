@@ -1,0 +1,4 @@
+"""Model zoo — the workloads the reference benchmarks/book tests run
+(reference benchmark/fluid/{mnist,resnet,vgg,stacked_dynamic_lstm,
+machine_translation}.py), built on the paddle_tpu.fluid layer API."""
+from . import lenet, resnet, transformer, vgg  # noqa: F401

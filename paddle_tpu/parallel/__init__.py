@@ -1,0 +1,13 @@
+"""Parallelism toolkit: meshes, sharding plans, collective ops.
+
+TPU-native replacement for the reference's distributed stack (SURVEY.md
+§2.10): where the reference inserts NCCL op-handles / gRPC send-recv into the
+program, here parallelism is expressed as jax.sharding specs over a device
+Mesh and XLA's SPMD partitioner inserts the ICI collectives.
+"""
+from .api import (  # noqa: F401
+    ShardingPlan,
+    make_mesh,
+    plan_data_parallel,
+    plan_transformer_tp,
+)

@@ -1,0 +1,83 @@
+"""Sharding plans: the TPU-native analog of the reference's
+distribute_transpiler (python/paddle/fluid/distribute_transpiler.py:136) —
+instead of rewriting the program into trainer+pserver halves, a plan maps
+var names to PartitionSpecs over a named Mesh; the same lowered block runs
+SPMD with XLA-inserted collectives.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Build a named mesh, e.g. make_mesh({'dp': 2, 'tp': 4}).
+    Axis sizes must multiply to the device count."""
+    devs = list(devices) if devices is not None else jax.devices()
+    shape = tuple(axes.values())
+    if int(np.prod(shape)) != len(devs):
+        raise ValueError(
+            f"mesh {axes} needs {int(np.prod(shape))} devices, have {len(devs)}"
+        )
+    return Mesh(np.asarray(devs).reshape(shape), tuple(axes.keys()))
+
+
+class ShardingPlan:
+    """Maps var-name patterns (regex) -> PartitionSpec. First match wins;
+    unmatched vars are replicated."""
+
+    def __init__(self, rules: Sequence[Tuple[str, P]] = (),
+                 batch_axis: Optional[str] = "dp"):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.batch_axis = batch_axis
+
+    def add(self, pattern: str, spec: P) -> "ShardingPlan":
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name: str, ndim: int) -> P:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                if len(spec) > ndim:
+                    # rules intentionally also match optimizer accumulators
+                    # derived from a param name; scalar accumulators
+                    # (beta pows, lr) can't take the param's spec — replicate
+                    return P()
+                return spec
+        return P()
+
+    def feed_spec(self, ndim: int) -> P:
+        if self.batch_axis is None or ndim == 0:
+            return P()
+        return P(self.batch_axis, *([None] * (ndim - 1)))
+
+
+def plan_data_parallel() -> ShardingPlan:
+    """Pure DP: feeds sharded on batch, all state replicated — what the
+    reference ParallelExecutor's NCCL all-reduce graph computes."""
+    return ShardingPlan(batch_axis="dp")
+
+
+def plan_transformer_tp() -> ShardingPlan:
+    """Megatron-style tensor parallel for models/transformer.py: attention
+    q/k/v and ffn first matmul shard on the output (head) axis, attention
+    out-proj and ffn second matmul shard on the input axis, embeddings shard
+    on vocab; XLA inserts the all-reduces at the row-parallel boundaries."""
+    # the `(_\w+)?$` tails also catch optimizer accumulators derived from the
+    # param name (e.g. "enc0.self.q.w_moment1_0"), keeping Adam moments
+    # sharded alongside their params
+    return ShardingPlan(
+        rules=[
+            (r"\.(q|k|v)\.w(_\w+)?$", P(None, "tp")),
+            (r"\.ff1\.w(_\w+)?$", P(None, "tp")),
+            (r"\.out\.w(_\w+)?$", P("tp", None)),
+            (r"\.ff2\.w(_\w+)?$", P("tp", None)),
+            (r"\.emb(_\w+)?$", P("tp", None)),
+            (r"^proj\.w(_\w+)?$", P(None, "tp")),
+        ],
+        batch_axis="dp",
+    )

@@ -1,6 +1,11 @@
 """Test harness config: run everything on a virtual 8-device CPU mesh so
 multi-chip sharding paths are exercised without TPU hardware (the driver
-separately compile-checks the TPU path via __graft_entry__)."""
+separately compile-checks the TPU path via __graft_entry__).
+
+Note: this environment's sitecustomize registers the `axon` TPU backend in
+every process and env-var platform selection is unreliable — force CPU via
+jax.config before any backend initialization.
+"""
 import os
 
 flags = os.environ.get("XLA_FLAGS", "")
@@ -9,3 +14,9 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+assert len(jax.devices()) == 8, jax.devices()

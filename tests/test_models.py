@@ -1,0 +1,101 @@
+"""Model zoo smoke + convergence tests (reference book/benchmark configs:
+recognize_digits LeNet, resnet, transformer)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+from paddle_tpu.models import lenet, resnet, transformer
+
+
+def test_lenet_mnist_converges():
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            img = layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            avg_cost, acc, pred = lenet.build(img, label)
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        losses = []
+        for step in range(30):
+            x = rng.rand(16, 1, 28, 28).astype(np.float32)
+            y = rng.randint(0, 10, size=(16, 1)).astype(np.int64)
+            # plant signal: brighten a label-dependent row block
+            for i in range(16):
+                x[i, 0, y[i, 0] * 2:(y[i, 0] * 2 + 3)] += 2.0
+            loss, a = exe.run(main, feed={"img": x, "label": y},
+                              fetch_list=[avg_cost, acc])
+            losses.append(float(loss[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+
+def test_resnet_cifar_smoke():
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            img = layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            avg_cost, acc, pred = resnet.build_train(
+                img, label, class_dim=10, depth=8, variant="cifar10"
+            )
+            fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(
+                avg_cost
+            )
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        for step in range(2):
+            x = rng.rand(4, 3, 32, 32).astype(np.float32)
+            y = rng.randint(0, 10, size=(4, 1)).astype(np.int64)
+            (loss,) = exe.run(main, feed={"img": x, "label": y},
+                              fetch_list=[avg_cost])
+            assert np.isfinite(loss).all()
+        # BN stats must have moved off their init
+        bn_means = [n for n in scope.var_names() if "batch_norm" in n]
+        assert bn_means
+
+
+def test_resnet50_imagenet_builds():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        img = layers.data(name="img", shape=[3, 224, 224], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        avg_cost, acc, pred = resnet.build_train(img, label, class_dim=1000,
+                                                 depth=50)
+    n_params = len(main.global_block().all_parameters())
+    # 53 convs + 53 BN(scale+bias) + fc(w+b) = 161 trainable params
+    assert n_params == 161
+    assert pred.shape == (-1, 1000)
+
+
+def test_transformer_copy_task_converges():
+    cfg = transformer.TransformerConfig(
+        src_vocab=50, trg_vocab=50, max_len=8, d_model=32, n_heads=4,
+        d_ff=64, n_layers=1, dropout=0.0,
+    )
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            src = layers.data(name="src", shape=[cfg.max_len], dtype="int64")
+            trg = layers.data(name="trg", shape=[cfg.max_len], dtype="int64")
+            lbl = layers.data(name="lbl", shape=[cfg.max_len, 1], dtype="int64")
+            avg_cost, logits = transformer.build_train(cfg, src, trg, lbl)
+            fluid.optimizer.Adam(learning_rate=3e-3).minimize(avg_cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        s = rng.randint(3, 50, size=(16, cfg.max_len)).astype(np.int64)
+        t = np.concatenate([np.zeros((16, 1), np.int64), s[:, :-1]], axis=1)
+        losses = []
+        for step in range(60):
+            losses.append(float(exe.run(
+                main, feed={"src": s, "trg": t, "lbl": s[:, :, None]},
+                fetch_list=[avg_cost],
+            )[0][0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.5, losses[::10]

@@ -1,0 +1,104 @@
+"""ParallelExecutor over the virtual 8-device mesh (reference
+test_parallel_executor.py — MNIST fc :243, transformer :444)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+from paddle_tpu.models import transformer
+from paddle_tpu.parallel import make_mesh, plan_transformer_tp
+
+
+def test_pe_mlp_data_parallel_matches_single():
+    # same program, same init: PE over 8 devices must track single-device run
+    def build():
+        # unique_name.guard: identical names across rebuilds, so the seeded
+        # content-salted RNG reproduces the same init (reference test pattern)
+        from paddle_tpu.fluid import unique_name
+
+        main, startup = Program(), Program()
+        main.random_seed = 7
+        startup.random_seed = 7
+        with unique_name.guard(), program_guard(main, startup):
+            x = layers.data(name="x", shape=[16], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="float32")
+            h = layers.fc(input=x, size=32, act="relu",
+                          param_attr=fluid.ParamAttr(name="w1"),
+                          bias_attr=fluid.ParamAttr(name="b1"))
+            p = layers.fc(input=h, size=1,
+                          param_attr=fluid.ParamAttr(name="w2"),
+                          bias_attr=fluid.ParamAttr(name="b2"))
+            cost = layers.mean(layers.square_error_cost(input=p, label=y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        return main, startup, cost
+
+    rng = np.random.RandomState(0)
+    w = rng.rand(16, 1).astype(np.float32)
+    xs = rng.rand(5, 64, 16).astype(np.float32)
+    ys = np.einsum("bni,io->bno", xs, w).astype(np.float32)
+
+    # single-device
+    main1, startup1, cost1 = build()
+    scope1 = fluid.Scope()
+    with fluid.scope_guard(scope1):
+        exe = fluid.Executor()
+        exe.run(startup1)
+        single = [
+            float(exe.run(main1, feed={"x": xs[i], "y": ys[i]},
+                          fetch_list=[cost1])[0][0])
+            for i in range(5)
+        ]
+
+    # data-parallel over 8 devices
+    main2, startup2, cost2 = build()
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor()
+        exe.run(startup2)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=cost2.name,
+                                    main_program=main2)
+        par = [
+            float(pe.run(fetch_list=[cost2],
+                         feed={"x": xs[i], "y": ys[i]})[0])
+            for i in range(5)
+        ]
+    np.testing.assert_allclose(single, par, rtol=2e-3, atol=1e-5)
+
+
+def test_pe_transformer_tensor_parallel():
+    cfg = transformer.TransformerConfig(
+        src_vocab=40, trg_vocab=40, max_len=8, d_model=32, n_heads=4,
+        d_ff=64, n_layers=1, dropout=0.0,
+    )
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            src = layers.data(name="src", shape=[cfg.max_len], dtype="int64")
+            trg = layers.data(name="trg", shape=[cfg.max_len], dtype="int64")
+            lbl = layers.data(name="lbl", shape=[cfg.max_len, 1], dtype="int64")
+            avg_cost, _ = transformer.build_train(cfg, src, trg, lbl)
+            fluid.optimizer.Adam(learning_rate=3e-3).minimize(avg_cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+        mesh = make_mesh({"dp": 2, "tp": 4})
+        pe = fluid.ParallelExecutor(
+            loss_name=avg_cost.name, main_program=main, mesh=mesh,
+            sharding_plan=plan_transformer_tp(),
+        )
+        rng = np.random.RandomState(0)
+        losses = []
+        for step in range(10):
+            s = rng.randint(3, 40, size=(8, cfg.max_len)).astype(np.int64)
+            t = np.concatenate([np.zeros((8, 1), np.int64), s[:, :-1]], axis=1)
+            losses.append(float(pe.run(
+                fetch_list=[avg_cost],
+                feed={"src": s, "trg": t, "lbl": s[:, :, None]},
+            )[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+        # verify params really are sharded over tp
+        import jax
+
+        w = scope.find_var("enc0.self.q.w")
+        assert isinstance(w, jax.Array)
+        assert w.sharding.spec == jax.sharding.PartitionSpec(None, "tp")
