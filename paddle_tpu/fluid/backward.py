@@ -20,11 +20,14 @@ from . import core, unique_name
 from .framework import Parameter, Program, Variable, grad_var_name
 from .registry import FWD_META_ATTR, OPS
 
-# op types that never participate in differentiation
+# op types that never participate in differentiation. `while` is forward-only
+# under XLA (no reverse-mode through lax.while_loop); `recurrent` (StaticRNN)
+# IS differentiable and is absent from this set.
 _NON_DIFF_OPS = {
     "feed", "fetch", "fill_constant", "gaussian_random", "uniform_random",
     "sgd", "momentum", "adam", "adamax", "adagrad", "adadelta", "rmsprop",
     "decayed_adagrad", "ftrl", "increment", "assign_value",
+    "while", "conditional_block",
 }
 
 _FLOAT_DTYPES = {"float16", "bfloat16", "float32", "float64"}

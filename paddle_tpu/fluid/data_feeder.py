@@ -18,22 +18,38 @@ class DataToLoDTensorConverter:
         self.lod = [[0] for _ in range(lod_level)]
 
     def feed(self, data):
-        self._feed_impl_(data, self.lod, self.lod_level)
-
-    def _feed_impl_(self, data, lod, lod_level):
-        if lod_level == 0:
-            self.data.append(data)
-        else:
-            lod[0].append(lod[0][-1] + len(data))
-            for each_data in data:
-                self._feed_impl_(each_data, lod[1:], lod_level - 1)
+        # lod_level>0: keep the ragged sample whole; done() pads + lengths
+        self.data.append(data)
 
     def done(self):
-        arr = np.array(self.data, dtype=self.dtype)
-        shape = [d if d >= 0 else -1 for d in self.shape]
-        if self.lod_level == 0 and shape and any(d == -1 for d in shape):
-            arr = arr.reshape([arr.shape[0]] + [d for d in shape[1:]])
-        return arr
+        if self.lod_level == 0:
+            arr = np.array(self.data, dtype=self.dtype)
+            shape = [d if d >= 0 else -1 for d in self.shape]
+            if shape and any(d == -1 for d in shape[1:]):
+                arr = arr.reshape([arr.shape[0]] + [d for d in shape[1:]])
+            return arr
+        if self.lod_level > 1:
+            raise NotImplementedError(
+                "nested (lod_level>1) sequences: flatten or bucket upstream"
+            )
+        # ragged -> padded [N, T, ...] + lengths, T bucketed to a power of two
+        # to bound recompilations (XLA static shapes; SURVEY.md §5.7)
+        seqs = [np.asarray(s, dtype=self.dtype) for s in self.data]
+        lengths = np.asarray([len(s) for s in seqs], dtype=np.int32)
+        max_len = max(1, int(lengths.max()))
+        T = 8
+        while T < max_len:
+            T *= 2
+        item_shape = ()
+        for s in seqs:  # first non-empty sample defines the item shape
+            if len(s):
+                item_shape = s.shape[1:]
+                break
+        padded = np.zeros((len(seqs), T) + item_shape, dtype=self.dtype)
+        for i, s in enumerate(seqs):
+            if len(s):
+                padded[i, :len(s)] = s
+        return padded, lengths
 
 
 class DataFeeder:
@@ -70,7 +86,11 @@ class DataFeeder:
             )
             for each_converter, each_slot in zip(converters, each_sample):
                 each_converter.feed(each_slot)
-        return {
-            name: conv.done()
-            for name, conv in zip(self.feed_names, converters)
-        }
+        out = {}
+        for name, conv in zip(self.feed_names, converters):
+            res = conv.done()
+            if isinstance(res, tuple):
+                out[name], out[name + "@LEN"] = res
+            else:
+                out[name] = res
+        return out

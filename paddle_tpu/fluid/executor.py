@@ -29,7 +29,7 @@ import numpy as np
 from . import core
 from .flags import FLAGS
 from .framework import Program, Variable, default_main_program
-from .registry import OPS, EmitCtx, run_forward, run_grad
+from .registry import EmitCtx, exec_op_descs
 
 _SKIP_OP_TYPES = {"feed", "fetch"}
 
@@ -123,6 +123,7 @@ def _block_io(block, feed_names: set, scope: Scope):
 def _lower(block, feed_names: Tuple[str, ...], fetch_names: Tuple[str, ...],
            state_in: Tuple[str, ...], state_out: Tuple[str, ...]):
     """Build the pure function feed, state_ro, state_rw, key -> fetches, new_state."""
+    program = block.program
     ops = [op.desc for op in block.ops if op.desc.type not in _SKIP_OP_TYPES]
     ro_names = tuple(n for n in state_in if n not in state_out)
     rw_names = tuple(n for n in state_in if n in state_out)
@@ -137,23 +138,8 @@ def _lower(block, feed_names: Tuple[str, ...], fetch_names: Tuple[str, ...],
         env.update(state_ro)
         env.update(state_rw)
         env.update(feeds)
-        ctx = EmitCtx(root_key=key)
-        for od in ops:
-            ins = {
-                slot: [env.get(n) if n else None for n in names]
-                for slot, names in od.inputs.items()
-            }
-            if od.type.endswith("_grad") and "__fwd__" in od.attrs:
-                outs = run_grad(ctx, ins, od.attrs)
-            else:
-                outs = run_forward(ctx, od.type, ins, od.attrs)
-            for slot, names in od.outputs.items():
-                vals = outs.get(slot, [])
-                for i, n in enumerate(names):
-                    if not n:
-                        continue
-                    if i < len(vals) and vals[i] is not None:
-                        env[n] = vals[i]
+        ctx = EmitCtx(root_key=key, program=program)
+        exec_op_descs(ctx, ops, env)
         fetches = []
         for n in fetch_names:
             if n not in env:

@@ -1,7 +1,9 @@
 """Layer functions (reference python/paddle/fluid/layers/)."""
-from . import io, nn, ops, tensor  # noqa: F401
+from . import control_flow, io, nn, ops, sequence, tensor  # noqa: F401
+from .control_flow import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from . import math_op_patch  # noqa: F401  (monkey-patches Variable operators)

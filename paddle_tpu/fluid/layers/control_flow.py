@@ -1,0 +1,384 @@
+"""Graph-level control flow builders (reference
+python/paddle/fluid/layers/control_flow.py: StaticRNN:383, While:608,
+ConditionalBlock:1106, Switch:1163, array_write:889, array_read:1017,
+less_than:953, increment).
+
+TPU lowering: While -> lax.while_loop (forward-only), ConditionalBlock ->
+lax.cond, StaticRNN -> a `recurrent` op unrolled at trace time
+(differentiable). See ops/control_flow.py.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "While", "StaticRNN", "ConditionalBlock", "Switch", "increment",
+    "array_write", "array_read", "array_length", "create_array",
+    "less_than", "equal", "zeros_like_array",
+]
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="increment", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"step": float(value)},
+    )
+    return out
+
+
+def less_than(x, y, cond=None):
+    helper = LayerHelper("less_than")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool")
+        cond.stop_gradient = True
+    helper.append_op(
+        type="less_than", inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]},
+    )
+    return cond
+
+
+def equal(x, y, cond=None):
+    helper = LayerHelper("equal")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool")
+        cond.stop_gradient = True
+    helper.append_op(
+        type="equal", inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]},
+    )
+    return cond
+
+
+def create_array(dtype, size, item_shape):
+    """Preallocated tensor array [size, *item_shape] (XLA needs static
+    extents; the reference's LoDTensorArray grows dynamically)."""
+    from .tensor import fill_constant
+
+    return fill_constant(shape=[size] + list(item_shape), dtype=dtype, value=0.0)
+
+
+def array_write(x, i, array):
+    helper = LayerHelper("array_write")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="write_to_array",
+        inputs={"Array": [array], "X": [x], "I": [i]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(
+        type="read_from_array", inputs={"X": [array], "I": [i]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="array_length", inputs={"X": [array]}, outputs={"Out": [out]},
+    )
+    return out
+
+
+def zeros_like_array(x):
+    helper = LayerHelper("zeros_like")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="fill_zeros_like", inputs={"X": [x]}, outputs={"Out": [out]},
+    )
+    return out
+
+
+class BlockGuard:
+    def __init__(self, main_program):
+        self.main_program = main_program
+
+    def __enter__(self):
+        self.block = self.main_program.create_block()
+        return self.block
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.main_program.rollback()
+        return exc_type is None
+
+
+class While:
+    """reference control_flow.py:608. Usage:
+        cond = layers.less_than(i, n)
+        w = While(cond)
+        with w.block():
+            ...ops...  (must update `cond` for termination)
+    Forward-only under XLA (see ops/control_flow.py docstring)."""
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("while", name=name)
+        if cond.dtype != "bool":
+            raise TypeError("condition should be a bool variable")
+        self.cond_var = cond
+
+    @contextlib.contextmanager
+    def block(self):
+        main = self.helper.main_program
+        parent_block = main.current_block()
+        sub = main.create_block()
+        try:
+            yield
+        finally:
+            main.rollback()
+            # X = outer vars the block reads; Out = written vars with a
+            # pre-loop value (the emitter's loop carry)
+            read, written = set(), []
+            for op in sub.ops:
+                read.update(n for n in op.desc.input_names() if n)
+                for n in op.desc.output_names():
+                    if n and n not in written:
+                        written.append(n)
+            # X: outer vars the block touches (read OR written — write-only
+            # outer vars still need their pre-loop value as carry init)
+            touched = sorted(
+                n for n in (read | set(written))
+                if n not in sub.vars
+                and parent_block._var_recursive(n) is not None
+            )
+            carried = [n for n in written
+                       if n in touched or n == self.cond_var.name]
+            parent_block.append_op(
+                type="while",
+                inputs={"Condition": [self.cond_var], "X": touched},
+                outputs={"Out": carried},
+                attrs={
+                    "sub_block": sub.idx,
+                    "x_var_names": touched,
+                    "cond_var_name": self.cond_var.name,
+                    "out_var_names": carried,
+                },
+            )
+
+
+class ConditionalBlock:
+    """reference control_flow.py:1106."""
+
+    def __init__(self, inputs, is_scalar_condition=True, name=None):
+        self.helper = LayerHelper("conditional_block", name=name)
+        assert len(inputs) == 1, "one condition var"
+        self.cond_var = inputs[0]
+
+    @contextlib.contextmanager
+    def block(self):
+        main = self.helper.main_program
+        parent_block = main.current_block()
+        sub = main.create_block()
+        try:
+            yield
+        finally:
+            main.rollback()
+            read, written = set(), []
+            for op in sub.ops:
+                read.update(n for n in op.desc.input_names() if n)
+                for n in op.desc.output_names():
+                    if n and n not in written:
+                        written.append(n)
+            touched = sorted(
+                n for n in (read | set(written))
+                if n not in sub.vars
+                and parent_block._var_recursive(n) is not None
+            )
+            carried = [n for n in written if n in touched]
+            parent_block.append_op(
+                type="conditional_block",
+                inputs={"Condition": [self.cond_var], "X": touched},
+                outputs={"Out": carried},
+                attrs={
+                    "sub_block": sub.idx,
+                    "x_var_names": touched,
+                    "out_var_names": carried,
+                },
+            )
+
+
+class Switch:
+    """reference control_flow.py:1163 — chained conditional blocks."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.pre_not_conditions = []
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        from .ops import _make_unary  # noqa: F401  (module import side effect)
+        from ..layer_helper import LayerHelper
+
+        if self.pre_not_conditions:
+            helper = LayerHelper("logical_and")
+            combined = helper.create_variable_for_type_inference("bool")
+            helper.append_op(
+                type="logical_and",
+                inputs={"X": [self.pre_not_conditions[-1]],
+                        "Y": [condition]},
+                outputs={"Out": [combined]},
+            )
+            cond_to_use = combined
+        else:
+            cond_to_use = condition
+        helper = LayerHelper("logical_not")
+        not_cond = helper.create_variable_for_type_inference("bool")
+        helper.append_op(
+            type="logical_not", inputs={"X": [condition]},
+            outputs={"Out": [not_cond]},
+        )
+        if self.pre_not_conditions:
+            helper = LayerHelper("logical_and")
+            chained = helper.create_variable_for_type_inference("bool")
+            helper.append_op(
+                type="logical_and",
+                inputs={"X": [self.pre_not_conditions[-1]], "Y": [not_cond]},
+                outputs={"Out": [chained]},
+            )
+            not_cond = chained
+        self.pre_not_conditions.append(not_cond)
+        cb = ConditionalBlock([cond_to_use])
+        with cb.block():
+            yield
+
+    @contextlib.contextmanager
+    def default(self):
+        assert self.pre_not_conditions, "default() requires a prior case()"
+        cb = ConditionalBlock([self.pre_not_conditions[-1]])
+        with cb.block():
+            yield
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class StaticRNN:
+    """reference control_flow.py:383 — define one step; the `recurrent` op
+    unrolls it over axis 1 at lowering time (differentiable).
+
+        rnn = StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)          # x: [N, T, D] -> xt: [N, D]
+            h_prev = rnn.memory(init=h0)    # h0: [N, H]
+            h = layers.fc(input=[xt, h_prev], size=H, act='tanh')
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()                          # [N, T, H]
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("recurrent", name=name)
+        self._sub = None
+        self._parent = None
+        self.step_inputs = []   # (full_seq_var, step_var)
+        self.memories = []      # (pre_mem_var, mem_var_or_None, init_var)
+        self.outputs = []       # step-local output vars
+
+    @contextlib.contextmanager
+    def step(self):
+        main = self.helper.main_program
+        self._parent = main.current_block()
+        self._sub = main.create_block()
+        try:
+            yield
+        finally:
+            main.rollback()
+            self._complete()
+
+    def step_input(self, x):
+        sv = self._sub.create_var(
+            name=x.name + "@step", dtype=x.dtype,
+            shape=[x.shape[0]] + list(x.shape[2:]) if x.shape else None,
+        )
+        self.step_inputs.append((x, sv))
+        return sv
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32"):
+        if init is None:
+            raise ValueError("StaticRNN.memory requires an init var "
+                             "(create it with layers.fill_constant_batch_size_like)")
+        pre = self._sub.create_var(
+            name=init.name + "@pre_mem", dtype=init.dtype,
+            shape=list(init.shape) if init.shape else None,
+        )
+        self.memories.append([pre, None, init])
+        return pre
+
+    def update_memory(self, mem, var):
+        for m in self.memories:
+            if m[0] is mem:
+                m[1] = var
+                return
+        raise ValueError("update_memory: unknown memory var")
+
+    def step_output(self, o):
+        self.outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        assert all(m[1] is not None for m in self.memories), (
+            "every memory needs update_memory()"
+        )
+        if not self.step_inputs:
+            raise ValueError(
+                "StaticRNN needs at least one step_input — the trip count "
+                "is its time extent (axis 1)"
+            )
+        # params: outer vars read by step ops (weights/biases), excluding
+        # step-local vars — they become explicit op inputs so the generic
+        # vjp differentiates through the unrolled steps
+        step_locals = {sv.name for _, sv in self.step_inputs}
+        step_locals.update(m[0].name for m in self.memories)
+        read = set()
+        for op in self._sub.ops:
+            read.update(n for n in op.desc.input_names() if n)
+        params = sorted(
+            n for n in read
+            if n not in step_locals
+            and n not in self._sub.vars
+            and self._parent._var_recursive(n) is not None
+        )
+        self._out_vars = [
+            self._parent.create_var(
+                name=o.name + "@seq", dtype=o.dtype,
+                shape=[o.shape[0], -1] + list(o.shape[1:]) if o.shape else None,
+            )
+            for o in self.outputs
+        ]
+        self._parent.append_op(
+            type="recurrent",
+            inputs={
+                "StepInputs": [x for x, _ in self.step_inputs],
+                "MemInit": [m[2] for m in self.memories],
+                "Params": params,
+            },
+            outputs={"Out": self._out_vars},
+            attrs={
+                "sub_block": self._sub.idx,
+                "step_input_vars": [sv.name for _, sv in self.step_inputs],
+                "memory_links": [[m[0].name, m[1].name] for m in self.memories],
+                "step_output_vars": [o.name for o in self.outputs],
+                "param_var_names": params,
+            },
+        )
+
+    def __call__(self):
+        if len(self._out_vars) == 1:
+            return self._out_vars[0]
+        return self._out_vars

@@ -24,7 +24,7 @@ def data(
     block = helper.main_program.current_block()
     if block.has_var(name):
         return block.var(name)
-    return block.create_var(
+    var = block.create_var(
         name=name,
         shape=shape,
         dtype=dtype,
@@ -32,3 +32,13 @@ def data(
         stop_gradient=stop_gradient,
         persistable=False,
     )
+    if lod_level > 0:
+        # padded+lengths sequence representation (see layers/sequence.py):
+        # a ragged feed becomes [N, T, ...] plus an int32 lengths companion
+        if len(shape) < 2 or shape[1] != -1:
+            var.desc.shape = [shape[0], -1] + shape[1:]
+        block.create_var(
+            name=name + "@LEN", shape=[-1], dtype="int32",
+            stop_gradient=True, persistable=False,
+        )
+    return var

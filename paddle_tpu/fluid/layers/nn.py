@@ -60,7 +60,13 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
             type="sum", inputs={"X": mul_results}, outputs={"Out": [pre_bias]}
         )
     pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
-    return helper.append_activation(pre_act)
+    out = helper.append_activation(pre_act)
+    if num_flatten_dims >= 2:
+        # time axis survives the flatten -> still a sequence
+        from .sequence import _propagate_lengths
+
+        _propagate_lengths(inputs[0], out)
+    return out
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
@@ -78,6 +84,9 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
         outputs={"Out": [tmp]},
         attrs={"is_sparse": is_sparse, "padding_idx": padding_idx},
     )
+    from .sequence import _propagate_lengths
+
+    _propagate_lengths(input, tmp)
     return tmp
 
 

@@ -1,0 +1,230 @@
+"""Sequence layer functions — the reference's LoD-consuming layers
+(dynamic_lstm nn.py:277, dynamic_gru nn.py:609, sequence_pool, sequence_conv,
+sequence_expand, sequence_first_step/last_step) on the padded+lengths
+representation.
+
+Convention: a data var with lod_level > 0 is a padded dense tensor [N, T, ...]
+with a companion int32 lengths var named `<name>@LEN` (created by layers.data,
+fed by DataFeeder). Layers propagate the companion through sequence-preserving
+ops via `Variable._seq_lengths`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "dynamic_lstm", "dynamic_gru", "sequence_pool", "sequence_conv",
+    "sequence_expand", "sequence_first_step", "sequence_last_step",
+    "sequence_softmax", "sequence_reshape", "sequence_concat", "seq_lengths_of",
+]
+
+LEN_SUFFIX = "@LEN"
+
+
+def seq_lengths_of(var: Variable):
+    """Resolve the lengths companion of a sequence var (or None)."""
+    direct = getattr(var, "_seq_lengths", None)
+    if direct is not None:
+        return direct
+    block = var.block
+    name = var.name + LEN_SUFFIX
+    return block._var_recursive(name)
+
+
+def _propagate_lengths(src: Variable, dst: Variable):
+    lens = seq_lengths_of(src)
+    if lens is not None:
+        dst._seq_lengths = lens
+    return dst
+
+
+def dynamic_lstm(input, size, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", param_attr=None, bias_attr=None,
+                 dtype="float32", name=None):
+    """reference layers/nn.py:277 — input is the x-projection [N, T, 4H]."""
+    helper = LayerHelper("lstm", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    size = size // 4
+    weight = helper.create_parameter(helper.param_attr, shape=[size, 4 * size],
+                                     dtype=dtype)
+    bias_size = 7 * size if use_peepholes else 4 * size
+    bias = helper.create_parameter(helper.bias_attr, shape=[bias_size],
+                                   dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    batch_gate = helper.create_variable_for_type_inference(dtype)
+    batch_cell_pre = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    lens = seq_lengths_of(input)
+    if lens is not None:
+        inputs["Lengths"] = [lens]
+    helper.append_op(
+        type="lstm",
+        inputs=inputs,
+        outputs={"Hidden": [hidden], "Cell": [cell],
+                 "BatchGate": [batch_gate],
+                 "BatchCellPreAct": [batch_cell_pre]},
+        attrs={
+            "use_peepholes": use_peepholes, "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+        },
+    )
+    _propagate_lengths(input, hidden)
+    _propagate_lengths(input, cell)
+    return hidden, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, dtype="float32"):
+    """reference layers/nn.py:609 — input is the x-projection [N, T, 3H]."""
+    helper = LayerHelper("gru", param_attr=param_attr, bias_attr=bias_attr)
+    weight = helper.create_parameter(helper.param_attr, shape=[size, 3 * size],
+                                     dtype=dtype)
+    bias = helper.create_parameter(helper.bias_attr, shape=[3 * size],
+                                   dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    bg = helper.create_variable_for_type_inference(dtype)
+    brh = helper.create_variable_for_type_inference(dtype)
+    bh = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    lens = seq_lengths_of(input)
+    if lens is not None:
+        inputs["Lengths"] = [lens]
+    helper.append_op(
+        type="gru",
+        inputs=inputs,
+        outputs={"Hidden": [hidden], "BatchGate": [bg],
+                 "BatchResetHiddenPrev": [brh], "BatchHidden": [bh]},
+        attrs={
+            "is_reverse": is_reverse, "gate_activation": gate_activation,
+            "activation": candidate_activation,
+        },
+    )
+    _propagate_lengths(input, hidden)
+    return hidden
+
+
+def _seq_pool(input, pool_type):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    max_index = helper.create_variable_for_type_inference("int32")
+    inputs = {"X": [input]}
+    lens = seq_lengths_of(input)
+    if lens is not None:
+        inputs["Lengths"] = [lens]
+    helper.append_op(
+        type="sequence_pool",
+        inputs=inputs,
+        outputs={"Out": [out], "MaxIndex": [max_index]},
+        attrs={"pooltype": pool_type.upper()},
+    )
+    return out
+
+
+def sequence_pool(input, pool_type):
+    return _seq_pool(input, pool_type)
+
+
+def sequence_first_step(input):
+    return _seq_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return _seq_pool(input, "last")
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None):
+    """reference layers/nn.py sequence_conv."""
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act)
+    dtype = input.dtype
+    filter_shape = [filter_size * input.shape[-1], num_filters]
+    filter_param = helper.create_parameter(helper.param_attr,
+                                           shape=filter_shape, dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [input], "Filter": [filter_param]}
+    lens = seq_lengths_of(input)
+    if lens is not None:
+        inputs["Lengths"] = [lens]
+    helper.append_op(
+        type="sequence_conv",
+        inputs=inputs,
+        outputs={"Out": [pre_bias]},
+        attrs={
+            "contextStride": filter_stride,
+            "contextStart": -int(filter_size // 2),
+            "contextLength": filter_size,
+        },
+    )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=2)
+    out = helper.append_activation(pre_act)
+    _propagate_lengths(input, out)
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1):
+    helper = LayerHelper("sequence_expand")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_expand", inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]}, attrs={"ref_level": ref_level},
+    )
+    _propagate_lengths(y, out)
+    return out
+
+
+def sequence_softmax(input, use_cudnn=True):
+    helper = LayerHelper("sequence_softmax")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input]}
+    lens = seq_lengths_of(input)
+    if lens is not None:
+        inputs["Lengths"] = [lens]
+    helper.append_op(
+        type="sequence_softmax", inputs=inputs, outputs={"Out": [out]},
+    )
+    _propagate_lengths(input, out)
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_reshape", inputs={"X": [input]},
+        outputs={"Out": [out]}, attrs={"new_dim": new_dim},
+    )
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    lens = [seq_lengths_of(v) for v in input]
+    inputs = {"X": input}
+    if any(l is not None for l in lens):
+        if any(l is None for l in lens):
+            raise ValueError(
+                "sequence_concat: either all inputs carry lengths or none"
+            )
+        inputs["Lengths"] = lens
+        # result lengths = elementwise sum of input lengths
+        total = helper.create_variable_for_type_inference("int32")
+        helper.append_op(type="sum", inputs={"X": lens},
+                         outputs={"Out": [total]})
+        out._seq_lengths = total
+    helper.append_op(
+        type="sequence_concat", inputs=inputs, outputs={"Out": [out]},
+    )
+    return out

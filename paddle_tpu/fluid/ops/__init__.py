@@ -6,6 +6,7 @@ SURVEY.md §2.4). Importing this package registers all ops.
 from . import (  # noqa: F401
     activations,
     compare_ops,
+    control_flow,
     elementwise,
     loss_ops,
     math_ops,
@@ -14,5 +15,6 @@ from . import (  # noqa: F401
     optimizer_ops,
     random_ops,
     reduce_ops,
+    sequence_ops,
     tensor_ops,
 )

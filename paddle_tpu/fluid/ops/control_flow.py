@@ -1,0 +1,178 @@
+"""Control-flow op emitters: while -> lax.while_loop, conditional_block ->
+lax.cond, recurrent (StaticRNN) -> trace-time unroll.
+
+Reference: operators/while_op.cc:35 (re-runs the sub-block per step via a
+nested Executor + StepScopes), operators/conditional_block_op.cc,
+operators/recurrent_op.cc (StaticRNN engine, StepScopes:53, memory links
+:141). Here the sub-block's emitters are traced into the SAME XLA
+computation — no nested interpreter; loop state is an explicit carry.
+
+All outer vars a sub-block reads are listed in the op's inputs (the layer
+builders compute this), so the emitters are pure functions of `ins` and the
+generic vjp differentiates `recurrent` with no hand-written grad. `while`
+stays forward-only (XLA while_loop has no reverse-mode); train RNNs with the
+scan-based lstm/gru ops or StaticRNN.
+
+Constraints (XLA): loop-carried shapes are static across iterations; the
+reference's shrinking-batch DynamicRNN trick (shrink_rnn_memory) becomes
+masking.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import exec_op_descs, register_op
+from .common import one
+
+
+def _sub_op_descs(ctx, attrs):
+    if ctx.program is None:
+        raise RuntimeError("control-flow op needs ctx.program (executor trace)")
+    sub = ctx.program.blocks[int(attrs["sub_block"])]
+    return [op.desc for op in sub.ops]
+
+
+def _written(op_descs):
+    seen, out = set(), []
+    for d in op_descs:
+        for n in d.output_names():
+            if n and n not in seen:
+                seen.add(n)
+                out.append(n)
+    return out
+
+
+@register_op("while", no_grad=("Condition", "X"),
+             ref="paddle/fluid/operators/while_op.cc:35")
+def while_op(ctx, ins, attrs):
+    ops = _sub_op_descs(ctx, attrs)
+    x_names = list(attrs["x_var_names"])
+    cond_name = str(attrs["cond_var_name"])
+    out_names = list(attrs["out_var_names"])
+
+    env = dict(zip(x_names, ins.get("X", [])))
+    env[cond_name] = one(ins, "Condition")
+    # loop-carried state: written vars with a pre-loop value, + condition
+    carry_names = [n for n in _written(ops) if n in env]
+    if cond_name not in carry_names:
+        carry_names.append(cond_name)
+    base_env = {k: v for k, v in env.items() if k not in carry_names}
+
+    def cond_fn(carry):
+        return jnp.reshape(carry[cond_name], ()).astype(bool)
+
+    def body_fn(carry):
+        local = dict(base_env)
+        local.update(carry)
+        exec_op_descs(ctx, ops, local)
+        return {n: local[n] for n in carry_names}
+
+    init = {n: env[n] for n in carry_names}
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    return {"Out": [final.get(n) for n in out_names]}
+
+
+@register_op("conditional_block", no_grad=("Condition",),
+             ref="paddle/fluid/operators/conditional_block_op.cc")
+def conditional_block(ctx, ins, attrs):
+    ops = _sub_op_descs(ctx, attrs)
+    x_names = list(attrs["x_var_names"])
+    out_names = list(attrs["out_var_names"])
+    env = dict(zip(x_names, ins.get("X", [])))
+    carry_names = [n for n in _written(ops) if n in env]
+
+    def true_fn(carry):
+        local = dict(env)
+        local.update(carry)
+        exec_op_descs(ctx, ops, local)
+        return {n: local[n] for n in carry_names}
+
+    def false_fn(carry):
+        return carry
+
+    pred = jnp.reshape(one(ins, "Condition"), ()).astype(bool)
+    init = {n: env[n] for n in carry_names}
+    final = jax.lax.cond(pred, true_fn, false_fn, init)
+    return {"Out": [final.get(n) for n in out_names]}
+
+
+@register_op("recurrent", no_grad=(),
+             ref="paddle/fluid/operators/recurrent_op.cc")
+def recurrent(ctx, ins, attrs):
+    """StaticRNN: unroll the step block over axis 1 of the step inputs.
+    Differentiable — the unrolled steps are plain jax ops in one trace and
+    the generic vjp flows through StepInputs/MemInit/Params."""
+    ops = _sub_op_descs(ctx, attrs)
+    step_in_vars = list(attrs["step_input_vars"])
+    mem_links = [tuple(l) for l in attrs["memory_links"]]  # (pre, updated)
+    step_out_vars = list(attrs["step_output_vars"])
+    param_names = list(attrs["param_var_names"])
+
+    step_inputs = ins.get("StepInputs", [])
+    mem_init = ins.get("MemInit", [])
+    params = ins.get("Params", [])
+
+    if not step_inputs:
+        raise ValueError("recurrent op requires StepInputs (trip count)")
+    T = step_inputs[0].shape[1]
+
+    base_env = dict(zip(param_names, params))
+    mems = {pre: init for (pre, _), init in zip(mem_links, mem_init)}
+    collected = {n: [] for n in step_out_vars}
+    for t in range(T):
+        local = dict(base_env)
+        local.update(mems)
+        for full, sub in zip(step_inputs, step_in_vars):
+            local[sub] = full[:, t]
+        exec_op_descs(ctx, ops, local)
+        mems = {pre: local[upd] for (pre, upd) in mem_links}
+        for n in step_out_vars:
+            collected[n].append(local[n])
+    return {"Out": [jnp.stack(collected[n], axis=1) for n in step_out_vars]}
+
+
+# --- tensor-array ops (reference tensor_array_read_write_op.cc) ----------
+# arrays are preallocated dense buffers [T, ...] (static shapes); write =
+# dynamic_update_slice, read = dynamic_slice on axis 0
+
+
+@register_op("write_to_array", no_grad=("I",),
+             ref="paddle/fluid/operators/tensor_array_read_write_op.cc")
+def write_to_array(ctx, ins, attrs):
+    arr, x, i = one(ins, "Array"), one(ins, "X"), one(ins, "I")
+    idx = jnp.reshape(i, ()).astype(jnp.int32)
+    starts = (idx,) + (0,) * (arr.ndim - 1)
+    return {"Out": jax.lax.dynamic_update_slice(arr, x[None], starts)}
+
+
+@register_op("read_from_array", no_grad=("I",),
+             ref="paddle/fluid/operators/tensor_array_read_write_op.cc")
+def read_from_array(ctx, ins, attrs):
+    arr, i = one(ins, "X"), one(ins, "I")
+    idx = jnp.reshape(i, ()).astype(jnp.int32)
+    starts = (idx,) + (0,) * (arr.ndim - 1)
+    sizes = (1,) + arr.shape[1:]
+    return {"Out": jax.lax.dynamic_slice(arr, starts, sizes)[0]}
+
+
+@register_op("array_length", no_grad=("X",),
+             ref="paddle/fluid/operators/lod_array_length_op.cc")
+def array_length(ctx, ins, attrs):
+    return {"Out": jnp.asarray([one(ins, "X").shape[0]], dtype=jnp.int64)}
+
+
+@register_op("slice",
+             ref="paddle/fluid/operators (era: crop/sequence_slice family)")
+def slice_op(ctx, ins, attrs):
+    x = one(ins, "Input")
+    axes = [int(a) for a in attrs["axes"]]
+    starts = [int(s) for s in attrs["starts"]]
+    ends = [int(e) for e in attrs["ends"]]
+    idx = [slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        dim = x.shape[ax]
+        s = s + dim if s < 0 else min(s, dim)
+        e = e + dim if e < 0 else min(e, dim)
+        idx[ax] = slice(s, e)
+    return {"Out": x[tuple(idx)]}

@@ -191,9 +191,22 @@ def softmax(ctx, ins, attrs):
     return {"Out": jax.nn.softmax(one(ins, "X"), axis=-1)}
 
 
-@register_op("sequence_softmax", ref="paddle/fluid/operators/sequence_softmax_op.cc")
+@register_op("sequence_softmax", no_grad=("Lengths",),
+             ref="paddle/fluid/operators/sequence_softmax_op.cc")
 def sequence_softmax(ctx, ins, attrs):
-    return {"Out": jax.nn.softmax(one(ins, "X"), axis=-1)}
+    """Softmax within each sequence over the time axis; padded positions get
+    zero probability (the reference softmaxes per LoD segment)."""
+    x = one(ins, "X")
+    lengths = one(ins, "Lengths")
+    if lengths is None:
+        return {"Out": jax.nn.softmax(x, axis=1 if x.ndim > 1 else 0)}
+    T = x.shape[1]
+    valid = jnp.arange(T)[None, :] < lengths[:, None]
+    while valid.ndim < x.ndim:
+        valid = valid[..., None]
+    masked = jnp.where(valid, x, -jnp.inf)
+    out = jax.nn.softmax(masked, axis=1)
+    return {"Out": jnp.where(valid, out, 0.0)}
 
 
 @register_op("lrn", ref="paddle/fluid/operators/lrn_op.cc")

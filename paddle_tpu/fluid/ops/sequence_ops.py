@@ -1,0 +1,322 @@
+"""Sequence ops — the reference's LoD machinery redesigned for static shapes.
+
+The reference stores variable-length batches as LoD offset tables over a
+packed tensor (lod_tensor.h:44-110) and reorders into time-batches for RNNs
+(math/sequence2batch.*). XLA needs static shapes, so here a "sequence batch"
+is a padded dense tensor [N, T, ...] plus a lengths vector [N] (int), carried
+in a companion variable `<name>@LEN` (see layers/sequence.py). Masking
+replaces shrinking; bucketing at the feeder bounds recompiles.
+
+Reference op files: sequence_pool_op.cc, sequence_conv_op.cc,
+sequence_expand_op.cc, sequence_slice_op.cc, sequence_concat_op.cc,
+lstm_op.cc (+math/lstm_compute), gru_op.cc (+math/gru_compute).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+def _mask(lengths, T, dtype=jnp.float32):
+    # [N, T] 1.0 where t < len
+    return (jnp.arange(T)[None, :] < lengths[:, None]).astype(dtype)
+
+
+@register_op("sequence_pool", no_grad=("Lengths",),
+             ref="paddle/fluid/operators/sequence_pool_op.cc")
+def sequence_pool(ctx, ins, attrs):
+    x = one(ins, "X")  # [N, T, D]
+    lengths = one(ins, "Lengths")
+    pool_type = str(attrs.get("pooltype", "AVERAGE")).upper()
+    N, T = x.shape[0], x.shape[1]
+    if lengths is None:
+        lengths = jnp.full((N,), T, dtype=jnp.int32)
+    m = _mask(lengths, T, x.dtype)[:, :, None]
+    safe_len = jnp.maximum(lengths, 1).astype(x.dtype)[:, None]
+    if pool_type == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / safe_len
+    elif pool_type == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif pool_type == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(safe_len)
+    elif pool_type == "MAX":
+        neg = jnp.finfo(x.dtype).min
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif pool_type == "LAST":
+        idx = jnp.maximum(lengths - 1, 0).astype(jnp.int32)
+        out = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32),
+                                  axis=1)[:, 0]
+    elif pool_type == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {pool_type}")
+    return {"Out": out, "MaxIndex": jnp.zeros((N,), jnp.int32)}
+
+
+@register_op("sequence_conv", no_grad=("Lengths",),
+             ref="paddle/fluid/operators/sequence_conv_op.cc")
+def sequence_conv(ctx, ins, attrs):
+    """Context-window projection (reference math/context_project.*): for each
+    timestep, concat [t+start, t+start+len) rows (zero-padded at edges) and
+    multiply by the filter [ctx_len*D, out_dim]."""
+    x = one(ins, "X")  # [N, T, D]
+    w = one(ins, "Filter")
+    lengths = one(ins, "Lengths")
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", -((ctx_len - 1) // 2)))
+    N, T, D = x.shape
+    if lengths is not None:
+        x = x * _mask(lengths, T, x.dtype)[:, :, None]
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        shifted = jnp.roll(x, -off, axis=1)
+        t_idx = jnp.arange(T) + off
+        valid = ((t_idx >= 0) & (t_idx < T)).astype(x.dtype)[None, :, None]
+        cols.append(shifted * valid)
+    ctx_mat = jnp.concatenate(cols, axis=-1)  # [N, T, ctx_len*D]
+    out = jnp.einsum("ntd,do->nto", ctx_mat, w)
+    return {"Out": out}
+
+
+@register_op("sequence_expand", no_grad=("Y", "YLengths"),
+             ref="paddle/fluid/operators/sequence_expand_op.cc")
+def sequence_expand(ctx, ins, attrs):
+    """Broadcast per-sequence rows of X across the timesteps of Y
+    (padded-form equivalent of the reference's LoD expand)."""
+    x = one(ins, "X")  # [N, D] or [N, 1, D]
+    y = one(ins, "Y")  # [N, T, ...] provides the target length
+    if x.ndim == 2:
+        x = x[:, None, :]
+    T = y.shape[1]
+    return {"Out": jnp.broadcast_to(x, (x.shape[0], T, x.shape[2]))}
+
+
+@register_op("sequence_slice", no_grad=("Offset", "Length"),
+             ref="paddle/fluid/operators/sequence_slice_op.cc")
+def sequence_slice(ctx, ins, attrs):
+    x = one(ins, "X")
+    offset = one(ins, "Offset")
+    length = one(ins, "Length")
+    T = x.shape[1]
+    t_idx = jnp.arange(T)[None, :]
+    keep = (t_idx >= offset.reshape(-1, 1)) & (
+        t_idx < (offset + length).reshape(-1, 1)
+    )
+    return {"Out": x * keep[:, :, None].astype(x.dtype)}
+
+
+@register_op("sequence_concat", no_grad=("Lengths",),
+             ref="paddle/fluid/operators/sequence_concat_op.cc")
+def sequence_concat(ctx, ins, attrs):
+    """Concatenate along time per-sample: each input's valid rows are packed
+    behind the previous input's valid rows (not behind its padding)."""
+    xs = [v for v in ins.get("X", []) if v is not None]
+    lens = ins.get("Lengths", [])
+    if not lens:
+        return {"Out": jnp.concatenate(xs, axis=1)}
+    N = xs[0].shape[0]
+    T_out = sum(x.shape[1] for x in xs)
+    item = xs[0].shape[2:]
+    out = jnp.zeros((N, T_out) + item, xs[0].dtype)
+    batch_idx = jnp.arange(N)[:, None]
+    offset = jnp.zeros((N,), jnp.int32)
+    for i, x in enumerate(xs):
+        T_i = x.shape[1]
+        li = lens[i] if i < len(lens) and lens[i] is not None else jnp.full(
+            (N,), T_i, jnp.int32)
+        t = jnp.arange(T_i)[None, :]
+        dest = offset[:, None] + t
+        dest = jnp.where(t < li[:, None], dest, T_out)  # OOB -> dropped
+        out = out.at[batch_idx, dest].set(x, mode="drop")
+        offset = offset + li.astype(jnp.int32)
+    return {"Out": out}
+
+
+@register_op("sequence_reshape", ref="paddle/fluid/operators/sequence_reshape_op.cc")
+def sequence_reshape(ctx, ins, attrs):
+    x = one(ins, "X")
+    new_dim = int(attrs["new_dim"])
+    N = x.shape[0]
+    return {"Out": jnp.reshape(x, (N, -1, new_dim))}
+
+
+@register_op("sequence_erase", no_grad=("X",),
+             ref="paddle/fluid/operators/sequence_erase_op.cc")
+def sequence_erase(ctx, ins, attrs):
+    """Mask out listed tokens (int sequences): erased positions are replaced
+    by 0 and do not shrink the padded tensor (static shapes)."""
+    x = one(ins, "X")
+    tokens = jnp.asarray(attrs.get("tokens", []), dtype=x.dtype)
+    erase = jnp.isin(x, tokens)
+    return {"Out": jnp.where(erase, jnp.zeros_like(x), x)}
+
+
+# --- fused RNN compute ops (reference math/detail fused cells) -----------
+@register_op("lstm", no_grad=("Lengths",),
+             ref="paddle/fluid/operators/lstm_op.cc, math/lstm_compute.*")
+def lstm(ctx, ins, attrs):
+    """Fused LSTM over time via lax.scan. Input is the pre-projected gate
+    activations [N, T, 4H] (the reference's dynamic_lstm also takes the
+    x-projection as input, layers/nn.py:277); Weight [H, 4H] is the recurrent
+    projection; Bias [4H] or [7H] with peepholes. Gate order i, f, c, o
+    (reference lstm_op.cc gate order: input, forget, cell, output)."""
+    x = one(ins, "Input")
+    w = one(ins, "Weight")
+    bias = one(ins, "Bias")
+    lengths = one(ins, "Lengths")
+    h0, c0 = one(ins, "H0"), one(ins, "C0")
+    use_peepholes = bool(attrs.get("use_peepholes", False))
+    is_reverse = bool(attrs.get("is_reverse", False))
+    gate_act = attrs.get("gate_activation", "sigmoid")
+    cell_act = attrs.get("cell_activation", "tanh")
+    cand_act = attrs.get("candidate_activation", "tanh")
+
+    acts = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}
+    g_act, c_act, d_act = acts[gate_act], acts[cand_act], acts[cell_act]
+
+    N, T, H4 = x.shape
+    H = H4 // 4
+    if bias is not None:
+        b_gate = bias[:4 * H]
+        x = x + b_gate[None, None, :]
+        if use_peepholes:
+            w_ic, w_fc, w_oc = (bias[4 * H:5 * H], bias[5 * H:6 * H],
+                                bias[6 * H:7 * H])
+    if h0 is None:
+        h0 = jnp.zeros((N, H), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((N, H), x.dtype)
+    if lengths is None:
+        lengths = jnp.full((N,), T, dtype=jnp.int32)
+
+    xt_seq = jnp.swapaxes(x, 0, 1)  # [T, N, 4H]
+    if is_reverse:
+        xt_seq = jnp.flip(xt_seq, axis=0)
+    step_idx = jnp.arange(T)
+    if is_reverse:
+        step_idx = jnp.flip(step_idx)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, t = inp
+        gates = xt + h_prev @ w  # [N, 4H]
+        gi, gf, gc, go = jnp.split(gates, 4, axis=1)
+        if use_peepholes:
+            gi = gi + c_prev * w_ic[None, :]
+            gf = gf + c_prev * w_fc[None, :]
+        i = g_act(gi)
+        f = g_act(gf)
+        c_new = f * c_prev + i * c_act(gc)
+        if use_peepholes:
+            go = go + c_new * w_oc[None, :]
+        o = g_act(go)
+        h_new = o * d_act(c_new)
+        valid = (t < lengths)[:, None]
+        h_new = jnp.where(valid, h_new, h_prev)
+        c_new = jnp.where(valid, c_new, c_prev)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xt_seq, step_idx))
+    if is_reverse:
+        hs, cs = jnp.flip(hs, axis=0), jnp.flip(cs, axis=0)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    mask = _mask(lengths, T, x.dtype)[:, :, None]
+    return {"Hidden": hidden * mask, "Cell": cell * mask,
+            "BatchGate": x, "BatchCellPreAct": cell}
+
+
+@register_op("gru", no_grad=("Lengths",),
+             ref="paddle/fluid/operators/gru_op.cc, math/gru_compute.*")
+def gru(ctx, ins, attrs):
+    """Fused GRU: Input [N, T, 3H] pre-projected, Weight packs [H, 2H]
+    (update|reset) + [H, H] (candidate) like the reference gru layout."""
+    x = one(ins, "Input")
+    w = one(ins, "Weight")  # [H, 3H]
+    bias = one(ins, "Bias")
+    lengths = one(ins, "Lengths")
+    h0 = one(ins, "H0")
+    is_reverse = bool(attrs.get("is_reverse", False))
+    acts = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}
+    g_act = acts[attrs.get("gate_activation", "sigmoid")]
+    c_act = acts[attrs.get("activation", "tanh")]
+
+    N, T, H3 = x.shape
+    H = H3 // 3
+    if bias is not None:
+        x = x + bias[None, None, :]
+    if h0 is None:
+        h0 = jnp.zeros((N, H), x.dtype)
+    if lengths is None:
+        lengths = jnp.full((N,), T, dtype=jnp.int32)
+    w_ur = w[:, :2 * H]  # update/reset recurrent weights
+    w_c = w[:, 2 * H:]
+
+    xt_seq = jnp.swapaxes(x, 0, 1)
+    if is_reverse:
+        xt_seq = jnp.flip(xt_seq, axis=0)
+    step_idx = jnp.arange(T)
+    if is_reverse:
+        step_idx = jnp.flip(step_idx)
+
+    def step(h_prev, inp):
+        xt, t = inp
+        xu, xr, xc = jnp.split(xt, 3, axis=1)
+        ur = h_prev @ w_ur
+        u = g_act(xu + ur[:, :H])
+        r = g_act(xr + ur[:, H:])
+        c = c_act(xc + (r * h_prev) @ w_c)
+        # reference gru_compute: h = (1-u)*prev + u*candidate
+        h_new = (1.0 - u) * h_prev + u * c
+        valid = (t < lengths)[:, None]
+        h_new = jnp.where(valid, h_new, h_prev)
+        return h_new, h_new
+
+    _, hs = jax.lax.scan(step, h0, (xt_seq, step_idx))
+    if is_reverse:
+        hs = jnp.flip(hs, axis=0)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    mask = _mask(lengths, T, x.dtype)[:, :, None]
+    return {"Hidden": hidden * mask, "BatchGate": x,
+            "BatchResetHiddenPrev": hidden, "BatchHidden": hidden}
+
+
+@register_op("lstm_unit", ref="paddle/fluid/operators/lstm_unit_op.cc")
+def lstm_unit(ctx, ins, attrs):
+    x = one(ins, "X")  # [N, 4H] pre-projected gates
+    c_prev = one(ins, "C_prev")
+    forget_bias = float(attrs.get("forget_bias", 0.0))
+    gi, gf, gc, go = jnp.split(x, 4, axis=1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    c = f * c_prev + i * jnp.tanh(gc)
+    h = jax.nn.sigmoid(go) * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+@register_op("gru_unit", ref="paddle/fluid/operators/gru_unit_op.cc")
+def gru_unit(ctx, ins, attrs):
+    x = one(ins, "Input")  # [N, 3H]
+    h_prev = one(ins, "HiddenPrev")
+    w = one(ins, "Weight")  # [H, 3H]
+    bias = one(ins, "Bias")
+    acts = {1: jax.nn.sigmoid, 2: jnp.tanh, 3: jax.nn.relu,
+            0: lambda v: v}
+    g_act = acts.get(int(attrs.get("gate_activation", 1)), jax.nn.sigmoid)
+    c_act = acts.get(int(attrs.get("activation", 2)), jnp.tanh)
+    H = h_prev.shape[1]
+    if bias is not None:
+        x = x + bias.reshape(1, -1)
+    xu, xr, xc = x[:, :H], x[:, H:2 * H], x[:, 2 * H:]
+    ur = h_prev @ w[:, :2 * H]
+    u = g_act(xu + ur[:, :H])
+    r = g_act(xr + ur[:, H:])
+    c = c_act(xc + (r * h_prev) @ w[:, 2 * H:])
+    h = (1.0 - u) * h_prev + u * c
+    return {"Hidden": h, "Gate": x, "ResetHiddenPrev": r * h_prev}

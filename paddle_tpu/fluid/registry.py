@@ -37,11 +37,13 @@ GRAD_SUFFIX = "@GRAD"
 
 class EmitCtx:
     """Per-trace context handed to emitters (role of the reference's
-    ExecutionContext, operator.h:185): RNG access + execution mode."""
+    ExecutionContext, operator.h:185): RNG access, execution mode, and the
+    owning Program (control-flow emitters resolve sub-blocks through it)."""
 
-    def __init__(self, root_key=None, is_test: bool = False):
+    def __init__(self, root_key=None, is_test: bool = False, program=None):
         self._root_key = root_key
         self.is_test = is_test
+        self.program = program
 
     def rng(self, attrs: Dict[str, Any]):
         """Deterministic per-op key: fold the op's seed into the step key."""
@@ -130,6 +132,29 @@ def _is_diff(x) -> bool:
 def run_forward(ctx: EmitCtx, op_type: str, ins, attrs) -> Dict[str, List[Any]]:
     info = get_op_info(op_type)
     return normalize_outs(info.forward(ctx, ins, attrs))
+
+
+def exec_op_descs(ctx: EmitCtx, op_descs, env: Dict[str, Any],
+                  skip_types=("feed", "fetch")):
+    """Trace a list of OpDescs into env — the executor's hot loop, also used
+    by control-flow emitters on sub-blocks (the reference nests Executors,
+    while_op.cc:35; here it's one trace)."""
+    for od in op_descs:
+        if od.type in skip_types:
+            continue
+        ins = {
+            slot: [env.get(n) if n else None for n in names]
+            for slot, names in od.inputs.items()
+        }
+        if od.type.endswith("_grad") and FWD_META_ATTR in od.attrs:
+            outs = run_grad(ctx, ins, od.attrs)
+        else:
+            outs = run_forward(ctx, od.type, ins, od.attrs)
+        for slot, names in od.outputs.items():
+            vals = outs.get(slot, [])
+            for i, n in enumerate(names):
+                if n and i < len(vals) and vals[i] is not None:
+                    env[n] = vals[i]
 
 
 def run_grad(ctx: EmitCtx, ins: Dict[str, List[Any]], attrs: Dict[str, Any]):

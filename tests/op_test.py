@@ -1,0 +1,170 @@
+"""OpTest harness — capability-parity with the reference's op-correctness
+backbone (python/paddle/fluid/tests/unittests/op_test.py: OpTest:212,
+check_output:343, check_grad:378, get_numeric_gradient:97): build a one-op
+program from declarative inputs/attrs, check outputs against a numpy
+reference, and check analytic gradients (vjp grad ops) against central-
+difference numeric gradients computed through the same executor."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import unique_name
+from paddle_tpu.fluid.backward import append_backward
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+class OpTest:
+    """Subclass contract (mirrors the reference):
+        self.op_type: str
+        self.inputs:  {slot: ndarray | [(name, ndarray), ...]}
+        self.attrs:   {...} (optional)
+        self.outputs: {slot: ndarray | [(name, ndarray), ...]} expected
+    """
+
+    op_type: str
+    inputs: dict
+    outputs: dict
+    attrs: dict = {}
+
+    # --- helpers ---------------------------------------------------------
+    @staticmethod
+    def _as_list(slot_value, slot):
+        if isinstance(slot_value, list):
+            return slot_value
+        return [(slot, slot_value)]
+
+    def _build(self, extra_fetch=()):
+        main, startup = Program(), Program()
+        scope = fluid.Scope()
+        feed = {}
+        with unique_name.guard(), program_guard(main, startup):
+            op_inputs = {}
+            for slot, value in self.inputs.items():
+                names = []
+                for name, arr in self._as_list(value, slot):
+                    arr = np.asarray(arr)
+                    var = main.global_block().create_var(
+                        name=name, shape=list(arr.shape), dtype=str(arr.dtype),
+                        stop_gradient=False,
+                    )
+                    feed[name] = arr
+                    names.append(name)
+                op_inputs[slot] = names
+            op_outputs = {}
+            out_vars = {}
+            for slot, value in self.outputs.items():
+                names = []
+                for name, arr in self._as_list(value, slot):
+                    var = main.global_block().create_var(
+                        name=name, dtype=str(np.asarray(arr).dtype),
+                        shape=list(np.asarray(arr).shape),
+                    )
+                    out_vars[name] = np.asarray(arr)
+                    names.append(name)
+                op_outputs[slot] = names
+            main.global_block().append_op(
+                type=self.op_type, inputs=op_inputs, outputs=op_outputs,
+                attrs=dict(getattr(self, "attrs", {}) or {}),
+            )
+        return main, startup, scope, feed, out_vars
+
+    # --- checks ----------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=()):
+        main, startup, scope, feed, expected = self._build()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            fetch_names = [n for n in expected if n not in no_check_set]
+            results = exe.run(main, feed=feed, fetch_list=fetch_names)
+        for name, got in zip(fetch_names, results):
+            want = expected[name]
+            np.testing.assert_allclose(
+                np.asarray(got, dtype=np.float64)
+                if np.issubdtype(np.asarray(got).dtype, np.floating)
+                else got,
+                want.astype(np.float64)
+                if np.issubdtype(want.dtype, np.floating) else want,
+                atol=atol, rtol=rtol,
+                err_msg=f"op {self.op_type} output '{name}' mismatch",
+            )
+
+    def check_grad(self, inputs_to_check, output_name, max_relative_error=5e-3,
+                   numeric_eps=1e-3, no_grad_set=None):
+        """Analytic d(loss)/d(input) vs central differences, with
+        loss = mean(output * W) for a fixed random W — a plain mean is
+        degenerate for ops whose outputs have row constraints (softmax rows
+        summing to 1 makes d(mean)/dx identically zero)."""
+        rng = np.random.RandomState(1234)
+
+        def add_loss(prog, out_var):
+            w = rng.rand(*[int(d) for d in out_var.shape]).astype(np.float32)
+            wv = fluid.layers.assign(w)
+            weighted = fluid.layers.elementwise_mul(out_var, wv)
+            return fluid.layers.mean(weighted)
+
+        main, startup, scope, feed, _ = self._build()
+        with fluid.scope_guard(scope):
+            with program_guard(main, startup):
+                out = main.global_block().var(output_name)
+                loss = add_loss(main, out)
+                params_grads = append_backward(
+                    loss, parameter_list=list(inputs_to_check),
+                    no_grad_set=no_grad_set,
+                )
+            grad_map = {p.name: g.name for p, g in params_grads}
+            exe = fluid.Executor()
+            analytic = {}
+            for name in inputs_to_check:
+                assert name in grad_map, (
+                    f"no gradient generated for '{name}' of op {self.op_type}"
+                )
+                (g,) = exe.run(main, feed=feed, fetch_list=[grad_map[name]])
+                analytic[name] = np.asarray(g, dtype=np.float64)
+
+            # numeric: rebuild a forward-only loss program with the same W
+            rng = np.random.RandomState(1234)
+            main2, startup2, scope2, feed2, _ = self._build()
+            with fluid.scope_guard(scope2):
+                with program_guard(main2, startup2):
+                    loss2 = add_loss(
+                        main2, main2.global_block().var(output_name)
+                    )
+                exe2 = fluid.Executor()
+
+                def loss_at(feed_override):
+                    (v,) = exe2.run(main2, feed=feed_override,
+                                    fetch_list=[loss2])
+                    return float(np.asarray(v).reshape(-1)[0])
+
+                for name in inputs_to_check:
+                    base = feed2[name].astype(np.float64)
+                    num = np.zeros_like(base)
+                    flat = base.reshape(-1)
+                    num_flat = num.reshape(-1)
+                    for i in range(flat.size):
+                        fp = dict(feed2)
+                        fm = dict(feed2)
+                        xp = flat.copy()
+                        xp[i] += numeric_eps
+                        xm = flat.copy()
+                        xm[i] -= numeric_eps
+                        fp[name] = xp.reshape(base.shape).astype(
+                            feed2[name].dtype
+                        )
+                        fm[name] = xm.reshape(base.shape).astype(
+                            feed2[name].dtype
+                        )
+                        num_flat[i] = (
+                            loss_at(fp) - loss_at(fm)
+                        ) / (2 * numeric_eps)
+                    a = analytic[name]
+                    denom = np.maximum(
+                        np.maximum(np.abs(a), np.abs(num)), 1e-3
+                    )
+                    rel = np.abs(a - num) / denom
+                    assert rel.max() <= max_relative_error, (
+                        f"op {self.op_type} grad wrt '{name}': max rel err "
+                        f"{rel.max():.5f} > {max_relative_error} "
+                        f"(analytic {a.reshape(-1)[:4]}, numeric "
+                        f"{num.reshape(-1)[:4]})"
+                    )

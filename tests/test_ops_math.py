@@ -1,0 +1,301 @@
+"""Math/tensor op correctness (reference test_elementwise_*_op.py,
+test_mul_op.py, test_matmul_op.py, test_concat_op.py, ...)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    def test_same_shape(self):
+        self.op_type = "elementwise_add"
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x + y}
+        self.check_output()
+
+    def test_broadcast_axis1(self):
+        self.op_type = "elementwise_add"
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(3).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.check_output()
+
+    def test_grad(self):
+        self.op_type = "elementwise_add"
+        x = np.random.rand(2, 3).astype(np.float32)
+        y = np.random.rand(3).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y}
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseOps(OpTest):
+    @pytest.mark.parametrize(
+        "op,fn",
+        [("elementwise_sub", np.subtract), ("elementwise_mul", np.multiply),
+         ("elementwise_div", np.divide), ("elementwise_max", np.maximum),
+         ("elementwise_min", np.minimum), ("elementwise_pow", np.power)],
+    )
+    def test_ops(self, op, fn):
+        self.op_type = op
+        x = (np.random.rand(3, 4) + 0.5).astype(np.float32)
+        y = (np.random.rand(3, 4) + 0.5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": fn(x, y)}
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+class TestMul(OpTest):
+    def test_2d(self):
+        self.op_type = "mul"
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(4, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x @ y}
+        self.check_output()
+
+    def test_4d_flatten(self):
+        self.op_type = "mul"
+        x = np.random.rand(2, 2, 3).astype(np.float32)
+        y = np.random.rand(6, 4).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1}
+        self.outputs = {"Out": x.reshape(2, 6) @ y}
+        self.check_output()
+
+    def test_grad(self):
+        self.op_type = "mul"
+        x = np.random.rand(2, 3).astype(np.float32)
+        y = np.random.rand(3, 2).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x @ y}
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmul(OpTest):
+    @pytest.mark.parametrize("tx,ty", [(False, False), (True, False),
+                                       (False, True), (True, True)])
+    def test_transpose_variants(self, tx, ty):
+        self.op_type = "matmul"
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        x = a.T if tx else a
+        y = b.T if ty else b
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": tx, "transpose_Y": ty}
+        self.outputs = {"Out": a @ b}
+        self.check_output()
+
+    def test_batched(self):
+        self.op_type = "matmul"
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(2, 4, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": np.einsum("bij,bjk->bik", x, y)}
+        self.check_output()
+
+
+class TestReduce(OpTest):
+    @pytest.mark.parametrize(
+        "op,fn", [("reduce_sum", np.sum), ("reduce_mean", np.mean),
+                  ("reduce_max", np.max), ("reduce_min", np.min),
+                  ("reduce_prod", np.prod)],
+    )
+    def test_dim(self, op, fn):
+        self.op_type = op
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False}
+        self.outputs = {"Out": fn(x, axis=1)}
+        self.check_output(rtol=1e-4)
+
+    def test_reduce_all_keepdim(self):
+        self.op_type = "reduce_sum"
+        x = np.random.rand(2, 3).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True, "keep_dim": False, "dim": [0]}
+        self.outputs = {"Out": np.array([x.sum()])}
+        self.check_output(rtol=1e-4)
+
+
+class TestShapes(OpTest):
+    def test_concat(self):
+        self.op_type = "concat"
+        xs = [np.random.rand(2, 3).astype(np.float32) for _ in range(3)]
+        self.inputs = {"X": [(f"x{i}", x) for i, x in enumerate(xs)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate(xs, axis=1)}
+        self.check_output()
+
+    def test_split(self):
+        self.op_type = "split"
+        x = np.random.rand(4, 6).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"num": 3, "sections": [], "axis": 1}
+        parts = np.split(x, 3, axis=1)
+        self.outputs = {"Out": [(f"o{i}", p) for i, p in enumerate(parts)]}
+        self.check_output()
+
+    def test_split_sections(self):
+        self.op_type = "split"
+        x = np.random.rand(4, 6).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"num": 0, "sections": [1, 2, 3], "axis": 1}
+        parts = np.split(x, [1, 3], axis=1)
+        self.outputs = {"Out": [(f"o{i}", p) for i, p in enumerate(parts)]}
+        self.check_output()
+
+    def test_reshape(self):
+        self.op_type = "reshape"
+        x = np.random.rand(2, 6).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [0, 2, 3]}
+        self.outputs = {"Out": x.reshape(2, 2, 3)}
+        self.check_output()
+
+    def test_transpose(self):
+        self.op_type = "transpose"
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [2, 0, 1]}
+        self.outputs = {"Out": x.transpose(2, 0, 1)}
+        self.check_output()
+
+    def test_cast(self):
+        self.op_type = "cast"
+        x = np.random.rand(3, 3).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"in_dtype": "float32", "out_dtype": "int32"}
+        self.outputs = {"Out": x.astype(np.int32)}
+        self.check_output()
+
+    def test_expand(self):
+        self.op_type = "expand"
+        x = np.random.rand(2, 3).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"expand_times": [2, 2]}
+        self.outputs = {"Out": np.tile(x, (2, 2))}
+        self.check_output()
+
+    def test_pad(self):
+        self.op_type = "pad"
+        x = np.random.rand(2, 3).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"paddings": [0, 1, 1, 0], "pad_value": 0.5}
+        self.outputs = {"Out": np.pad(x, ((0, 1), (1, 0)),
+                                      constant_values=0.5)}
+        self.check_output()
+
+
+class TestGatherLookup(OpTest):
+    def test_lookup_table(self):
+        self.op_type = "lookup_table"
+        w = np.random.rand(10, 4).astype(np.float32)
+        ids = np.array([[1], [3], [5]], dtype=np.int64)
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {}
+        self.outputs = {"Out": w[ids.reshape(-1)]}
+        self.check_output()
+
+    def test_lookup_table_padding_idx(self):
+        self.op_type = "lookup_table"
+        w = np.random.rand(10, 4).astype(np.float32)
+        ids = np.array([[1], [0], [5]], dtype=np.int64)
+        expected = w[ids.reshape(-1)].copy()
+        expected[1] = 0.0
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {"padding_idx": 0}
+        self.outputs = {"Out": expected}
+        self.check_output()
+
+    def test_lookup_table_grad(self):
+        self.op_type = "lookup_table"
+        w = np.random.rand(6, 3).astype(np.float32)
+        ids = np.array([[1], [1], [4]], dtype=np.int64)
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {}
+        self.outputs = {"Out": w[ids.reshape(-1)]}
+        self.check_grad(["W"], "Out")
+
+    def test_gather(self):
+        self.op_type = "gather"
+        x = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4], dtype=np.int64)
+        self.inputs = {"X": x, "Index": idx}
+        self.attrs = {}
+        self.outputs = {"Out": x[idx]}
+        self.check_output()
+
+    def test_one_hot(self):
+        self.op_type = "one_hot"
+        x = np.array([[1], [0], [3]], dtype=np.int64)
+        expected = np.zeros((3, 4), dtype=np.float32)
+        expected[np.arange(3), x.reshape(-1)] = 1.0
+        self.inputs = {"X": x}
+        self.attrs = {"depth": 4}
+        self.outputs = {"Out": expected}
+        self.check_output()
+
+    def test_top_k(self):
+        self.op_type = "top_k"
+        x = np.random.rand(3, 6).astype(np.float32)
+        k = 2
+        idx = np.argsort(-x, axis=1)[:, :k]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"k": k}
+        self.outputs = {"Out": vals, "Indices": idx.astype(np.int64)}
+        self.check_output()
+
+
+class TestMisc(OpTest):
+    def test_scale(self):
+        self.op_type = "scale"
+        x = np.random.rand(3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 1.0}
+        self.outputs = {"Out": x * 2.5 + 1.0}
+        self.check_output()
+
+    def test_clip(self):
+        self.op_type = "clip"
+        x = np.random.uniform(-2, 2, (3, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"min": -0.5, "max": 0.5}
+        self.outputs = {"Out": np.clip(x, -0.5, 0.5)}
+        self.check_output()
+
+    def test_cumsum_exclusive_reverse(self):
+        self.op_type = "cumsum"
+        x = np.random.rand(3, 4).astype(np.float32)
+        rev_incl = np.flip(np.cumsum(np.flip(x, 1), axis=1), 1)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "exclusive": True, "reverse": True}
+        self.outputs = {"Out": rev_incl - x}
+        self.check_output(rtol=1e-4)
+
+    def test_sum_op(self):
+        self.op_type = "sum"
+        xs = [np.random.rand(2, 3).astype(np.float32) for _ in range(3)]
+        self.inputs = {"X": [(f"x{i}", x) for i, x in enumerate(xs)]}
+        self.attrs = {}
+        self.outputs = {"Out": xs[0] + xs[1] + xs[2]}
+        self.check_output()
+
+    def test_mean(self):
+        self.op_type = "mean"
+        x = np.random.rand(4, 5).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.array([x.mean()])}
+        self.check_output(rtol=1e-4)

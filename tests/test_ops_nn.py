@@ -1,0 +1,262 @@
+"""NN op correctness (reference test_conv2d_op.py, test_pool2d_op.py,
+test_batch_norm_op.py, test_layer_norm_op.py, test_softmax_op.py,
+test_cross_entropy_op.py, ...)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _conv2d_ref(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oc, oh, ow), dtype=np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+class TestConv2d(OpTest):
+    def test_output(self):
+        self.op_type = "conv2d"
+        x = np.random.rand(2, 3, 6, 6).astype(np.float32)
+        w = np.random.rand(4, 3, 3, 3).astype(np.float32)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": _conv2d_ref(x, w, 1, 1).astype(np.float32)}
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_stride2(self):
+        self.op_type = "conv2d"
+        x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+        w = np.random.rand(3, 2, 3, 3).astype(np.float32)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2], "paddings": [0, 0],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": _conv2d_ref(x, w, 2, 0).astype(np.float32)}
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.op_type = "conv2d"
+        x = np.random.rand(1, 2, 4, 4).astype(np.float32)
+        w = np.random.rand(2, 2, 3, 3).astype(np.float32)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": _conv2d_ref(x, w, 1, 1).astype(np.float32)}
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=2e-2)
+
+
+class TestPool2d(OpTest):
+    def test_max(self):
+        self.op_type = "pool2d"
+        x = np.random.rand(2, 2, 4, 4).astype(np.float32)
+        expected = x.reshape(2, 2, 2, 2, 2, 2).max(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+        self.outputs = {"Out": expected}
+        self.check_output()
+
+    def test_avg(self):
+        self.op_type = "pool2d"
+        x = np.random.rand(2, 2, 4, 4).astype(np.float32)
+        expected = x.reshape(2, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+        self.outputs = {"Out": expected}
+        self.check_output(rtol=1e-4)
+
+    def test_global(self):
+        self.op_type = "pool2d"
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [1, 1],
+                      "strides": [1, 1], "paddings": [0, 0],
+                      "global_pooling": True}
+        self.outputs = {"Out": x.mean(axis=(2, 3), keepdims=True)}
+        self.check_output(rtol=1e-4)
+
+
+class TestBatchNorm(OpTest):
+    def test_train(self):
+        self.op_type = "batch_norm"
+        x = np.random.rand(4, 3, 2, 2).astype(np.float32)
+        scale = np.random.rand(3).astype(np.float32)
+        bias = np.random.rand(3).astype(np.float32)
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        eps, momentum = 1e-5, 0.9
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        y = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(
+            bv.reshape(1, 3, 1, 1) + eps
+        ) * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+                       "Variance": var}
+        self.attrs = {"epsilon": eps, "momentum": momentum, "is_test": False}
+        self.outputs = {
+            "Y": y.astype(np.float32),
+            "MeanOut": (mean * momentum + bm * (1 - momentum)),
+            "VarianceOut": (var * momentum + bv * (1 - momentum)),
+            "SavedMean": bm,
+            "SavedVariance": (1.0 / np.sqrt(bv + eps)),
+        }
+        self.check_output(atol=1e-4, rtol=1e-3)
+
+    def test_inference(self):
+        self.op_type = "batch_norm"
+        x = np.random.rand(4, 3, 2, 2).astype(np.float32)
+        scale = np.random.rand(3).astype(np.float32)
+        bias = np.random.rand(3).astype(np.float32)
+        mean = np.random.rand(3).astype(np.float32)
+        var = (np.random.rand(3) + 0.5).astype(np.float32)
+        eps = 1e-5
+        y = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+            var.reshape(1, 3, 1, 1) + eps
+        ) * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+                       "Variance": var}
+        self.attrs = {"epsilon": eps, "is_test": True}
+        self.outputs = {"Y": y.astype(np.float32)}
+        self.check_output(atol=1e-4, rtol=1e-3, no_check_set=(
+            "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"))
+
+
+class TestLayerNorm(OpTest):
+    def test_output_and_grad(self):
+        self.op_type = "layer_norm"
+        x = np.random.rand(3, 8).astype(np.float32)
+        scale = np.random.rand(8).astype(np.float32)
+        bias = np.random.rand(8).astype(np.float32)
+        eps = 1e-5
+        mu = x.mean(axis=1, keepdims=True)
+        sig = x.var(axis=1, keepdims=True)
+        y = (x - mu) / np.sqrt(sig + eps) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps, "begin_norm_axis": 1}
+        self.outputs = {
+            "Y": y.astype(np.float32),
+            "Mean": mu.reshape(-1),
+            "Variance": sig.reshape(-1),
+        }
+        self.check_output(atol=1e-4, rtol=1e-3)
+        self.check_grad(["X", "Scale", "Bias"], "Y", max_relative_error=2e-2)
+
+
+class TestSoftmaxFamily(OpTest):
+    def test_softmax(self):
+        self.op_type = "softmax"
+        x = np.random.rand(3, 6).astype(np.float32)
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": e / e.sum(axis=1, keepdims=True)}
+        self.check_output(rtol=1e-4)
+
+    def test_softmax_grad(self):
+        self.op_type = "softmax"
+        x = np.random.rand(2, 5).astype(np.float32)
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": e / e.sum(axis=1, keepdims=True)}
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+    def test_cross_entropy(self):
+        self.op_type = "cross_entropy"
+        p = np.random.rand(4, 5).astype(np.float32) + 0.1
+        p /= p.sum(axis=1, keepdims=True)
+        lab = np.array([[0], [2], [4], [1]], dtype=np.int64)
+        expected = -np.log(p[np.arange(4), lab.reshape(-1)]).reshape(4, 1)
+        self.inputs = {"X": p, "Label": lab}
+        self.attrs = {}
+        self.outputs = {"Y": expected.astype(np.float32)}
+        self.check_output(rtol=1e-4)
+
+    def test_softmax_with_cross_entropy_grad(self):
+        self.op_type = "softmax_with_cross_entropy"
+        logits = np.random.rand(3, 5).astype(np.float32)
+        lab = np.array([[1], [0], [4]], dtype=np.int64)
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        sm = e / e.sum(axis=1, keepdims=True)
+        loss = -np.log(sm[np.arange(3), lab.reshape(-1)]).reshape(3, 1)
+        self.inputs = {"Logits": logits, "Label": lab}
+        self.attrs = {}
+        self.outputs = {"Softmax": sm, "Loss": loss.astype(np.float32)}
+        self.check_output(rtol=1e-3, atol=1e-5)
+        self.check_grad(["Logits"], "Loss", max_relative_error=1e-2)
+
+    def test_sigmoid_cross_entropy_with_logits(self):
+        self.op_type = "sigmoid_cross_entropy_with_logits"
+        x = np.random.uniform(-2, 2, (4, 3)).astype(np.float32)
+        lab = np.random.randint(0, 2, (4, 3)).astype(np.float32)
+        expected = np.maximum(x, 0) - x * lab + np.log1p(np.exp(-np.abs(x)))
+        self.inputs = {"X": x, "Label": lab}
+        self.attrs = {}
+        self.outputs = {"Out": expected.astype(np.float32)}
+        self.check_output(rtol=1e-4)
+
+
+class TestActivations(OpTest):
+    @pytest.mark.parametrize(
+        "op,fn",
+        [("relu", lambda x: np.maximum(x, 0)),
+         ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+         ("tanh", np.tanh),
+         ("square", np.square),
+         ("softsign", lambda x: x / (1 + np.abs(x))),
+         ("leaky_relu", lambda x: np.where(x > 0, x, 0.02 * x))],
+    )
+    def test_fwd(self, op, fn):
+        self.op_type = op
+        x = np.random.uniform(-1.5, 1.5, (3, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": fn(x).astype(np.float32)}
+        self.check_output(rtol=1e-4, atol=1e-5)
+
+    def test_tanh_grad(self):
+        self.op_type = "tanh"
+        x = np.random.uniform(-1, 1, (3, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.tanh(x)}
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestDropout(OpTest):
+    def test_is_test_mode(self):
+        self.op_type = "dropout"
+        x = np.random.rand(4, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.3, "is_test": True}
+        self.outputs = {"Out": x * 0.7}
+        self.check_output(no_check_set=("Mask",), rtol=1e-4)
+
+    def test_train_mask_semantics(self):
+        # out == x * mask, mask in {0,1}, drop-rate near p
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid.framework import Program, program_guard
+        from paddle_tpu.fluid import layers
+
+        main, startup, scope = Program(), Program(), fluid.Scope()
+        with fluid.scope_guard(scope):
+            with program_guard(main, startup):
+                x = layers.data(name="x", shape=[1000], dtype="float32")
+                out = layers.dropout(x, dropout_prob=0.4)
+            exe = fluid.Executor()
+            xv = np.ones((2, 1000), np.float32)
+            (o,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        kept = (o != 0).mean()
+        assert abs(kept - 0.6) < 0.05
+        assert set(np.unique(o)) <= {0.0, 1.0}

@@ -1,0 +1,128 @@
+"""Optimizer-op correctness vs numpy references (reference test_sgd_op.py,
+test_momentum_op.py, test_adam_op.py, ...)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestSGD(OpTest):
+    def test_sgd(self):
+        self.op_type = "sgd"
+        p = np.random.rand(4, 3).astype(np.float32)
+        g = np.random.rand(4, 3).astype(np.float32)
+        lr = np.array([0.1], dtype=np.float32)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.attrs = {}
+        self.outputs = {"ParamOut": p - 0.1 * g}
+        self.check_output()
+
+
+class TestMomentum(OpTest):
+    def test_plain(self):
+        self.op_type = "momentum"
+        p = np.random.rand(4).astype(np.float32)
+        g = np.random.rand(4).astype(np.float32)
+        v = np.random.rand(4).astype(np.float32)
+        lr = np.array([0.01], dtype=np.float32)
+        mu = 0.9
+        vn = mu * v + g
+        self.inputs = {"Param": p, "Grad": g, "Velocity": v,
+                       "LearningRate": lr}
+        self.attrs = {"mu": mu}
+        self.outputs = {"ParamOut": p - 0.01 * vn, "VelocityOut": vn}
+        self.check_output(rtol=1e-4)
+
+    def test_nesterov(self):
+        self.op_type = "momentum"
+        p = np.random.rand(4).astype(np.float32)
+        g = np.random.rand(4).astype(np.float32)
+        v = np.random.rand(4).astype(np.float32)
+        lr = np.array([0.01], dtype=np.float32)
+        mu = 0.9
+        vn = mu * v + g
+        self.inputs = {"Param": p, "Grad": g, "Velocity": v,
+                       "LearningRate": lr}
+        self.attrs = {"mu": mu, "use_nesterov": True}
+        self.outputs = {"ParamOut": p - (g + mu * vn) * 0.01,
+                        "VelocityOut": vn}
+        self.check_output(rtol=1e-4)
+
+
+class TestAdam(OpTest):
+    def test_adam(self):
+        self.op_type = "adam"
+        p = np.random.rand(4).astype(np.float32)
+        g = np.random.rand(4).astype(np.float32)
+        m1 = np.random.rand(4).astype(np.float32)
+        m2 = np.random.rand(4).astype(np.float32)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        b1p = np.array([b1 ** 3], dtype=np.float32)
+        b2p = np.array([b2 ** 3], dtype=np.float32)
+        lr = np.array([0.001], dtype=np.float32)
+        m1n = b1 * m1 + (1 - b1) * g
+        m2n = b2 * m2 + (1 - b2) * g * g
+        lr_t = 0.001 * np.sqrt(1 - b2p) / (1 - b1p)
+        pn = p - lr_t * m1n / (np.sqrt(m2n) + eps)
+        self.inputs = {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                       "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": lr}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {
+            "ParamOut": pn.astype(np.float32), "Moment1Out": m1n,
+            "Moment2Out": m2n, "Beta1PowOut": b1p * b1,
+            "Beta2PowOut": b2p * b2,
+        }
+        self.check_output(rtol=1e-4)
+
+
+class TestAdagrad(OpTest):
+    def test_adagrad(self):
+        self.op_type = "adagrad"
+        p = np.random.rand(4).astype(np.float32)
+        g = np.random.rand(4).astype(np.float32)
+        m = np.random.rand(4).astype(np.float32)
+        lr = np.array([0.1], dtype=np.float32)
+        eps = 1e-6
+        mn = m + g * g
+        self.inputs = {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr}
+        self.attrs = {"epsilon": eps}
+        self.outputs = {"ParamOut": p - 0.1 * g / (np.sqrt(mn) + eps),
+                        "MomentOut": mn}
+        self.check_output(rtol=1e-4)
+
+
+class TestRMSProp(OpTest):
+    def test_rmsprop(self):
+        self.op_type = "rmsprop"
+        p = np.random.rand(4).astype(np.float32)
+        g = np.random.rand(4).astype(np.float32)
+        ms = np.random.rand(4).astype(np.float32)
+        mom = np.random.rand(4).astype(np.float32)
+        lr = np.array([0.01], dtype=np.float32)
+        decay, mu, eps = 0.9, 0.0, 1e-10
+        msn = decay * ms + (1 - decay) * g * g
+        momn = mu * mom + 0.01 * g / np.sqrt(msn + eps)
+        self.inputs = {"Param": p, "Grad": g, "MeanSquare": ms, "Moment": mom,
+                       "LearningRate": lr}
+        self.attrs = {"decay": decay, "momentum": mu, "epsilon": eps}
+        self.outputs = {"ParamOut": p - momn, "MeanSquareOut": msn,
+                        "MomentOut": momn}
+        self.check_output(rtol=1e-4)
+
+
+class TestAdadelta(OpTest):
+    def test_adadelta(self):
+        self.op_type = "adadelta"
+        p = np.random.rand(4).astype(np.float32)
+        g = np.random.rand(4).astype(np.float32)
+        asg = np.random.rand(4).astype(np.float32)
+        asu = np.random.rand(4).astype(np.float32)
+        rho, eps = 0.95, 1e-6
+        asgn = rho * asg + (1 - rho) * g * g
+        upd = -np.sqrt((asu + eps) / (asgn + eps)) * g
+        asun = rho * asu + (1 - rho) * upd * upd
+        self.inputs = {"Param": p, "Grad": g, "AvgSquaredGrad": asg,
+                       "AvgSquaredUpdate": asu}
+        self.attrs = {"rho": rho, "epsilon": eps}
+        self.outputs = {"ParamOut": p + upd, "AvgSquaredGradOut": asgn,
+                        "AvgSquaredUpdateOut": asun}
+        self.check_output(rtol=1e-4)

@@ -1,0 +1,261 @@
+"""Sequence ops, fused LSTM/GRU, control flow (reference test_lstm_op.py,
+test_gru_op.py, test_seq_pool.py, test_while_op.py, test_recurrent_op.py)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def _fresh():
+    return Program(), Program(), fluid.Scope()
+
+
+def test_sequence_pool_masking():
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[-1, 4], dtype="float32",
+                            lod_level=1)
+            avg = layers.sequence_pool(x, "average")
+            mx = layers.sequence_pool(x, "max")
+            last = layers.sequence_last_step(x)
+        exe = fluid.Executor()
+        xv = np.arange(2 * 4 * 4, dtype=np.float32).reshape(2, 4, 4)
+        lens = np.array([2, 3], dtype=np.int32)
+        a, m, l = exe.run(main, feed={"x": xv, "x@LEN": lens},
+                          fetch_list=[avg, mx, last])
+        np.testing.assert_allclose(a[0], xv[0, :2].mean(axis=0), rtol=1e-5)
+        np.testing.assert_allclose(a[1], xv[1, :3].mean(axis=0), rtol=1e-5)
+        np.testing.assert_allclose(m[1], xv[1, :3].max(axis=0), rtol=1e-5)
+        np.testing.assert_allclose(l[0], xv[0, 1], rtol=1e-5)
+        np.testing.assert_allclose(l[1], xv[1, 2], rtol=1e-5)
+
+
+def test_data_feeder_ragged():
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="ids", shape=[-1], dtype="int64", lod_level=1)
+        feeder = fluid.DataFeeder(feed_list=[x], program=main)
+        feed = feeder.feed([([1, 2, 3],), ([4, 5],)])
+        assert feed["ids"].shape == (2, 8)  # bucketed to pow2
+        assert feed["ids"][1, 2] == 0
+        np.testing.assert_array_equal(feed["ids@LEN"], [3, 2])
+
+
+def test_lstm_op_masks_and_matches_manual():
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[-1, 8], dtype="float32",
+                            lod_level=1)  # pre-projected 4H, H=2
+            h, c = layers.dynamic_lstm(
+                input=x, size=8, use_peepholes=False,
+                param_attr=fluid.ParamAttr(name="lstm_w"),
+                bias_attr=fluid.ParamAttr(name="lstm_b"),
+            )
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).rand(2, 4, 8).astype(np.float32)
+        lens = np.array([4, 2], dtype=np.int32)
+        hv, cv = exe.run(main, feed={"x": xv, "x@LEN": lens},
+                         fetch_list=[h, c])
+        w = np.asarray(scope.find_var("lstm_w"))
+        b = np.asarray(scope.find_var("lstm_b"))
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        hp = np.zeros((2, 2))
+        cp = np.zeros((2, 2))
+        for t in range(4):
+            g = xv[:, t] + b[None, :] + hp @ w
+            gi, gf, gc, go = np.split(g, 4, axis=1)
+            i, f, o = sig(gi), sig(gf), sig(go)
+            cn = f * cp + i * np.tanh(gc)
+            hn = o * np.tanh(cn)
+            valid = (t < lens)[:, None]
+            hp = np.where(valid, hn, hp)
+            cp = np.where(valid, cn, cp)
+            np.testing.assert_allclose(
+                hv[:, t], np.where(valid, hp, 0), atol=1e-4
+            )
+        # padding region of seq 1 must be zero
+        assert np.abs(hv[1, 2:]).max() == 0
+
+
+def test_gru_layer_runs():
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[-1, 6], dtype="float32",
+                            lod_level=1)
+            h = layers.dynamic_gru(input=x, size=2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.random.rand(3, 4, 6).astype(np.float32)
+        lens = np.array([4, 1, 3], dtype=np.int32)
+        (hv,) = exe.run(main, feed={"x": xv, "x@LEN": lens}, fetch_list=[h])
+        assert hv.shape == (3, 4, 2)
+        assert np.abs(hv[1, 1:]).max() == 0
+
+
+def test_while_loop_sums():
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+            n = layers.fill_constant(shape=[1], dtype="int64", value=10)
+            acc = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+            cond = layers.less_than(i, n)
+            w = layers.While(cond)
+            with w.block():
+                layers.increment(acc, value=2.0)
+                layers.increment(i, value=1)
+                layers.less_than(i, n, cond=cond)
+        exe = fluid.Executor()
+        (res,) = exe.run(main, fetch_list=[acc])
+        np.testing.assert_allclose(res, [20.0])
+
+
+def test_conditional_block():
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[1], dtype="float32",
+                            append_batch_size=False)
+            thresh = layers.fill_constant(shape=[1], dtype="float32", value=0.5)
+            out = layers.fill_constant(shape=[1], dtype="float32", value=-1.0)
+            cond = layers.less_than(thresh, x)  # x > 0.5
+            cb = layers.ConditionalBlock([cond])
+            with cb.block():
+                layers.increment(out, value=2.0)
+        exe = fluid.Executor()
+        (r1,) = exe.run(main, feed={"x": np.array([0.9], np.float32)},
+                        fetch_list=[out])
+        (r2,) = exe.run(main, feed={"x": np.array([0.1], np.float32)},
+                        fetch_list=[out])
+        np.testing.assert_allclose(r1, [1.0])
+        np.testing.assert_allclose(r2, [-1.0])
+
+
+def test_static_rnn_trains():
+    # simple RNN on a cumulative-sum task: output_t should track sum of inputs
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[6, 1], dtype="float32")  # [N,T,1]
+            y = layers.data(name="y", shape=[6, 1], dtype="float32")
+            h0 = layers.fill_constant_batch_size_like(
+                x, shape=[-1, 4], dtype="float32", value=0.0
+            )
+            rnn = layers.StaticRNN()
+            with rnn.step():
+                xt = rnn.step_input(x)
+                h_prev = rnn.memory(init=h0)
+                h = layers.fc(input=[xt, h_prev], size=4, act="tanh")
+                rnn.update_memory(h_prev, h)
+                o = layers.fc(input=h, size=1)
+                rnn.step_output(o)
+            pred = rnn()
+            loss = layers.mean(
+                layers.square_error_cost(input=pred, label=y)
+            )
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xv = rng.rand(8, 6, 1).astype(np.float32)
+        yv = np.cumsum(xv, axis=1).astype(np.float32)
+        losses = []
+        for _ in range(60):
+            (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            losses.append(float(lv[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.2, losses[::15]
+
+
+def test_stacked_lstm_model_trains():
+    from paddle_tpu.models import stacked_lstm
+
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            data = layers.data(name="words", shape=[-1], dtype="int64",
+                               lod_level=1)
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            avg_cost, acc, pred = stacked_lstm.build(
+                data, label, dict_dim=100, emb_dim=16, hid_dim=16,
+                stacked_num=2,
+            )
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        # class-correlated tokens
+        ids = np.zeros((8, 12), dtype=np.int64)
+        lab = rng.randint(0, 2, size=(8, 1)).astype(np.int64)
+        for i in range(8):
+            lo = 0 if lab[i, 0] == 0 else 50
+            ids[i] = rng.randint(lo, lo + 50, size=12)
+        lens = np.full((8,), 12, dtype=np.int32)
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(
+                main,
+                feed={"words": ids, "words@LEN": lens, "label": lab},
+                fetch_list=[avg_cost],
+            )
+            losses.append(float(lv[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_switch_assign_pattern():
+    # the canonical piecewise pattern: assign into an outer var inside a case
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[1], dtype="float32",
+                            append_batch_size=False)
+            half = layers.fill_constant(shape=[1], dtype="float32", value=0.5)
+            out = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+            with layers.Switch() as switch:
+                with switch.case(layers.less_than(x, half)):
+                    layers.assign(np.array([10.0], np.float32), output=out)
+                with switch.default():
+                    layers.assign(np.array([20.0], np.float32), output=out)
+        exe = fluid.Executor()
+        (lo,) = exe.run(main, feed={"x": np.array([0.2], np.float32)},
+                        fetch_list=[out])
+        (hi,) = exe.run(main, feed={"x": np.array([0.8], np.float32)},
+                        fetch_list=[out])
+        np.testing.assert_allclose(lo, [10.0])
+        np.testing.assert_allclose(hi, [20.0])
+
+
+def test_sequence_concat_packs_valid_rows():
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            a = layers.data(name="a", shape=[-1, 2], dtype="float32",
+                            lod_level=1)
+            b = layers.data(name="b", shape=[-1, 2], dtype="float32",
+                            lod_level=1)
+            cc = layers.sequence_concat([a, b])
+            pooled = layers.sequence_pool(cc, "sum")
+        exe = fluid.Executor()
+        av = np.arange(1 * 4 * 2, dtype=np.float32).reshape(1, 4, 2)
+        bv = 100 + np.arange(1 * 4 * 2, dtype=np.float32).reshape(1, 4, 2)
+        r_cc, r_sum = exe.run(
+            main,
+            feed={"a": av, "a@LEN": np.array([2], np.int32),
+                  "b": bv, "b@LEN": np.array([3], np.int32)},
+            fetch_list=[cc, pooled],
+        )
+        # valid rows of b start right after the 2 valid rows of a
+        np.testing.assert_allclose(r_cc[0, :2], av[0, :2])
+        np.testing.assert_allclose(r_cc[0, 2:5], bv[0, :3])
+        expected_sum = av[0, :2].sum(axis=0) + bv[0, :3].sum(axis=0)
+        np.testing.assert_allclose(r_sum[0], expected_sum, rtol=1e-5)
