@@ -5,6 +5,6 @@ same reader-creator API backed by deterministic synthetic data with the real
 shapes/vocab sizes; pass `data_dir`/env PADDLE_TPU_DATA to use real data laid
 out on disk where available.
 """
-from . import cifar, flowers, imdb, mnist, movielens, uci_housing, wmt14  # noqa: F401
+from . import cifar, flowers, imdb, imikolov, mnist, movielens, uci_housing, wmt14  # noqa: F401
 
 __all__ = ["mnist", "cifar", "uci_housing", "imdb", "flowers", "movielens", "wmt14"]

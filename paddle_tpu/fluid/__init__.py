@@ -4,9 +4,11 @@ from . import ops as _ops  # registers all op emitters  # noqa: F401
 from . import (  # noqa: F401
     backward,
     clip,
+    evaluator,
     initializer,
     io,
     layers,
+    metrics,
     nets,
     optimizer,
     param_attr,

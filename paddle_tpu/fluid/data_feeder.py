@@ -15,7 +15,6 @@ class DataToLoDTensorConverter:
         self.shape = [d for d in shape]
         self.dtype = dtype
         self.data = []
-        self.lod = [[0] for _ in range(lod_level)]
 
     def feed(self, data):
         # lod_level>0: keep the ragged sample whole; done() pads + lengths
@@ -25,7 +24,9 @@ class DataToLoDTensorConverter:
         if self.lod_level == 0:
             arr = np.array(self.data, dtype=self.dtype)
             shape = [d if d >= 0 else -1 for d in self.shape]
-            if shape and any(d == -1 for d in shape[1:]):
+            # conform samples to the declared var shape (reference feeds flat
+            # reader rows into e.g. [1,28,28] data vars)
+            if shape[1:] and tuple(arr.shape[1:]) != tuple(shape[1:]):
                 arr = arr.reshape([arr.shape[0]] + [d for d in shape[1:]])
             return arr
         if self.lod_level > 1:

@@ -100,17 +100,22 @@ def zeros_like_array(x):
     return out
 
 
-class BlockGuard:
-    def __init__(self, main_program):
-        self.main_program = main_program
-
-    def __enter__(self):
-        self.block = self.main_program.create_block()
-        return self.block
-
-    def __exit__(self, exc_type, exc_val, exc_tb):
-        self.main_program.rollback()
-        return exc_type is None
+def _scan_block_io(sub, parent_block):
+    """Outer vars a finished sub-block touches: returns (touched, written) —
+    `touched` = sorted outer-var names the block reads OR writes (write-only
+    outer vars still need their pre-loop value as carry init), `written` =
+    output names in first-write order."""
+    read, written = set(), []
+    for op in sub.ops:
+        read.update(n for n in op.desc.input_names() if n)
+        for n in op.desc.output_names():
+            if n and n not in written:
+                written.append(n)
+    touched = sorted(
+        n for n in (read | set(written))
+        if n not in sub.vars and parent_block._var_recursive(n) is not None
+    )
+    return touched, written
 
 
 class While:
@@ -136,21 +141,9 @@ class While:
             yield
         finally:
             main.rollback()
-            # X = outer vars the block reads; Out = written vars with a
+            # X = outer vars the block touches; Out = written vars with a
             # pre-loop value (the emitter's loop carry)
-            read, written = set(), []
-            for op in sub.ops:
-                read.update(n for n in op.desc.input_names() if n)
-                for n in op.desc.output_names():
-                    if n and n not in written:
-                        written.append(n)
-            # X: outer vars the block touches (read OR written — write-only
-            # outer vars still need their pre-loop value as carry init)
-            touched = sorted(
-                n for n in (read | set(written))
-                if n not in sub.vars
-                and parent_block._var_recursive(n) is not None
-            )
+            touched, written = _scan_block_io(sub, parent_block)
             carried = [n for n in written
                        if n in touched or n == self.cond_var.name]
             parent_block.append_op(
@@ -183,17 +176,7 @@ class ConditionalBlock:
             yield
         finally:
             main.rollback()
-            read, written = set(), []
-            for op in sub.ops:
-                read.update(n for n in op.desc.input_names() if n)
-                for n in op.desc.output_names():
-                    if n and n not in written:
-                        written.append(n)
-            touched = sorted(
-                n for n in (read | set(written))
-                if n not in sub.vars
-                and parent_block._var_recursive(n) is not None
-            )
+            touched, written = _scan_block_io(sub, parent_block)
             carried = [n for n in written if n in touched]
             parent_block.append_op(
                 type="conditional_block",
@@ -216,9 +199,6 @@ class Switch:
 
     @contextlib.contextmanager
     def case(self, condition):
-        from .ops import _make_unary  # noqa: F401  (module import side effect)
-        from ..layer_helper import LayerHelper
-
         if self.pre_not_conditions:
             helper = LayerHelper("logical_and")
             combined = helper.create_variable_for_type_inference("bool")

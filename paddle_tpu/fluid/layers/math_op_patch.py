@@ -53,6 +53,7 @@ Variable.__rmul__ = _binary("elementwise_mul", reverse=True)
 Variable.__truediv__ = _binary("elementwise_div")
 Variable.__rtruediv__ = _binary("elementwise_div", reverse=True)
 Variable.__pow__ = _binary("elementwise_pow")
+Variable.__rpow__ = _binary("elementwise_pow", reverse=True)
 Variable.__neg__ = _scale(scale_val=-1.0)
 Variable.__lt__ = _binary("less_than")
 Variable.__le__ = _binary("less_equal")

@@ -20,7 +20,7 @@ __all__ = [
     "elementwise_max", "elementwise_min", "elementwise_pow", "label_smooth",
     "sigmoid_cross_entropy_with_logits", "smooth_l1", "lrn", "expand", "pad",
     "im2sequence", "prelu", "autoincreased_step_counter", "cos_sim",
-    "dot_product_attention",
+    "dot_product_attention", "edit_distance", "chunk_eval",
 ]
 
 
@@ -724,3 +724,54 @@ def dot_product_attention(querys, keys, values):
     product = matmul(querys, keys, transpose_y=True)
     attn = softmax(product)
     return matmul(attn, values), attn
+
+
+def edit_distance(input, label, normalized=False, ignored_tokens=None,
+                  name=None):
+    """reference layers/nn.py edit_distance — returns (distances [N,1],
+    sequence_num [1]). `ignored_tokens` filtering is folded into the op via
+    the attr (dense layout: ignored tokens must be padding-equivalent)."""
+    helper = LayerHelper("edit_distance", name=name)
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    seq_num = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="edit_distance",
+        inputs={"Hyps": [input], "Refs": [label]},
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized,
+               "ignored_tokens": list(ignored_tokens or [])},
+    )
+    return out, seq_num
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """reference layers/nn.py chunk_eval — returns (precision, recall,
+    f1_score, num_infer_chunks, num_label_chunks, num_correct_chunks)."""
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_variable_for_type_inference(dtype="float32")
+    recall = helper.create_variable_for_type_inference(dtype="float32")
+    f1_score = helper.create_variable_for_type_inference(dtype="float32")
+    num_infer_chunks = helper.create_variable_for_type_inference(dtype="int64")
+    num_label_chunks = helper.create_variable_for_type_inference(dtype="int64")
+    num_correct_chunks = helper.create_variable_for_type_inference(dtype="int64")
+    inputs = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        inputs["SeqLength"] = [seq_length]
+    helper.append_op(
+        type="chunk_eval",
+        inputs=inputs,
+        outputs={
+            "Precision": [precision],
+            "Recall": [recall],
+            "F1-Score": [f1_score],
+            "NumInferChunks": [num_infer_chunks],
+            "NumLabelChunks": [num_label_chunks],
+            "NumCorrectChunks": [num_correct_chunks],
+        },
+        attrs={"num_chunk_types": num_chunk_types,
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": list(excluded_chunk_types or [])},
+    )
+    return (precision, recall, f1_score, num_infer_chunks, num_label_chunks,
+            num_correct_chunks)

@@ -94,3 +94,16 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
         weights = layers.dropout(weights, dropout_prob=dropout_rate)
     ctx = layers.matmul(weights, v)
     return _combine_heads(ctx)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max"):
+    """reference python/paddle/fluid/nets.py sequence_conv_pool — text-conv
+    building block used by the sentiment book chapter."""
+    from .layers import sequence
+
+    conv_out = sequence.sequence_conv(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, act=act,
+    )
+    return sequence.sequence_pool(input=conv_out, pool_type=pool_type)

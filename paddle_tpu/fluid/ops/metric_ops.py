@@ -79,3 +79,162 @@ def edit_distance(ctx, ins, attrs):
     dists = jax.vmap(one_pair)(hyps, refs)
     return {"Out": dists.reshape(-1, 1),
             "SequenceNum": jnp.asarray([hyps.shape[0]], dtype=jnp.int64)}
+
+
+@register_op("chunk_eval",
+             no_grad=("Inference", "Label", "SeqLength"),
+             ref="paddle/fluid/operators/chunk_eval_op.cc")
+def chunk_eval(ctx, ins, attrs):
+    """Chunking precision/recall/F1 over dense [N, T] tag-id batches.
+
+    The reference walks LoD sequences token-by-token on the host; here the
+    conlleval start/end rules are evaluated as vectorized masks so the whole
+    metric stays inside the compiled step (TPU-friendly: no host round-trip).
+    Tag encoding (reference chunk_eval_op.h): label = chunk_type * num_tag
+    + tag_type, O = num_chunk_types * num_tag; schemes IOB(2)/IOE(2)/
+    IOBES(4)/plain(1).
+    """
+    import jax
+
+    inference, label = one(ins, "Inference"), one(ins, "Label")
+    seq_length = one(ins, "SeqLength")
+    num_chunk_types = int(attrs["num_chunk_types"])
+    scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = list(attrs.get("excluded_chunk_types", []) or [])
+
+    num_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    # unified tag classes: 0=B 1=I 2=E 3=S 4=O
+    tag_map = {
+        "IOB": [0, 1], "IOE": [1, 2], "IOBES": [0, 1, 2, 3], "plain": [1],
+    }[scheme]
+    O = num_chunk_types * num_tag
+
+    def squeeze2d(x):
+        return x.reshape(x.shape[0], -1)
+
+    inference, label = squeeze2d(inference), squeeze2d(label)
+    N, T = inference.shape
+    pos = jnp.arange(T)
+    if seq_length is not None:
+        valid = pos[None, :] < seq_length.reshape(-1, 1)
+    else:
+        valid = jnp.ones((N, T), dtype=bool)
+
+    tag_lut = jnp.asarray(
+        [tag_map[i % num_tag] for i in range(O)] + [4], dtype=jnp.int32
+    )
+    type_lut_list = [i // num_tag for i in range(O)] + [-1]
+    for i in range(O):
+        if (i // num_tag) in excluded:
+            type_lut_list[i] = -1
+    type_lut = jnp.asarray(type_lut_list, dtype=jnp.int32)
+
+    def masks(seq, valid_row):
+        ids = jnp.clip(seq.astype(jnp.int32), 0, O)
+        tag = jnp.where(valid_row, tag_lut[ids], 4)
+        typ = jnp.where(valid_row, type_lut[ids], -1)
+        tag = jnp.where(typ < 0, 4, tag)  # excluded/O → O
+        prev_tag = jnp.concatenate([jnp.asarray([4], jnp.int32), tag[:-1]])
+        prev_typ = jnp.concatenate([jnp.asarray([-1], jnp.int32), typ[:-1]])
+        next_tag = jnp.concatenate([tag[1:], jnp.asarray([4], jnp.int32)])
+        next_typ = jnp.concatenate([typ[1:], jnp.asarray([-1], jnp.int32)])
+        in_chunk = tag != 4
+        # conlleval start_of_chunk(prev, cur)
+        start = in_chunk & (
+            (tag == 0) | (tag == 3)                     # B or S
+            | jnp.isin(prev_tag, jnp.asarray([2, 3, 4]))  # prev E/S/O
+            | (prev_typ != typ)
+        )
+        # conlleval end_of_chunk evaluated at cur (chunk ends AT cur)
+        end = in_chunk & (
+            (tag == 2) | (tag == 3)                     # E or S
+            | jnp.isin(next_tag, jnp.asarray([0, 3, 4]))  # next B/S/O
+            | (next_typ != typ)
+        )
+        return start, end, typ
+
+    def per_seq(inf_row, lab_row, valid_row):
+        s_g, e_g, t_g = masks(inf_row, valid_row)
+        s_l, e_l, t_l = masks(lab_row, valid_row)
+        big = T + 1
+        idx = jnp.arange(T)
+
+        def next_end(end_mask):
+            cand = jnp.where(end_mask, idx, big)
+            return jnp.flip(jax.lax.cummin(jnp.flip(cand)))
+
+        same_span = next_end(e_g) == next_end(e_l)
+        correct = s_g & s_l & (t_g == t_l) & same_span
+        return (jnp.sum(s_g), jnp.sum(s_l), jnp.sum(correct))
+
+    n_inf, n_lab, n_cor = jax.vmap(per_seq)(inference, label, valid)
+    num_infer = jnp.sum(n_inf).astype(jnp.int64)
+    num_label = jnp.sum(n_lab).astype(jnp.int64)
+    num_correct = jnp.sum(n_cor).astype(jnp.int64)
+    inf_f = jnp.maximum(num_infer.astype(jnp.float32), 1.0)
+    lab_f = jnp.maximum(num_label.astype(jnp.float32), 1.0)
+    precision = num_correct.astype(jnp.float32) / inf_f
+    recall = num_correct.astype(jnp.float32) / lab_f
+    f1 = jnp.where(
+        num_correct > 0,
+        2.0 * precision * recall / jnp.maximum(precision + recall, 1e-12),
+        0.0,
+    )
+    return {
+        "Precision": precision.reshape((1,)),
+        "Recall": recall.reshape((1,)),
+        "F1-Score": f1.reshape((1,)),
+        "NumInferChunks": num_infer.reshape((1,)),
+        "NumLabelChunks": num_label.reshape((1,)),
+        "NumCorrectChunks": num_correct.reshape((1,)),
+    }
+
+
+@register_op("precision_recall",
+             no_grad=("MaxProbs", "Indices", "Labels", "Weights",
+                      "StatesInfo"),
+             ref="paddle/fluid/operators/precision_recall_op.cc")
+def precision_recall(ctx, ins, attrs):
+    """Per-class TP/FP/TN/FN stats + macro/micro precision/recall/F1,
+    accumulated across batches via the StatesInfo input."""
+    indices, labels = one(ins, "Indices"), one(ins, "Labels")
+    weights = one(ins, "Weights")
+    states = one(ins, "StatesInfo")
+    cls_num = int(attrs["class_number"])
+
+    pred = indices.reshape(-1).astype(jnp.int32)
+    lab = labels.reshape(-1).astype(jnp.int32)
+    w = (weights.reshape(-1).astype(jnp.float32)
+         if weights is not None else jnp.ones_like(pred, jnp.float32))
+
+    cls = jnp.arange(cls_num)[:, None]
+    is_pred = pred[None, :] == cls
+    is_lab = lab[None, :] == cls
+    tp = jnp.sum(jnp.where(is_pred & is_lab, w[None, :], 0.0), axis=1)
+    fp = jnp.sum(jnp.where(is_pred & ~is_lab, w[None, :], 0.0), axis=1)
+    fn = jnp.sum(jnp.where(~is_pred & is_lab, w[None, :], 0.0), axis=1)
+    tn = jnp.sum(jnp.where(~is_pred & ~is_lab, w[None, :], 0.0), axis=1)
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)
+    accum = batch_states if states is None else batch_states + states
+
+    def prf(st):
+        tp_, fp_, tn_, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12), 0.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0)
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        stp, sfp, sfn = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        mprec = jnp.where(stp + sfp > 0, stp / jnp.maximum(stp + sfp, 1e-12), 0.0)
+        mrec = jnp.where(stp + sfn > 0, stp / jnp.maximum(stp + sfn, 1e-12), 0.0)
+        mf1 = jnp.where(mprec + mrec > 0,
+                        2 * mprec * mrec / jnp.maximum(mprec + mrec, 1e-12), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mprec, mrec, mf1])])
+
+    batch_metrics = prf(batch_states)
+    accum_metrics = prf(accum)
+    return {
+        "BatchMetrics": batch_metrics,
+        "AccumMetrics": accum_metrics,
+        "AccumStatesInfo": accum,
+    }

@@ -159,3 +159,48 @@ def proximal_adagrad(ctx, ins, attrs):
     prox = p - lr_t * g
     pn = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0) / (1.0 + lr_t * l2)
     return {"ParamOut": pn, "MomentOut": mn}
+
+
+@register_op("average_accumulates",
+             no_grad=("Param",),
+             ref="paddle/fluid/operators/average_accumulates_op.cc")
+def average_accumulates(ctx, ins, attrs):
+    """ModelAverage accumulator update: windowed running sums of the param.
+    sum_1 accumulates recent steps; every max_average_window steps it is
+    folded into sum_2; when the accumulation window closes, sums move to
+    sum_3 and counters reset (mirrors the reference kernel's branch logic,
+    expressed as jnp.where so it stays trace-friendly)."""
+    param = one(ins, "Param")
+    sum_1, sum_2, sum_3 = one(ins, "Sum1"), one(ins, "Sum2"), one(ins, "Sum3")
+    num_acc = one(ins, "NumAccumulates").reshape(()).astype(jnp.int64)
+    old_num_acc = one(ins, "OldNumAccumulates").reshape(()).astype(jnp.int64)
+    num_upd = one(ins, "NumUpdates").reshape(()).astype(jnp.int64)
+    avg_window = float(attrs.get("average_window", 0.0))
+    max_avg_win = int(attrs.get("max_average_window", 2 ** 31 - 1))
+    min_avg_win = int(attrs.get("min_average_window", 10000))
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    sum_1 = sum_1 + param
+
+    fold = num_upd % max_avg_win == 0
+    sum_2 = jnp.where(fold, sum_2 + sum_1, sum_2)
+    sum_1 = jnp.where(fold, jnp.zeros_like(sum_1), sum_1)
+
+    window = jnp.minimum(
+        jnp.asarray(max_avg_win, jnp.float32),
+        num_upd.astype(jnp.float32) * avg_window,
+    )
+    close = (num_acc >= min_avg_win) & (num_acc.astype(jnp.float32) >= window)
+    sum_3 = jnp.where(close, sum_1 + sum_2, sum_3)
+    sum_1 = jnp.where(close, jnp.zeros_like(sum_1), sum_1)
+    sum_2 = jnp.where(close, jnp.zeros_like(sum_2), sum_2)
+    old_num_acc = jnp.where(close, num_acc, old_num_acc)
+    num_acc = jnp.where(close, jnp.zeros_like(num_acc), num_acc)
+
+    return {
+        "SumOut1": sum_1, "SumOut2": sum_2, "SumOut3": sum_3,
+        "NumAccumulatesOut": num_acc.reshape((1,)),
+        "OldNumAccumulatesOut": old_num_acc.reshape((1,)),
+        "NumUpdatesOut": num_upd.reshape((1,)),
+    }

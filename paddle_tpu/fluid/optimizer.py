@@ -5,6 +5,8 @@ Adadelta:594, RMSProp:676): minimize = append_backward + regularization +
 clip + per-param device-side optimizer ops with accumulators."""
 from __future__ import annotations
 
+import contextlib
+
 from collections import defaultdict
 from typing import Optional
 
@@ -410,6 +412,136 @@ class FtrlOptimizer(Optimizer):
             },
             attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
         )
+
+
+class ModelAverage(Optimizer):
+    """Polyak-style windowed parameter averaging (reference optimizer.py:811):
+    appends an `average_accumulates` op per parameter to the main program;
+    `apply()` swaps averaged values into the params (context manager),
+    `restore()` swaps the live values back."""
+
+    def __init__(self, average_window_rate, params_grads=None,
+                 min_average_window=10000, max_average_window=10000, **kwargs):
+        super().__init__(0.0, **kwargs)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self.params_grads = [] if params_grads is None else list(params_grads)
+
+        main = default_main_program()
+        existing = {p.name for p, _ in self.params_grads}
+        for param in main.global_block().all_parameters():
+            if param.name not in existing and getattr(param, "trainable", True):
+                self.params_grads.append((param, None))
+
+        self.helper = LayerHelper("model_average")
+        for param, _ in self.params_grads:
+            self._append_average_accumulate_op(param)
+
+        self.apply_program = Program()
+        block = self.apply_program.global_block()
+        with program_guard(main_program=self.apply_program):
+            for param_grad in self.params_grads:
+                self._add_average_apply_op(block, param_grad)
+
+        self.restore_program = Program()
+        block = self.restore_program.global_block()
+        with program_guard(main_program=self.restore_program):
+            for param_grad in self.params_grads:
+                self._add_average_restore_op(block, param_grad)
+
+    def _clone(self, block, var):
+        return block.create_var(
+            name=var.name, shape=var.shape, dtype=var.dtype, persistable=True
+        )
+
+    def _add_average_apply_op(self, block, param_grad):
+        from .layers import tensor as tensor_layers
+
+        param = self._clone(block, param_grad[0])
+        backup = block.create_var(
+            name=param.name + "@BACKUP", shape=param.shape, dtype=param.dtype,
+            persistable=True,
+        )
+        sum_1 = self._clone(block, self._get_accumulator("sum_1", param_grad[0]))
+        sum_2 = self._clone(block, self._get_accumulator("sum_2", param_grad[0]))
+        sum_3 = self._clone(block, self._get_accumulator("sum_3", param_grad[0]))
+        num_accumulates = self._clone(
+            block, self._get_accumulator("num_accumulates", param_grad[0])
+        )
+        old_num_accumulates = self._clone(
+            block, self._get_accumulator("old_num_accumulates", param_grad[0])
+        )
+        # backup current value, then param = total_sum / total_count
+        tensor_layers.assign(input=param, output=backup)
+        total = tensor_layers.sums(input=[sum_1, sum_2, sum_3])
+        count = tensor_layers.cast(
+            tensor_layers.sums(input=[num_accumulates, old_num_accumulates]),
+            "float32",
+        )
+        block.append_op(
+            type="elementwise_div",
+            inputs={"X": [total], "Y": [count]},
+            outputs={"Out": [param]},
+            attrs={"axis": -1},
+        )
+
+    def _add_average_restore_op(self, block, param_grad):
+        from .layers import tensor as tensor_layers
+
+        param = self._clone(block, param_grad[0])
+        backup = block.create_var(
+            name=param.name + "@BACKUP", shape=param.shape, dtype=param.dtype,
+            persistable=True,
+        )
+        tensor_layers.assign(input=backup, output=param)
+
+    def _append_average_accumulate_op(self, param):
+        self.helper = LayerHelper("average_accumulate")
+        sum_1 = self._add_accumulator("sum_1", param)
+        sum_2 = self._add_accumulator("sum_2", param)
+        sum_3 = self._add_accumulator("sum_3", param)
+        num_accumulates = self._add_accumulator(
+            "num_accumulates", param, dtype="int64", shape=[1]
+        )
+        old_num_accumulates = self._add_accumulator(
+            "old_num_accumulates", param, dtype="int64", shape=[1]
+        )
+        num_updates = self._add_accumulator(
+            "num_updates", param, dtype="int64", shape=[1]
+        )
+        self.helper.append_op(
+            type="average_accumulates",
+            inputs={
+                "Param": [param], "Sum1": [sum_1], "Sum2": [sum_2],
+                "Sum3": [sum_3], "NumAccumulates": [num_accumulates],
+                "OldNumAccumulates": [old_num_accumulates],
+                "NumUpdates": [num_updates],
+            },
+            outputs={
+                "SumOut1": [sum_1], "SumOut2": [sum_2], "SumOut3": [sum_3],
+                "NumAccumulatesOut": [num_accumulates],
+                "OldNumAccumulatesOut": [old_num_accumulates],
+                "NumUpdatesOut": [num_updates],
+            },
+            attrs={
+                "average_window": self.average_window,
+                "min_average_window": self.min_average_window,
+                "max_average_window": self.max_average_window,
+            },
+        )
+
+    @contextlib.contextmanager
+    def apply(self, executor, need_restore=True):
+        executor.run(self.apply_program)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor):
+        executor.run(self.restore_program)
 
 
 # reference exposes short aliases

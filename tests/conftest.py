@@ -20,3 +20,16 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 assert len(jax.devices()) == 8, jax.devices()
+
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_numpy():
+    """Deterministic test data: OpTest subclasses draw inputs from the global
+    numpy RNG with tight float32 gradient tolerances — unseeded draws made
+    e.g. TestLayerNorm flaky (~1 in 6)."""
+    np.random.seed(90210)
+    yield

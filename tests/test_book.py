@@ -1,0 +1,209 @@
+"""End-to-end "book" chapters (reference python/paddle/fluid/tests/book/):
+full train loops on dataset readers, assert loss decreases, save + reload
+inference models. recognize_digits / word2vec / understand_sentiment here;
+fit_a_line lives in test_fit_a_line.py, machine_translation with the
+beam-search decoder in test_machine_translation.py."""
+import tempfile
+
+import numpy as np
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def _train(main, startup, scope, feeder, reader, avg_cost, n_epochs):
+    exe = fluid.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(n_epochs):
+        for data in reader():
+            (loss,) = exe.run(main, feed=feeder.feed(data),
+                              fetch_list=[avg_cost])
+            losses.append(float(np.asarray(loss).reshape(-1)[0]))
+    return exe, losses
+
+
+def test_recognize_digits_conv():
+    """reference tests/book/test_recognize_digits.py (conv variant)."""
+    from paddle_tpu.models import lenet
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    main.random_seed = startup.random_seed = 7
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            img = layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            avg_cost, acc, prediction = lenet.build(img, label)
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+
+        reader = paddle_tpu.batch(
+            paddle_tpu.dataset.mnist.train(), batch_size=64
+        )
+        feeder = fluid.DataFeeder(feed_list=[img, label])
+
+        def limited():
+            for i, d in enumerate(reader()):
+                if i >= 12:
+                    break
+                yield d
+
+        exe, losses = _train(main, startup, scope, feeder, limited,
+                             avg_cost, 2)
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+        assert np.isfinite(losses[-1])
+
+        with tempfile.TemporaryDirectory() as tmp:
+            fluid.save_inference_model(tmp, ["img"], [prediction], exe, main)
+            scope2 = fluid.Scope()
+            with fluid.scope_guard(scope2):
+                exe2 = fluid.Executor()
+                prog2, feeds, fetches = fluid.load_inference_model(tmp, exe2)
+                x = np.random.RandomState(3).rand(4, 1, 28, 28).astype(
+                    np.float32
+                )
+                (probs,) = exe2.run(prog2, feed={feeds[0]: x},
+                                    fetch_list=fetches)
+                probs = np.asarray(probs)
+                assert probs.shape == (4, 10)
+                np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_word2vec():
+    """reference tests/book/test_word2vec.py — n-gram next-word model."""
+    EMBED_SIZE, HIDDEN_SIZE, N = 32, 64, 5
+    word_dict = paddle_tpu.dataset.imikolov.build_dict()
+    dict_size = len(word_dict)
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    main.random_seed = startup.random_seed = 13
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            words = [
+                layers.data(name=f"word_{i}", shape=[1], dtype="int64")
+                for i in range(N - 1)
+            ]
+            next_word = layers.data(name="next_word", shape=[1], dtype="int64")
+            embeds = [
+                layers.embedding(
+                    input=w, size=[dict_size, EMBED_SIZE],
+                    param_attr=fluid.ParamAttr(name="shared_w"),
+                )
+                for w in words
+            ]
+            concat = layers.concat(input=embeds, axis=1)
+            hidden = layers.fc(input=concat, size=HIDDEN_SIZE, act="sigmoid")
+            logits = layers.fc(input=hidden, size=dict_size)
+            cost = layers.softmax_with_cross_entropy(
+                logits=logits, label=next_word
+            )
+            avg_cost = layers.mean(cost)
+            fluid.optimizer.Adam(learning_rate=5e-3).minimize(avg_cost)
+
+        reader = paddle_tpu.batch(
+            paddle_tpu.dataset.imikolov.train(word_dict, N), batch_size=64
+        )
+        feeder = fluid.DataFeeder(feed_list=words + [next_word])
+
+        def limited():
+            for i, d in enumerate(reader()):
+                if i >= 40:
+                    break
+                yield d
+
+        exe, losses = _train(main, startup, scope, feeder, limited,
+                             avg_cost, 5)
+        # the synthetic chain is 85% deterministic → loss drops hard
+        assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_understand_sentiment_conv():
+    """reference tests/book/test_understand_sentiment.py (convolution_net)."""
+    from paddle_tpu.fluid import nets
+
+    word_dict = paddle_tpu.dataset.imdb.word_dict()
+    dict_dim, emb_dim, hid_dim, class_dim = len(word_dict), 32, 32, 2
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    main.random_seed = startup.random_seed = 17
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            data = layers.data(name="words", shape=[1], dtype="int64",
+                               lod_level=1)
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            emb = layers.embedding(input=data, size=[dict_dim, emb_dim])
+            conv_3 = nets.sequence_conv_pool(
+                input=emb, num_filters=hid_dim, filter_size=3, act="tanh",
+                pool_type="sqrt",
+            )
+            conv_4 = nets.sequence_conv_pool(
+                input=emb, num_filters=hid_dim, filter_size=4, act="tanh",
+                pool_type="sqrt",
+            )
+            merged = layers.concat(input=[conv_3, conv_4], axis=1)
+            logits = layers.fc(input=merged, size=class_dim)
+            cost = layers.softmax_with_cross_entropy(logits=logits,
+                                                     label=label)
+            avg_cost = layers.mean(cost)
+            prediction = layers.softmax(logits)
+            acc = layers.accuracy(input=prediction, label=label)
+            fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+
+        reader = paddle_tpu.batch(
+            paddle_tpu.dataset.imdb.train(word_dict), batch_size=32
+        )
+        feeder = fluid.DataFeeder(feed_list=[data, label])
+
+        def limited():
+            for i, d in enumerate(reader()):
+                if i >= 10:
+                    break
+                yield d
+
+        exe, losses = _train(main, startup, scope, feeder, limited,
+                             avg_cost, 3)
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+        # accuracy on a fresh batch should beat chance on the synthetic signal
+        batch = next(iter(reader()))
+        (a,) = exe.run(main, feed=feeder.feed(batch), fetch_list=[acc])
+        assert float(np.asarray(a).reshape(-1)[0]) > 0.55
+
+
+def test_understand_sentiment_dynamic_lstm():
+    """reference stacked_lstm_net variant, on the dynamic LSTM stack."""
+    word_dict = paddle_tpu.dataset.imdb.word_dict()
+    dict_dim, emb_dim, hid_dim, class_dim = len(word_dict), 32, 32, 2
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    main.random_seed = startup.random_seed = 23
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            data = layers.data(name="words", shape=[1], dtype="int64",
+                               lod_level=1)
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            emb = layers.embedding(input=data, size=[dict_dim, emb_dim])
+            fc1 = layers.fc(input=emb, size=hid_dim * 4, num_flatten_dims=2)
+            lstm1, _ = layers.dynamic_lstm(input=fc1, size=hid_dim * 4)
+            lstm_last = layers.sequence_last_step(lstm1)
+            logits = layers.fc(input=lstm_last, size=class_dim)
+            cost = layers.softmax_with_cross_entropy(logits=logits,
+                                                     label=label)
+            avg_cost = layers.mean(cost)
+            fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+
+        reader = paddle_tpu.batch(
+            paddle_tpu.dataset.imdb.train(word_dict), batch_size=32
+        )
+        feeder = fluid.DataFeeder(feed_list=[data, label])
+
+        def limited():
+            for i, d in enumerate(reader()):
+                if i >= 6:
+                    break
+                yield d
+
+        exe, losses = _train(main, startup, scope, feeder, limited,
+                             avg_cost, 2)
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+        assert np.isfinite(losses[-1])

@@ -1,0 +1,237 @@
+"""LR schedules, metrics accumulators, graph evaluators, ModelAverage
+(reference tests: test_learning_rate_decay.py, test_metrics/evaluator usage
+in book chapters, test_model_average — capability parity)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def _run_schedule(build_fn, n_steps):
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            lr = build_fn()
+        exe = fluid.Executor()
+        exe.run(startup)
+        vals = []
+        for _ in range(n_steps):
+            (v,) = exe.run(main, fetch_list=[lr])
+            vals.append(float(np.asarray(v).reshape(-1)[0]))
+    return vals
+
+
+def test_exponential_decay():
+    vals = _run_schedule(
+        lambda: layers.exponential_decay(0.1, decay_steps=2, decay_rate=0.5),
+        4,
+    )
+    # first observed step is 0 (counter inits to begin-1, increments pre-read)
+    for i, v in enumerate(vals):
+        assert math.isclose(v, 0.1 * 0.5 ** (i / 2.0), rel_tol=1e-5), (i, v)
+
+
+def test_exponential_decay_staircase():
+    vals = _run_schedule(
+        lambda: layers.exponential_decay(
+            0.1, decay_steps=2, decay_rate=0.5, staircase=True
+        ),
+        4,
+    )
+    want = [0.1 * 0.5 ** math.floor(i / 2.0) for i in range(4)]
+    np.testing.assert_allclose(vals, want, rtol=1e-5)
+
+
+def test_natural_exp_and_inverse_time_decay():
+    vals = _run_schedule(
+        lambda: layers.natural_exp_decay(0.1, decay_steps=1, decay_rate=0.5),
+        3,
+    )
+    want = [0.1 * math.exp(-0.5 * i) for i in range(3)]
+    np.testing.assert_allclose(vals, want, rtol=1e-5)
+
+    vals = _run_schedule(
+        lambda: layers.inverse_time_decay(0.1, decay_steps=1, decay_rate=0.5),
+        3,
+    )
+    want = [0.1 / (1 + 0.5 * i) for i in range(3)]
+    np.testing.assert_allclose(vals, want, rtol=1e-5)
+
+
+def test_polynomial_decay():
+    vals = _run_schedule(
+        lambda: layers.polynomial_decay(
+            0.1, decay_steps=4, end_learning_rate=0.01, power=1.0
+        ),
+        6,
+    )
+    for i, v in enumerate(vals):
+        step = min(i, 4)
+        want = (0.1 - 0.01) * (1 - step / 4.0) + 0.01
+        assert math.isclose(v, want, rel_tol=1e-5), (i, v, want)
+
+
+def test_piecewise_decay():
+    vals = _run_schedule(
+        lambda: layers.piecewise_decay([2, 4], [1.0, 0.5, 0.1]), 6
+    )
+    want = [1.0, 1.0, 0.5, 0.5, 0.1, 0.1]
+    np.testing.assert_allclose(vals, want, rtol=1e-6)
+
+
+def test_lr_schedule_drives_sgd():
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[1], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="float32")
+            pred = layers.fc(input=x, size=1)
+            cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+            lr = layers.exponential_decay(0.05, decay_steps=1, decay_rate=0.9)
+            fluid.optimizer.SGD(learning_rate=lr).minimize(cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).rand(8, 1).astype("float32")
+        yv = 3 * xv + 1
+        losses = [
+            float(
+                np.asarray(
+                    exe.run(main, feed={"x": xv, "y": yv},
+                            fetch_list=[cost])[0]
+                ).reshape(-1)[0]
+            )
+            for _ in range(20)
+        ]
+        assert losses[-1] < losses[0]
+
+
+def test_metrics_accuracy_and_auc():
+    from paddle_tpu.fluid.metrics import Accuracy, Auc, CompositeMetric
+
+    acc = Accuracy()
+    acc.update(0.5, 10)
+    acc.update(1.0, 10)
+    assert math.isclose(acc.eval(), 0.75)
+    acc.reset()
+    acc.update(0.2, 5)
+    assert math.isclose(acc.eval(), 0.2)
+
+    auc = Auc(num_thresholds=200)
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, size=200)
+    # informative scores → AUC well above 0.5
+    scores = 0.7 * labels + 0.3 * rng.rand(200)
+    preds = np.stack([1 - scores, scores], axis=1)
+    auc.update(preds, labels)
+    assert auc.eval() > 0.8
+
+    comp = CompositeMetric()
+    comp.add_metric(Accuracy())
+    comp._metrics[0].update(1.0, 2)
+    assert comp.eval() == [1.0]
+
+
+def test_metrics_chunk_and_edit_distance():
+    from paddle_tpu.fluid.metrics import ChunkEvaluator, EditDistance
+
+    ch = ChunkEvaluator()
+    ch.update(10, 8, 4)
+    precision, recall, f1 = ch.eval()
+    assert math.isclose(precision, 0.4) and math.isclose(recall, 0.5)
+    assert math.isclose(f1, 2 * 0.4 * 0.5 / 0.9)
+
+    ed = EditDistance()
+    ed.update(np.array([[1.0], [0.0], [3.0]]), 3)
+    avg, err = ed.eval()
+    assert math.isclose(avg, 4.0 / 3)
+    assert math.isclose(err, 2.0 / 3)
+
+
+def test_chunk_eval_op_iob():
+    # B-PER I-PER O B-LOC → labels with num_tag=2: B=t*2, I=t*2+1, O=4
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            inf = layers.data(name="inf", shape=[6], dtype="int64")
+            lab = layers.data(name="lab", shape=[6], dtype="int64")
+            outs = layers.chunk_eval(
+                input=inf, label=lab, chunk_scheme="IOB", num_chunk_types=2
+            )
+        exe = fluid.Executor()
+        label = np.array([[0, 1, 4, 2, 3, 4]], dtype=np.int64)  # PER, LOC
+        good = np.array([[0, 1, 4, 2, 3, 4]], dtype=np.int64)   # both right
+        half = np.array([[0, 4, 4, 2, 3, 4]], dtype=np.int64)   # PER trunc
+        r = exe.run(main, feed={"inf": good, "lab": label},
+                    fetch_list=list(outs))
+        precision, recall, f1, ni, nl, nc = [np.asarray(v) for v in r]
+        assert ni[0] == 2 and nl[0] == 2 and nc[0] == 2
+        assert precision[0] == 1.0 and recall[0] == 1.0 and f1[0] == 1.0
+        r = exe.run(main, feed={"inf": half, "lab": label},
+                    fetch_list=list(outs))
+        precision, recall, f1, ni, nl, nc = [np.asarray(v) for v in r]
+        # "B-PER" alone is a different span than "B-PER I-PER" → only LOC OK
+        assert ni[0] == 2 and nl[0] == 2 and nc[0] == 1
+
+
+def test_evaluator_accuracy():
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            scores = layers.data(name="scores", shape=[4], dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            evaluator = fluid.evaluator.Accuracy(input=scores, label=label)
+        exe = fluid.Executor()
+        exe.run(startup)
+        evaluator.reset(exe)
+        rng = np.random.RandomState(1)
+        total, correct = 0, 0
+        for _ in range(3):
+            s = rng.rand(8, 4).astype("float32")
+            lbl = rng.randint(0, 4, size=(8, 1)).astype("int64")
+            exe.run(main, feed={"scores": s, "label": lbl},
+                    fetch_list=evaluator.metrics)
+            correct += int(np.sum(np.argmax(s, 1) == lbl.reshape(-1)))
+            total += 8
+        got = float(np.asarray(evaluator.eval(exe)).reshape(-1)[0])
+        assert math.isclose(got, correct / total, rel_tol=1e-6)
+
+
+def test_model_average():
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[1], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="float32")
+            pred = layers.fc(input=x, size=1, bias_attr=False)
+            cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+            model_average = fluid.optimizer.ModelAverage(
+                0.5, min_average_window=2, max_average_window=10
+            )
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.ones((4, 1), dtype="float32")
+        yv = 2 * xv
+        param_name = main.global_block().all_parameters()[0].name
+        seen = []
+        for _ in range(5):
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[cost])
+            seen.append(
+                float(np.asarray(fluid.fetch_var(param_name, scope)).reshape(-1)[0])
+            )
+        live = float(np.asarray(fluid.fetch_var(param_name, scope)).reshape(-1)[0])
+        with model_average.apply(exe):
+            avg = float(
+                np.asarray(fluid.fetch_var(param_name, scope)).reshape(-1)[0]
+            )
+            # averaged value lies strictly inside the visited range
+            assert min(seen) - 1e-6 <= avg <= max(seen) + 1e-6
+            assert not math.isclose(avg, live, rel_tol=1e-9)
+        restored = float(
+            np.asarray(fluid.fetch_var(param_name, scope)).reshape(-1)[0]
+        )
+        assert math.isclose(restored, live, rel_tol=1e-6)
