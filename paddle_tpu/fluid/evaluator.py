@@ -182,7 +182,7 @@ class ChunkEvaluator(Evaluator):
     (reference evaluator.py:232)."""
 
     def __init__(self, input, label, chunk_scheme, num_chunk_types,
-                 excluded_chunk_types=None, **kwargs):
+                 excluded_chunk_types=None, seq_length=None, **kwargs):
         super().__init__("chunk_eval", **kwargs)
         main_program = self.helper.main_program
         if main_program.current_block().idx != 0:
@@ -204,6 +204,7 @@ class ChunkEvaluator(Evaluator):
             input=input, label=label, chunk_scheme=chunk_scheme,
             num_chunk_types=num_chunk_types,
             excluded_chunk_types=excluded_chunk_types,
+            seq_length=seq_length,
         )
         tensor.sums(
             input=[self.num_infer_chunks, num_infer_chunks],

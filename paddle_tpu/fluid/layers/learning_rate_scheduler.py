@@ -26,8 +26,8 @@ __all__ = [
 
 
 def _decay_step_counter(begin=0):
-    # float32 global step, starting at `begin` (first observed value begin+1
-    # matches the reference, which increments before the decay math)
+    # float32 global step; the first observed value is `begin` (the counter
+    # increments after the decay math reads it)
     global_step = nn.autoincreased_step_counter(
         counter_name="@LR_DECAY_COUNTER@", begin=begin, step=1
     )

@@ -5,16 +5,22 @@ SURVEY.md §2.4). Importing this package registers all ops.
 """
 from . import (  # noqa: F401
     activations,
+    beam_search_ops,
     compare_ops,
     control_flow,
+    crf_ops,
+    ctc_ops,
+    detection_ops,
     elementwise,
     loss_ops,
     math_ops,
     metric_ops,
+    nce_op,
     nn_ops,
     optimizer_ops,
     random_ops,
     reduce_ops,
     sequence_ops,
     tensor_ops,
+    vision_ops,
 )

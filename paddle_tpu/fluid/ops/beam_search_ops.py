@@ -1,0 +1,101 @@
+"""Beam search ops (reference paddle/fluid/operators/beam_search_op.cc and
+beam_search_decode_op.cc).
+
+The reference keeps beams as LoD levels (source → beam items) and shrinks
+finished beams on the host. TPU redesign: fixed [B, beam] state the whole
+way — finished beams are frozen by forcing end_id with additive-zero score,
+so every step is the same static-shape XLA computation (this is how JAX
+decoders, e.g. flax/t5x, handle it). beam_search_decode backtracks the
+stacked (ids, parents) arrays with a lax.scan.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+@register_op("beam_search", no_grad=("PreIds", "PreScores", "Ids", "Scores"),
+             ref="paddle/fluid/operators/beam_search_op.cc")
+def beam_search(ctx, ins, attrs):
+    """One expansion step.
+
+    Inputs: PreIds [B, beam] (last step's tokens), PreScores [B, beam]
+    (cumulative log-probs), Scores [B, beam, V] (this step's log-probs;
+    `Ids` optional pre-pruned candidate ids [B, beam, V]).
+    Attrs: beam_size, end_id, level (ignored — LoD artifact).
+    Outputs: SelectedIds [B, beam], SelectedScores [B, beam],
+    ParentIdx [B, beam] (which beam each selection extends).
+    """
+    pre_ids = one(ins, "PreIds")
+    pre_scores = one(ins, "PreScores")
+    ids = one(ins, "Ids")
+    scores = one(ins, "Scores")
+    beam_size = int(attrs.get("beam_size", scores.shape[1]))
+    end_id = int(attrs.get("end_id", 0))
+
+    B, K, V = scores.shape
+    finished = pre_ids == end_id
+    # finished beams contribute exactly one candidate: end_id at unchanged
+    # cumulative score; live beams add their log-probs
+    total = pre_scores[:, :, None] + scores  # [B, K, V]
+    vocab = jnp.arange(V)[None, None, :] if ids is None else ids
+    keep_end = vocab == end_id
+    frozen = jnp.where(keep_end, pre_scores[:, :, None],
+                       jnp.asarray(-jnp.inf, total.dtype))
+    total = jnp.where(finished[:, :, None], frozen, total)
+
+    flat = total.reshape(B, K * V)
+    top_scores, top_pos = jax.lax.top_k(flat, beam_size)
+    parent = (top_pos // V).astype(jnp.int32)
+    token_pos = top_pos % V
+    if ids is None:
+        sel_ids = token_pos.astype(jnp.int64)
+    else:
+        sel_ids = jnp.take_along_axis(
+            ids.reshape(B, K * V), top_pos, axis=1).astype(jnp.int64)
+    return {"SelectedIds": sel_ids, "SelectedScores": top_scores,
+            "ParentIdx": parent}
+
+
+@register_op("beam_search_decode",
+             no_grad=("Ids", "Scores", "Parents", "Lengths"),
+             ref="paddle/fluid/operators/beam_search_decode_op.cc")
+def beam_search_decode(ctx, ins, attrs):
+    """Backtrack stacked beam steps into full sequences.
+
+    Inputs: Ids [T, B, beam] selected tokens per step, Parents [T, B, beam],
+    Scores [T, B, beam] cumulative scores.
+    Outputs: SentenceIds [B, beam, T] (end_id-padded), SentenceScores
+    [B, beam] (final cumulative score per hypothesis).
+    """
+    ids = jnp.asarray(one(ins, "Ids"))
+    parents = jnp.asarray(one(ins, "Parents"))
+    scores = jnp.asarray(one(ins, "Scores"))
+    end_id = int(attrs.get("end_id", 0))
+
+    T, B, K = ids.shape
+
+    def backtrack(step_ids, step_parents):
+        # walk from last step to first, carrying beam slot per hypothesis
+        slot0 = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (B, K))
+
+        def body(slot, t):
+            tok = jnp.take_along_axis(step_ids[t], slot, axis=1)  # [B, K]
+            par = jnp.take_along_axis(step_parents[t], slot, axis=1)
+            return par.astype(jnp.int32), tok
+
+        _, toks_rev = jax.lax.scan(body, slot0, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(toks_rev, axis=0)  # [T, B, K]
+
+    seq = backtrack(ids, parents)  # [T, B, K]
+    seq = jnp.transpose(seq, (1, 2, 0))  # [B, K, T]
+    # freeze everything after the first end_id to end_id
+    is_end = seq == end_id
+    seen = jnp.cumsum(is_end.astype(jnp.int32), axis=2) > 0
+    seq = jnp.where(seen, end_id, seq)
+    final_scores = scores[-1]  # [B, K]
+    return {"SentenceIds": seq.astype(jnp.int64),
+            "SentenceScores": final_scores}

@@ -1,0 +1,144 @@
+"""Linear-chain CRF ops (reference paddle/fluid/operators/
+{linear_chain_crf,crf_decoding}_op.*).
+
+The reference runs per-sequence host loops over LoD slices; here the
+forward-backward recursion is a `lax.scan` over the padded time axis with a
+length mask, so a whole batch trains as one XLA computation (log-space for
+stability — the reference tracks per-step scale factors instead).
+
+Transition layout matches the reference (linear_chain_crf_op.h): row 0 =
+start weights a, row 1 = end weights b, rows 2.. = w[i][j] transition from
+tag i to tag j; Transition shape [D+2, D].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+def _crf_log_alpha(emission, transition, lengths):
+    """emission [N, T, D] log-potentials, transition [D+2, D], lengths [N].
+    Returns per-sequence log partition [N]."""
+    a, b, w = transition[0], transition[1], transition[2:]
+    N, T, D = emission.shape
+    alpha0 = a[None, :] + emission[:, 0]  # [N, D]
+
+    def step(alpha, xs):
+        em_t, t = xs  # [N, D], scalar
+        # logsumexp_i alpha[i] + w[i, j]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + w[None, :, :], axis=1) + em_t
+        valid = (t < lengths)[:, None]
+        return jnp.where(valid, nxt, alpha), None
+
+    ts = jnp.arange(1, T)
+    alpha, _ = jax.lax.scan(step, alpha0,
+                            (jnp.swapaxes(emission[:, 1:], 0, 1), ts))
+    return jax.nn.logsumexp(alpha + b[None, :], axis=1)
+
+
+def _crf_path_score(emission, transition, label, lengths):
+    """Score of the gold path, log-space. label [N, T] int."""
+    a, b, w = transition[0], transition[1], transition[2:]
+    N, T, D = emission.shape
+    lab = jnp.clip(label.astype(jnp.int32), 0, D - 1)
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < lengths[:, None]
+    em = jnp.take_along_axis(emission, lab[:, :, None], axis=2)[:, :, 0]
+    em_score = jnp.sum(jnp.where(valid, em, 0.0), axis=1)
+    trans = w[lab[:, :-1], lab[:, 1:]]  # [N, T-1]
+    trans_valid = valid[:, 1:]
+    trans_score = jnp.sum(jnp.where(trans_valid, trans, 0.0), axis=1)
+    last = jnp.clip(lengths - 1, 0, T - 1).astype(jnp.int32)
+    last_lab = jnp.take_along_axis(lab, last[:, None], axis=1)[:, 0]
+    return a[lab[:, 0]] + em_score + trans_score + b[last_lab]
+
+
+@register_op("linear_chain_crf", no_grad=("Label", "Lengths"),
+             ref="paddle/fluid/operators/linear_chain_crf_op.cc")
+def linear_chain_crf(ctx, ins, attrs):
+    """Negative log-likelihood per sequence. Inputs Emission [N, T, D] (raw
+    scores; the reference internally exponentiates — we stay in log space),
+    Transition [D+2, D], Label [N, T]; optional Lengths [N]."""
+    emission = one(ins, "Emission")
+    transition = one(ins, "Transition")
+    label = one(ins, "Label")
+    lengths = one(ins, "Lengths")
+    if label.ndim == 3 and label.shape[-1] == 1:
+        label = label[..., 0]
+    N, T = emission.shape[0], emission.shape[1]
+    if lengths is None:
+        lengths = jnp.full((N,), T, jnp.int32)
+    log_z = _crf_log_alpha(emission, transition, lengths)
+    gold = _crf_path_score(emission, transition, label, lengths)
+    ll = log_z - gold  # NLL
+    return {
+        "LogLikelihood": ll.reshape(-1, 1),
+        # reference also emits normalized per-step potentials; expose the raw
+        # emission back (Alpha kept for API shape parity)
+        "Alpha": emission,
+        "EmissionExps": emission,
+        "TransitionExps": transition,
+    }
+
+
+@register_op("crf_decoding", no_grad=("Emission", "Transition", "Label",
+                                      "Lengths"),
+             ref="paddle/fluid/operators/crf_decoding_op.cc")
+def crf_decoding(ctx, ins, attrs):
+    """Viterbi decode. With Label given, outputs 1 where the viterbi path
+    agrees with the label (reference semantics); else the path itself."""
+    emission = one(ins, "Emission")
+    transition = one(ins, "Transition")
+    label = one(ins, "Label")
+    lengths = one(ins, "Lengths")
+    a, b, w = transition[0], transition[1], transition[2:]
+    N, T, D = emission.shape
+    if lengths is None:
+        lengths = jnp.full((N,), T, jnp.int32)
+
+    delta0 = a[None, :] + emission[:, 0]
+
+    def step(delta, xs):
+        em_t, t = xs
+        scores = delta[:, :, None] + w[None, :, :]  # [N, D_from, D_to]
+        best = jnp.max(scores, axis=1) + em_t
+        arg = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        valid = (t < lengths)[:, None]
+        return jnp.where(valid, best, delta), jnp.where(valid, arg, -1)
+
+    ts = jnp.arange(1, T)
+    delta, back = jax.lax.scan(step, delta0,
+                               (jnp.swapaxes(emission[:, 1:], 0, 1), ts))
+    back = jnp.swapaxes(back, 0, 1)  # [N, T-1, D]
+
+    # add end weights at each sequence's true last step
+    final = delta + b[None, :]
+    last_tag = jnp.argmax(final, axis=1).astype(jnp.int32)  # [N]
+
+    # backtrace emits the tag at each visited t (t from T-1 down to 1); the
+    # final carry is the tag at t=0
+    def backtrace_full(bp, lt, ln):
+        def body(carry, t):
+            tag = carry
+            ptr = bp[t - 1]
+            prev = jnp.where(t < ln, ptr[tag], tag)
+            prev = jnp.where(prev < 0, tag, prev)
+            return prev, tag
+
+        t0_tag, tags_rev = jax.lax.scan(body, lt, jnp.arange(T - 1, 0, -1))
+        return jnp.concatenate([t0_tag[None], jnp.flip(tags_rev)])
+
+    path = jax.vmap(backtrace_full)(back, last_tag, lengths)  # [N, T]
+    t_idx = jnp.arange(T)[None, :]
+    path = jnp.where(t_idx < lengths[:, None], path, 0)
+
+    if label is not None:
+        if label.ndim == 3 and label.shape[-1] == 1:
+            label = label[..., 0]
+        agree = (path == label.astype(jnp.int32)).astype(jnp.int64)
+        agree = jnp.where(t_idx < lengths[:, None], agree, 0)
+        return {"ViterbiPath": agree}
+    return {"ViterbiPath": path.astype(jnp.int64)}

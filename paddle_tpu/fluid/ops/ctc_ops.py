@@ -1,0 +1,125 @@
+"""CTC ops (reference paddle/fluid/operators/{warpctc,ctc_align}_op.*).
+
+The reference dlopens Baidu warp-ctc (platform/dynload/warpctc); here CTC
+loss is the standard log-space alpha recursion as a `lax.scan` over time with
+length masks — one fused XLA computation, batched over N, differentiable by
+jax.vjp (no hand-written grad kernel needed).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+NEG_INF = -1e30
+
+
+def _ctc_loss_one(logp, label, in_len, lab_len, blank):
+    """logp [T, C] log-probs, label [L] int, scalar lens. Returns -log p(l|x).
+
+    Standard extended-label alpha recursion (Graves 2006): S = 2L+1 states
+    interleaving blanks; transitions self / prev / prev-prev (skip only
+    between distinct non-blank labels).
+    """
+    T, C = logp.shape
+    L = label.shape[0]
+    S = 2 * L + 1
+    lab = jnp.clip(label.astype(jnp.int32), 0, C - 1)
+    # extended label sequence: [blank, l0, blank, l1, ..., blank]
+    ext = jnp.full((S,), blank, jnp.int32).at[1::2].set(lab)
+    s_idx = jnp.arange(S)
+    # skip allowed where ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((2,), -1, jnp.int32), ext[:-2]])
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    valid_s = s_idx < (2 * lab_len + 1)
+    alpha0 = jnp.full((S,), NEG_INF)
+    alpha0 = alpha0.at[0].set(logp[0, blank])
+    alpha0 = jnp.where((s_idx == 1) & (lab_len > 0),
+                       logp[0, ext[1]], alpha0)
+    alpha0 = jnp.where(valid_s, alpha0, NEG_INF)
+
+    def lse(a, b):
+        m = jnp.maximum(a, b)
+        m_ok = jnp.maximum(m, NEG_INF)
+        return m_ok + jnp.log(jnp.exp(a - m_ok) + jnp.exp(b - m_ok))
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate([jnp.full((1,), NEG_INF), alpha[:-1]])
+        prev2 = jnp.concatenate([jnp.full((2,), NEG_INF), alpha[:-2]])
+        acc = lse(alpha, prev1)
+        acc = jnp.where(can_skip, lse(acc, prev2), acc)
+        new = acc + logp[t, ext]
+        new = jnp.where(valid_s, new, NEG_INF)
+        return jnp.where(t < in_len, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    endL = jnp.clip(2 * lab_len, 0, S - 1)      # final blank
+    endL1 = jnp.clip(2 * lab_len - 1, 0, S - 1)  # final label
+    ll = lse(alpha[endL], jnp.where(lab_len > 0, alpha[endL1], NEG_INF))
+    return -ll
+
+
+@register_op("warpctc", no_grad=("Label", "LogitsLength", "LabelLength"),
+             ref="paddle/fluid/operators/warpctc_op.cc")
+def warpctc(ctx, ins, attrs):
+    """Inputs: Logits [N, T, C] raw activations (softmax applied inside, as
+    warp-ctc does), Label [N, L] padded with -1 (or blank), optional
+    LogitsLength [N] / LabelLength [N]. Output Loss [N, 1]."""
+    logits = one(ins, "Logits")
+    label = one(ins, "Label")
+    in_len = one(ins, "LogitsLength")
+    lab_len = one(ins, "LabelLength")
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = bool(attrs.get("norm_by_times", False))
+
+    N, T, C = logits.shape
+    if label.ndim == 3 and label.shape[-1] == 1:
+        label = label[..., 0]
+    if in_len is None:
+        in_len = jnp.full((N,), T, jnp.int32)
+    if lab_len is None:
+        lab_len = jnp.sum((label >= 0) & (label != blank), axis=1).astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    loss = jax.vmap(_ctc_loss_one, in_axes=(0, 0, 0, 0, None))(
+        logp, label, in_len.reshape(-1), lab_len.reshape(-1), blank)
+    if norm_by_times:
+        loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1.0)
+    return {"Loss": loss.reshape(-1, 1),
+            "WarpCTCGrad": jnp.zeros_like(logits)}
+
+
+@register_op("ctc_align", no_grad=("Input", "InputLength"),
+             ref="paddle/fluid/operators/ctc_align_op.cc")
+def ctc_align(ctx, ins, attrs):
+    """CTC greedy-decode post-processing: merge repeats, drop blanks.
+    Input [N, T] argmax'd token ids; Output [N, T] left-packed with -1 pad
+    (the reference emits variable-length LoD; dense pad is the static
+    equivalent)."""
+    x = one(ins, "Input")
+    in_len = one(ins, "InputLength")
+    blank = int(attrs.get("blank", 0))
+    merge_repeated = bool(attrs.get("merge_repeated", True))
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x[..., 0]
+    x = x.astype(jnp.int32)
+    N, T = x.shape
+    if in_len is None:
+        in_len = jnp.full((N,), T, jnp.int32)
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < in_len.reshape(-1, 1)
+    prev = jnp.concatenate([jnp.full((N, 1), -1, jnp.int32), x[:, :-1]], axis=1)
+    keep = valid & (x != blank)
+    if merge_repeated:
+        keep = keep & (x != prev)
+    # left-pack kept tokens: kept token t goes to slot cumsum(keep)[t]-1;
+    # discarded tokens scatter into an overflow slot T that is sliced away
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    scatter_pos = jnp.where(keep, pos, T)
+    out = jnp.full((N, T + 1), -1, jnp.int32)
+    out = jax.vmap(lambda o, p, xv: o.at[p].set(xv))(
+        out, scatter_pos, jnp.where(keep, x, -1))[:, :T]
+    count = jnp.sum(keep.astype(jnp.int32), axis=1)
+    return {"Output": out, "OutputLength": count.reshape(-1, 1)}

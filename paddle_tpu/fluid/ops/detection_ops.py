@@ -1,0 +1,455 @@
+"""SSD-style detection ops (reference paddle/fluid/operators/{prior_box,
+box_coder,iou_similarity,bipartite_match,target_assign,multiclass_nms,
+mine_hard_examples}_op.* and detection_map_op.*).
+
+TPU redesign notes: the reference's detection ops walk LoD sequences and use
+host-side sorts/greedy loops. Here everything is dense [N, P, ...] with a
+fixed prior/box count so the whole SSD loss lives in one XLA computation;
+greedy data-dependent loops (bipartite match, NMS) become `lax`-friendly
+fixed-trip-count loops with masking, which XLA maps onto the VPU without
+host sync.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+def _iou_matrix(a, b, eps=1e-10):
+    """a: [M, 4], b: [N, 4] (xmin, ymin, xmax, ymax) -> [M, N] IoU."""
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0, None) * jnp.clip(a[:, 3] - a[:, 1], 0, None)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0, None) * jnp.clip(b[:, 3] - b[:, 1], 0, None)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, eps)
+
+
+@register_op("iou_similarity", no_grad=("X", "Y"),
+             ref="paddle/fluid/operators/iou_similarity_op.cc")
+def iou_similarity(ctx, ins, attrs):
+    x, y = one(ins, "X"), one(ins, "Y")
+    if x.ndim == 3:  # batched [B, M, 4] x [N, 4]
+        return {"Out": jax.vmap(lambda xb: _iou_matrix(xb, y))(x)}
+    return {"Out": _iou_matrix(x, y)}
+
+
+@register_op("prior_box", no_grad=("Input", "Image"),
+             ref="paddle/fluid/operators/prior_box_op.cc")
+def prior_box(ctx, ins, attrs):
+    """Generate SSD prior boxes for one feature map.
+
+    Inputs: Input [N, C, H, W] feature map, Image [N, C, IH, IW].
+    Outputs: Boxes [H, W, num_priors, 4], Variances same shape.
+    """
+    feat, image = one(ins, "Input"), one(ins, "Image")
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", []) or []]
+    aspect_ratios = [float(a) for a in attrs.get("aspect_ratios", [1.0])]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    flip = bool(attrs.get("flip", False))
+    clip = bool(attrs.get("clip", False))
+    step_w = float(attrs.get("step_w", 0.0))
+    step_h = float(attrs.get("step_h", 0.0))
+    offset = float(attrs.get("offset", 0.5))
+
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = image.shape[2], image.shape[3]
+    if step_w == 0.0 or step_h == 0.0:
+        step_w, step_h = IW / W, IH / H
+
+    # expanded aspect ratios as in the reference (1.0 first, optional flips)
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if abs(ar - 1.0) > 1e-6:
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    widths, heights = [], []
+    for ms in min_sizes:
+        for ar in ars:
+            widths.append(ms * (ar ** 0.5))
+            heights.append(ms / (ar ** 0.5))
+        for Ms in max_sizes:
+            widths.append((ms * Ms) ** 0.5)
+            heights.append((ms * Ms) ** 0.5)
+    num_priors = len(widths)
+    bw = jnp.asarray(widths, jnp.float32) * 0.5
+    bh = jnp.asarray(heights, jnp.float32) * 0.5
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cx = cx[None, :, None]  # [1, W, 1]
+    cy = cy[:, None, None]  # [H, 1, 1]
+    boxes = jnp.stack(
+        [
+            jnp.broadcast_to((cx - bw) / IW, (H, W, num_priors)),
+            jnp.broadcast_to((cy - bh) / IH, (H, W, num_priors)),
+            jnp.broadcast_to((cx + bw) / IW, (H, W, num_priors)),
+            jnp.broadcast_to((cy + bh) / IH, (H, W, num_priors)),
+        ],
+        axis=-1,
+    )
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, num_priors, 4))
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("box_coder", no_grad=("PriorBox", "PriorBoxVar"),
+             ref="paddle/fluid/operators/box_coder_op.cc")
+def box_coder(ctx, ins, attrs):
+    """Encode target boxes against priors, or decode predicted offsets.
+
+    PriorBox [P, 4], PriorBoxVar [P, 4], TargetBox:
+      encode_center_size: [M, 4] -> Out [M, P, 4]
+      decode_center_size: [M, P, 4] (or [P, 4]) -> Out same
+    """
+    prior = one(ins, "PriorBox")
+    prior_var = one(ins, "PriorBoxVar")
+    target = one(ins, "TargetBox")
+    code_type = str(attrs.get("code_type", "encode_center_size"))
+    box_normalized = bool(attrs.get("box_normalized", True))
+
+    off = 0.0 if box_normalized else 1.0
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if prior_var is None:
+        prior_var = jnp.ones_like(prior)
+
+    if code_type == "encode_center_size":
+        tw = target[..., 2] - target[..., 0] + off
+        th = target[..., 3] - target[..., 1] + off
+        tcx = target[..., 0] + tw * 0.5
+        tcy = target[..., 1] + th * 0.5
+        if target.ndim == 3:
+            # paired encode: target [N, P, 4] where row p is already matched
+            # to prior p (ssd_loss loc targets) -> out [N, P, 4]
+            ox = (tcx - pcx[None, :]) / pw[None, :] / prior_var[None, :, 0]
+            oy = (tcy - pcy[None, :]) / ph[None, :] / prior_var[None, :, 1]
+            ow = jnp.log(jnp.maximum(jnp.abs(tw / pw[None, :]), 1e-10)) \
+                / prior_var[None, :, 2]
+            oh = jnp.log(jnp.maximum(jnp.abs(th / ph[None, :]), 1e-10)) \
+                / prior_var[None, :, 3]
+        else:
+            # all-pairs encode: target [M, 4] -> out [M, P, 4]
+            ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / prior_var[None, :, 0]
+            oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / prior_var[None, :, 1]
+            ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :])) / prior_var[None, :, 2]
+            oh = jnp.log(jnp.abs(th[:, None] / ph[None, :])) / prior_var[None, :, 3]
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+    elif code_type == "decode_center_size":
+        t = target if target.ndim == 3 else target[None, :, :]
+        dcx = prior_var[None, :, 0] * t[..., 0] * pw[None, :] + pcx[None, :]
+        dcy = prior_var[None, :, 1] * t[..., 1] * ph[None, :] + pcy[None, :]
+        dw = jnp.exp(prior_var[None, :, 2] * t[..., 2]) * pw[None, :]
+        dh = jnp.exp(prior_var[None, :, 3] * t[..., 3]) * ph[None, :]
+        out = jnp.stack(
+            [dcx - dw * 0.5, dcy - dh * 0.5,
+             dcx + dw * 0.5 - off, dcy + dh * 0.5 - off],
+            axis=-1,
+        )
+        if target.ndim == 2:
+            out = out[0]
+    else:
+        raise ValueError(f"unknown code_type {code_type}")
+    return {"OutputBox": out}
+
+
+@register_op("bipartite_match", no_grad=("DistMat",),
+             ref="paddle/fluid/operators/bipartite_match_op.cc")
+def bipartite_match(ctx, ins, attrs):
+    """Greedy bipartite matching on a [M, N] distance (similarity) matrix:
+    repeatedly take the global argmax, match that row/col pair, mask both out
+    (M rounds). Then remaining unmatched columns get their best row if
+    match_type == 'per_prediction' and dist > overlap_threshold.
+
+    Outputs ColToRowMatchIndices [1, N] (-1 = unmatched) and
+    ColToRowMatchDist [1, N]. Reference handles LoD batches; dense batch via
+    a leading batch dim is vmapped.
+    """
+    dist = one(ins, "DistMat")
+    match_type = str(attrs.get("match_type", "bipartite"))
+    thresh = float(attrs.get("dist_threshold", 0.5))
+
+    def match_one(d):
+        M, N = d.shape
+        NEG = jnp.asarray(-1e9, d.dtype)
+
+        def body(_, state):
+            dm, row_idx, row_dist = state
+            flat = jnp.argmax(dm)
+            i, j = flat // N, flat % N
+            best = dm[i, j]
+            do = best > 0
+            row_idx = jnp.where(do, row_idx.at[j].set(i.astype(jnp.int32)), row_idx)
+            row_dist = jnp.where(do, row_dist.at[j].set(best), row_dist)
+            dm = jnp.where(do, dm.at[i, :].set(NEG).at[:, j].set(NEG), dm)
+            return dm, row_idx, row_dist
+
+        row_idx = jnp.full((N,), -1, jnp.int32)
+        row_dist = jnp.zeros((N,), d.dtype)
+        _, row_idx, row_dist = jax.lax.fori_loop(
+            0, min(M, N), body, (d, row_idx, row_dist))
+
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best_val = jnp.max(d, axis=0)
+            take = (row_idx < 0) & (best_val > thresh)
+            row_idx = jnp.where(take, best_row, row_idx)
+            row_dist = jnp.where(take, best_val, row_dist)
+        return row_idx, row_dist
+
+    if dist.ndim == 3:
+        idx, dval = jax.vmap(match_one)(dist)
+    else:
+        idx, dval = match_one(dist)
+        idx, dval = idx[None, :], dval[None, :]
+    return {"ColToRowMatchIndices": idx, "ColToRowMatchDist": dval}
+
+
+@register_op("target_assign", no_grad=("X", "MatchIndices", "NegIndices"),
+             ref="paddle/fluid/operators/target_assign_op.cc")
+def target_assign(ctx, ins, attrs):
+    """Assign per-prior targets from per-image gt rows via MatchIndices.
+
+    X: [B, M, K] gt entities per image (dense; reference uses LoD),
+    MatchIndices: [B, P] (-1 = background). Out [B, P, K], OutWeight [B, P, 1]
+    (mismatch_value where unmatched, weight 0)."""
+    x = one(ins, "X")
+    match = one(ins, "MatchIndices")
+    neg = one(ins, "NegIndices")
+    mismatch_value = attrs.get("mismatch_value", 0)
+
+    if x.ndim == 2:
+        x = x[None]
+    B, P = match.shape
+    safe = jnp.clip(match, 0, x.shape[1] - 1)
+    gathered = jnp.take_along_axis(
+        x, safe[:, :, None].astype(jnp.int32), axis=1)  # [B, P, K]
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch_value, x.dtype))
+    w = matched.astype(jnp.float32)
+    if neg is not None:
+        # negative indices also get weight 1 (for conf loss on hard negatives)
+        neg = neg.reshape(B, -1).astype(jnp.int32)
+        neg_mask = jnp.zeros((B, P), jnp.float32)
+        valid = neg >= 0
+        neg_mask = jax.vmap(
+            lambda nm, nn, vv: nm.at[jnp.where(vv, nn, 0)].add(
+                jnp.where(vv, 1.0, 0.0))
+        )(neg_mask, jnp.clip(neg, 0, P - 1), valid)
+        w = jnp.clip(w + neg_mask[:, :, None], 0.0, 1.0)
+    return {"Out": out, "OutWeight": w}
+
+
+@register_op("mine_hard_examples",
+             no_grad=("ClsLoss", "LocLoss", "MatchIndices", "MatchDist"),
+             ref="paddle/fluid/operators/mine_hard_examples_op.cc")
+def mine_hard_examples(ctx, ins, attrs):
+    """OHEM negative mining: rank negatives by conf loss, keep top
+    neg_pos_ratio * num_pos (max_negative mining). Outputs NegIndices as a
+    dense [B, P] int32 with -1 padding plus UpdatedMatchIndices."""
+    cls_loss = one(ins, "ClsLoss")          # [B, P]
+    loc_loss = one(ins, "LocLoss")
+    match = one(ins, "MatchIndices")        # [B, P]
+    neg_pos_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_dist_threshold = float(attrs.get("neg_dist_threshold", 0.5))
+    match_dist = one(ins, "MatchDist")
+
+    loss = cls_loss if loc_loss is None else cls_loss + loc_loss
+    is_neg = match < 0
+    if match_dist is not None:
+        is_neg = is_neg & (match_dist < neg_dist_threshold)
+    num_pos = jnp.sum((match >= 0).astype(jnp.int32), axis=1)  # [B]
+    num_neg = jnp.minimum(
+        (num_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32),
+        jnp.sum(is_neg.astype(jnp.int32), axis=1),
+    )
+
+    NEG = jnp.asarray(-jnp.inf, loss.dtype)
+    neg_loss = jnp.where(is_neg, loss, NEG)
+    order = jnp.argsort(-neg_loss, axis=1).astype(jnp.int32)  # best-first
+    P = match.shape[1]
+    rank = jnp.arange(P)[None, :]
+    keep = rank < num_neg[:, None]
+    neg_indices = jnp.where(keep, order, -1)
+    updated = jnp.where(match >= 0, match, -1)
+    return {"NegIndices": neg_indices, "UpdatedMatchIndices": updated}
+
+
+@register_op("multiclass_nms", no_grad=("BBoxes", "Scores"),
+             ref="paddle/fluid/operators/multiclass_nms_op.cc")
+def multiclass_nms(ctx, ins, attrs):
+    """Per-class greedy NMS with fixed output size (XLA-static).
+
+    BBoxes [B, P, 4], Scores [B, C, P]. Out: [B, keep_top_k, 6]
+    (label, score, xmin, ymin, xmax, ymax), padded with label=-1.
+    The reference emits a LoD tensor of variable detections; dense padding is
+    the TPU-native equivalent.
+    """
+    bboxes = one(ins, "BBoxes")
+    scores = one(ins, "Scores")
+    score_threshold = float(attrs.get("score_threshold", 0.01))
+    nms_threshold = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    background_label = int(attrs.get("background_label", 0))
+    nms_eta = float(attrs.get("nms_eta", 1.0))
+
+    if bboxes.ndim == 2:
+        bboxes, scores = bboxes[None], scores[None]
+    B, P, _ = bboxes.shape
+    C = scores.shape[1]
+    k = min(nms_top_k, P)
+
+    def nms_one_class(boxes, sc):
+        """boxes [P,4], sc [P] -> (scores[k], idx[k]) kept (masked with -1).
+        nms_eta < 1 shrinks the threshold after each kept box while it stays
+        above 0.5 (the reference's adaptive NMS)."""
+        top_sc, top_idx = jax.lax.top_k(sc, k)
+        top_boxes = boxes[top_idx]
+        iou = _iou_matrix(top_boxes, top_boxes)
+
+        def body(i, state):
+            keep, thresh = state
+            # suppress i if any earlier kept box overlaps > current threshold
+            overlap = (iou[i] > thresh) & keep & (jnp.arange(k) < i)
+            sup = jnp.any(overlap)
+            ok = (~sup) & (top_sc[i] > score_threshold)
+            shrink = ok & (nms_eta < 1.0) & (thresh > 0.5)
+            thresh = jnp.where(shrink, thresh * nms_eta, thresh)
+            return keep.at[i].set(ok), thresh
+
+        keep, _ = jax.lax.fori_loop(
+            0, k, body,
+            (jnp.zeros((k,), bool), jnp.asarray(nms_threshold, jnp.float32)))
+        return jnp.where(keep, top_sc, -1.0), top_idx, keep
+
+    def per_image(boxes, sc):
+        # run per class (skip background), gather into [C*k] then keep_top_k
+        all_scores, all_boxes, all_labels = [], [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s, idx, keep = nms_one_class(boxes, sc[c])
+            all_scores.append(jnp.where(keep, s, -1.0))
+            all_boxes.append(boxes[idx])
+            all_labels.append(jnp.full((k,), c, jnp.float32))
+        cs = jnp.concatenate(all_scores)
+        cb = jnp.concatenate(all_boxes)
+        cl = jnp.concatenate(all_labels)
+        kk = min(keep_top_k, cs.shape[0])
+        top_s, top_i = jax.lax.top_k(cs, kk)
+        live = top_s > 0
+        out = jnp.concatenate(
+            [jnp.where(live, cl[top_i], -1.0)[:, None],
+             top_s[:, None],
+             jnp.where(live[:, None], cb[top_i], -1.0)], axis=1)
+        if kk < keep_top_k:
+            pad = jnp.full((keep_top_k - kk, 6), -1.0, out.dtype)
+            out = jnp.concatenate([out, pad], axis=0)
+        return out
+
+    return {"Out": jax.vmap(per_image)(bboxes, scores)}
+
+
+@register_op("detection_map",
+             no_grad=("DetectRes", "Label", "HasState", "PosCount",
+                      "TruePos", "FalsePos"),
+             ref="paddle/fluid/operators/detection_map_op.cc")
+def detection_map(ctx, ins, attrs):
+    """Single-batch mean average precision over dense padded detections.
+
+    DetectRes [B, D, 6] (label, score, box; label<0 = pad),
+    Label [B, G, 6] (label, xmin, ymin, xmax, ymax, difficult) or [B, G, 5].
+    Computes 11-point interpolated or integral mAP in-graph.
+    """
+    det = one(ins, "DetectRes")
+    gt = one(ins, "Label")
+    overlap_threshold = float(attrs.get("overlap_threshold", 0.5))
+    ap_type = str(attrs.get("ap_type", "integral"))
+    class_num = int(attrs.get("class_num", 21))
+    background_label = int(attrs.get("background_label", 0))
+    evaluate_difficult = bool(attrs.get("evaluate_difficult", True))
+
+    if det.ndim == 2:
+        det, gt = det[None], gt[None]
+    B, D, _ = det.shape
+    G = gt.shape[1]
+    has_difficult = gt.shape[2] == 6
+    gt_box = gt[..., 1:5]
+    gt_label = gt[..., 0].astype(jnp.int32)
+    gt_valid = gt_label >= 0
+    if has_difficult and not evaluate_difficult:
+        gt_valid = gt_valid & (gt[..., 5] < 0.5)
+
+    def per_image(d, gbox, glab, gval):
+        iou = _iou_matrix(d[:, 2:6], gbox)  # [D, G]
+        dlab = d[:, 0].astype(jnp.int32)
+        same = dlab[:, None] == glab[None, :]
+        iou = jnp.where(same & gval[None, :], iou, 0.0)
+
+        # greedy per-image matching in score order (det already sorted or not;
+        # sort to be safe)
+        order = jnp.argsort(-d[:, 1]).astype(jnp.int32)
+
+        def body(t, state):
+            used, tp = state
+            i = order[t]
+            row = jnp.where(used, 0.0, iou[i])
+            j = jnp.argmax(row)
+            ok = (row[j] >= overlap_threshold) & (dlab[i] >= 0)
+            used = jnp.where(ok, used.at[j].set(True), used)
+            tp = tp.at[i].set(ok)
+            return used, tp
+
+        used0 = jnp.zeros((G,), bool)
+        _, tp = jax.lax.fori_loop(0, D, body,
+                                  (used0, jnp.zeros((D,), bool)))
+        return tp
+
+    tp = jax.vmap(per_image)(det, gt_box, gt_label, gt_valid)  # [B, D]
+    dlab = det[..., 0].astype(jnp.int32)
+    dscore = det[..., 1]
+    dvalid = dlab >= 0
+
+    aps = []
+    for c in range(class_num):
+        if c == background_label:
+            continue
+        m = dvalid & (dlab == c)
+        npos = jnp.sum(gt_valid & (gt_label == c))
+        sc = jnp.where(m, dscore, -jnp.inf).reshape(-1)
+        tpc = (tp & m).reshape(-1)
+        order = jnp.argsort(-sc)
+        tps = jnp.cumsum(tpc[order].astype(jnp.float32))
+        valid_sorted = m.reshape(-1)[order]
+        fps = jnp.cumsum((valid_sorted & ~tpc[order]).astype(jnp.float32))
+        rec = tps / jnp.maximum(npos.astype(jnp.float32), 1.0)
+        prec = tps / jnp.maximum(tps + fps, 1e-12)
+        if ap_type == "11point":
+            pts = jnp.linspace(0, 1, 11)
+            pmax = jax.vmap(
+                lambda r: jnp.max(jnp.where(rec >= r, prec, 0.0)))(pts)
+            ap = jnp.mean(pmax)
+        else:  # integral
+            drec = jnp.diff(jnp.concatenate([jnp.zeros((1,)), rec]))
+            ap = jnp.sum(prec * drec)
+        aps.append(jnp.where(npos > 0, ap, jnp.nan))
+    aps = jnp.stack(aps)
+    m_ap = jnp.nanmean(aps)
+    return {"MAP": jnp.nan_to_num(m_ap).reshape((1,)),
+            "AccumPosCount": jnp.zeros((1,), jnp.int32),
+            "AccumTruePos": jnp.zeros((1, 2), jnp.float32),
+            "AccumFalsePos": jnp.zeros((1, 2), jnp.float32)}

@@ -46,19 +46,50 @@ def auc(ctx, ins, attrs):
     return {"AUC": auc_val.reshape((1,))}
 
 
-@register_op("edit_distance", no_grad=("Hyps", "Refs"),
+@register_op("edit_distance", no_grad=("Hyps", "Refs", "HypsLength",
+                                       "RefsLength"),
              ref="paddle/fluid/operators/edit_distance_op.cc")
 def edit_distance(ctx, ins, attrs):
+    """Levenshtein distance per row. Dense layout: negative ids and any id in
+    `ignored_tokens` are filtered out (left-packed) before the DP, and the DP
+    reads its answer at each row's effective length — equivalent to the
+    reference's LoD-sliced sequences."""
     import jax
 
     hyps, refs = one(ins, "Hyps"), one(ins, "Refs")
+    h_len, r_len = one(ins, "HypsLength"), one(ins, "RefsLength")
     normalized = bool(attrs.get("normalized", False))
+    ignored = [int(t) for t in (attrs.get("ignored_tokens") or [])]
 
-    def one_pair(h, r):
-        m, n = h.shape[0], r.shape[0]
-        row = jnp.arange(n + 1, dtype=jnp.float32)
+    hyps = hyps.reshape(hyps.shape[0], -1).astype(jnp.int32)
+    refs = refs.reshape(refs.shape[0], -1).astype(jnp.int32)
 
-        def body(i, row):
+    def pack(x, lengths):
+        """Drop ignored/negative/beyond-length tokens, left-pack, return
+        (packed [N, L], eff_len [N])."""
+        N, L = x.shape
+        keep = x >= 0
+        if lengths is not None:
+            keep = keep & (jnp.arange(L)[None, :] < lengths.reshape(-1, 1))
+        for t in ignored:
+            keep = keep & (x != t)
+        pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+        scatter_pos = jnp.where(keep, pos, L)
+        out = jnp.full((N, L + 1), -1, jnp.int32)
+        out = jax.vmap(lambda o, p, xv: o.at[p].set(xv))(
+            out, scatter_pos, jnp.where(keep, x, -1))[:, :L]
+        return out, jnp.sum(keep.astype(jnp.int32), axis=1)
+
+    hyps, m_eff = pack(hyps, h_len)
+    refs, n_eff = pack(refs, r_len)
+
+    def one_pair(h, r, m, n):
+        T_h, T_r = h.shape[0], r.shape[0]
+        row0 = jnp.arange(T_r + 1, dtype=jnp.float32)
+
+        def body(i, carry):
+            row, ans = carry
+
             def inner(j, acc):
                 prev_row, cur = acc
                 cost = jnp.where(h[i - 1] == r[j - 1], 0.0, 1.0)
@@ -69,14 +100,18 @@ def edit_distance(ctx, ins, attrs):
                 return prev_row, cur.at[j].set(val)
 
             new = jnp.zeros_like(row).at[0].set(i * 1.0)
-            _, new = jax.lax.fori_loop(1, n + 1, inner, (row, new))
-            return new
+            _, new = jax.lax.fori_loop(1, T_r + 1, inner, (row, new))
+            ans = jnp.where(i == m, new, ans)
+            return new, ans
 
-        final = jax.lax.fori_loop(1, m + 1, body, row)
-        d = final[n]
-        return d / n if normalized else d
+        # ans starts as row 0 (covers m == 0), then snapshots row m
+        _, ans = jax.lax.fori_loop(1, T_h + 1, body, (row0, row0))
+        d = ans[n]
+        if normalized:
+            d = d / jnp.maximum(n.astype(jnp.float32), 1.0)
+        return d
 
-    dists = jax.vmap(one_pair)(hyps, refs)
+    dists = jax.vmap(one_pair)(hyps, refs, m_eff, n_eff)
     return {"Out": dists.reshape(-1, 1),
             "SequenceNum": jnp.asarray([hyps.shape[0]], dtype=jnp.int64)}
 
@@ -130,6 +165,7 @@ def chunk_eval(ctx, ins, attrs):
     type_lut = jnp.asarray(type_lut_list, dtype=jnp.int32)
 
     def masks(seq, valid_row):
+        valid_row = valid_row & (seq >= 0)  # -1 padding counts as O
         ids = jnp.clip(seq.astype(jnp.int32), 0, O)
         tag = jnp.where(valid_row, tag_lut[ids], 4)
         typ = jnp.where(valid_row, type_lut[ids], -1)
@@ -238,3 +274,44 @@ def precision_recall(ctx, ins, attrs):
         "AccumMetrics": accum_metrics,
         "AccumStatesInfo": accum,
     }
+
+
+@register_op("positive_negative_pair",
+             no_grad=("Score", "Label", "QueryID", "AccumulatePositivePair",
+                      "AccumulateNegativePair", "AccumulateNeutralPair",
+                      "Weight"),
+             ref="paddle/fluid/operators/positive_negative_pair_op.cc")
+def positive_negative_pair(ctx, ins, attrs):
+    """Ranking pair stats per query: for each same-query item pair, count it
+    positive when score order matches label order, negative when inverted,
+    neutral on score ties. O(N^2) pairwise masks instead of the reference's
+    per-query host loops (N = batch rows, small for ranking evals)."""
+    score, label = one(ins, "Score"), one(ins, "Label")
+    qid = one(ins, "QueryID")
+    acc_pos = one(ins, "AccumulatePositivePair")
+    acc_neg = one(ins, "AccumulateNegativePair")
+    acc_neu = one(ins, "AccumulateNeutralPair")
+    weight = one(ins, "Weight")
+    col = int(attrs.get("column", -1))
+
+    s = score if score.ndim == 1 else score[:, col]
+    l = label.reshape(-1)
+    q = qid.reshape(-1)
+    w = weight.reshape(-1) if weight is not None else jnp.ones_like(s)
+
+    same_q = q[:, None] == q[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q), k=1)
+    cares = same_q & (l[:, None] != l[None, :]) & (upper > 0)
+    ds = s[:, None] - s[None, :]
+    dl = l[:, None] - l[None, :]
+    pw = 0.5 * (w[:, None] + w[None, :])
+    pos = jnp.sum(jnp.where(cares & (ds * dl > 0), pw, 0.0))
+    neg = jnp.sum(jnp.where(cares & (ds * dl < 0), pw, 0.0))
+    neu = jnp.sum(jnp.where(cares & (ds == 0), pw, 0.0))
+    if acc_pos is not None:
+        pos = pos + acc_pos.reshape(())
+        neg = neg + acc_neg.reshape(())
+        neu = neu + acc_neu.reshape(())
+    return {"PositivePair": pos.reshape((1,)),
+            "NegativePair": neg.reshape((1,)),
+            "NeutralPair": neu.reshape((1,))}

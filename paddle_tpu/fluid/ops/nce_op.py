@@ -1,0 +1,58 @@
+"""Noise-contrastive estimation op (reference paddle/fluid/operators/
+nce_op.{cc,h} + operators/math/sampler.*).
+
+The reference samples negatives on the host with a uniform/custom sampler
+and loops rows; here sampling uses the deterministic per-op RNG key (so the
+vjp re-trace sees identical negatives — the reference reuses its sampled ids
+in the grad kernel for the same reason) and the scoring is one batched
+gather + dot, MXU-friendly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+@register_op("nce", needs_rng=True,
+             no_grad=("Label", "SampleWeight", "CustomDistribution"),
+             ref="paddle/fluid/operators/nce_op.cc")
+def nce(ctx, ins, attrs):
+    """Inputs: Input [N, D], Weight [V, D], optional Bias [V],
+    Label [N, num_true]. Attrs: num_total_classes, num_neg_samples.
+    Outputs: Cost [N, 1], SampleLogits, SampleLabels (parity slots)."""
+    x = one(ins, "Input")
+    w = one(ins, "Weight")
+    bias = one(ins, "Bias")
+    label = one(ins, "Label")
+    num_classes = int(attrs["num_total_classes"])
+    num_neg = int(attrs.get("num_neg_samples", 10))
+
+    N, D = x.shape
+    if label.ndim == 1:
+        label = label[:, None]
+    num_true = label.shape[1]
+    label = label.astype(jnp.int32)
+
+    neg = jax.random.randint(ctx.rng(attrs), (N, num_neg), 0, num_classes)
+    samples = jnp.concatenate([label, neg], axis=1)  # [N, num_true+num_neg]
+
+    sw = w[samples]  # [N, S, D]
+    logits = jnp.einsum("nd,nsd->ns", x, sw)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[samples]
+
+    # NCE with uniform noise: P_n(y) = 1/num_classes; per-sample logit
+    # corrected by log(k * P_n) (reference nce_op.h computes
+    # out = samplerProb-corrected sigmoid cross-entropy)
+    log_kpn = jnp.log(jnp.asarray(num_neg / num_classes, logits.dtype))
+    adj = logits - log_kpn
+    is_true = jnp.concatenate(
+        [jnp.ones((N, num_true)), jnp.zeros((N, num_neg))], axis=1)
+    # sigmoid cross entropy: -[t*log σ(a) + (1-t)*log(1-σ(a))]
+    loss = jnp.maximum(adj, 0) - adj * is_true + jnp.log1p(jnp.exp(-jnp.abs(adj)))
+    cost = jnp.sum(loss, axis=1, keepdims=True)
+    return {"Cost": cost, "SampleLogits": logits,
+            "SampleLabels": samples.astype(jnp.int64)}

@@ -1,0 +1,305 @@
+"""Remaining vision / misc ops (reference paddle/fluid/operators/{maxout,
+unpool,spp,roi_pool,row_conv,conv_shift,bilinear_tensor_product,norm,
+pool_with_index}_op.* and pool_op.cc 3-D path).
+
+All dense NCHW with static shapes; window ops use lax.reduce_window so XLA
+tiles them onto the VPU, and argmax-style index outputs are computed with a
+position-encoding reduce (no host loops, unlike the reference's CPU
+kernels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .common import one
+
+
+@register_op("maxout", ref="paddle/fluid/operators/maxout_op.cc")
+def maxout(ctx, ins, attrs):
+    x = one(ins, "X")  # [N, C, H, W]
+    groups = int(attrs["groups"])
+    N, C, H, W = x.shape
+    return {"Out": jnp.max(x.reshape(N, C // groups, groups, H, W), axis=2)}
+
+
+@register_op("norm", ref="paddle/fluid/operators/norm_op.cc")
+def norm(ctx, ins, attrs):
+    """Cross-channel L2 normalization with learned per-channel scale
+    (SSD's conv4_3 norm layer)."""
+    x = one(ins, "X")  # [N, C, H, W]
+    scale = one(ins, "Scale")  # [C] (reference: [1, C, 1, 1])
+    eps = float(attrs.get("epsilon", 1e-10))
+    l2 = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+    return {"Out": x / l2 * scale.reshape(1, -1, 1, 1)}
+
+
+def _pool_nd(x, pooling_type, ksize, strides, paddings, global_pooling,
+             exclusive, spatial):
+    if global_pooling:
+        ksize = list(x.shape[2:2 + spatial])
+        paddings = [0] * spatial
+        strides = [1] * spatial
+    window = (1, 1) + tuple(ksize)
+    wstrides = (1, 1) + tuple(strides)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if pooling_type == "max":
+        init = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                else jnp.iinfo(x.dtype).min)
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, wstrides, pads)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, wstrides, pads)
+    if exclusive:
+        ones = jnp.ones_like(x)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, wstrides, pads)
+        return s / cnt
+    denom = 1.0
+    for k in ksize:
+        denom *= k
+    return s / float(denom)
+
+
+def _tuple_n(v, n):
+    v = list(v) if isinstance(v, (list, tuple)) else [v]
+    if len(v) == 1:
+        v = v * n
+    return v
+
+
+@register_op("pool3d", ref="paddle/fluid/operators/pool_op.cc")
+def pool3d(ctx, ins, attrs):
+    x = one(ins, "X")  # [N, C, D, H, W]
+    out = _pool_nd(
+        x, str(attrs.get("pooling_type", "max")),
+        _tuple_n(attrs.get("ksize", [2, 2, 2]), 3),
+        _tuple_n(attrs.get("strides", [1, 1, 1]), 3),
+        _tuple_n(attrs.get("paddings", [0, 0, 0]), 3),
+        bool(attrs.get("global_pooling", False)),
+        bool(attrs.get("exclusive", True)), 3)
+    return {"Out": out}
+
+
+def _max_pool_with_index(x, ksize, strides, paddings):
+    """Returns (pooled, flat-index-into-HxW). Index computed by reducing
+    (value, position) pairs — the reference's CPU kernel records the argmax
+    position the same way, serially."""
+    N, C, H, W = x.shape
+    pos = jnp.broadcast_to(
+        (jnp.arange(H)[:, None] * W + jnp.arange(W)[None, :]).astype(jnp.int32),
+        (N, C, H, W))
+    window = (1, 1, ksize[0], ksize[1])
+    wstrides = (1, 1, strides[0], strides[1])
+    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]),
+            (paddings[1], paddings[1]))
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    init = (jnp.asarray(-jnp.inf, x.dtype), jnp.asarray(-1, jnp.int32))
+    vals, idx = jax.lax.reduce_window(
+        (x, pos), init, reducer, window, wstrides, pads)
+    return vals, idx
+
+
+@register_op("max_pool2d_with_index", ref="paddle/fluid/operators/pool_with_index_op.cc")
+def max_pool2d_with_index(ctx, ins, attrs):
+    x = one(ins, "X")
+    ksize = _tuple_n(attrs.get("ksize", [2, 2]), 2)
+    strides = _tuple_n(attrs.get("strides", [1, 1]), 2)
+    paddings = _tuple_n(attrs.get("paddings", [0, 0]), 2)
+    if bool(attrs.get("global_pooling", False)):
+        ksize = [x.shape[2], x.shape[3]]
+        paddings = [0, 0]
+        strides = [1, 1]
+    vals, idx = _max_pool_with_index(x, ksize, strides, paddings)
+    return {"Out": vals, "Mask": idx}
+
+
+@register_op("unpool", no_grad=("Indices",),
+             ref="paddle/fluid/operators/unpool_op.cc")
+def unpool(ctx, ins, attrs):
+    """Max-unpool: scatter pooled values back to their argmax positions."""
+    x = one(ins, "X")          # [N, C, h, w]
+    indices = one(ins, "Indices")  # [N, C, h, w] flat HxW positions
+    ksize = _tuple_n(attrs.get("ksize", [2, 2]), 2)
+    strides = _tuple_n(attrs.get("strides", ksize), 2)
+    paddings = _tuple_n(attrs.get("paddings", [0, 0]), 2)
+    N, C, h, w = x.shape
+    H = (h - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    W = (w - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+    flat_x = x.reshape(N, C, h * w)
+    flat_i = jnp.clip(indices.reshape(N, C, h * w).astype(jnp.int32),
+                      0, H * W - 1)
+    out = jnp.zeros((N, C, H * W), x.dtype)
+    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(out, flat_i, flat_x)
+    return {"Out": out.reshape(N, C, H, W)}
+
+
+@register_op("spp", ref="paddle/fluid/operators/spp_op.cc")
+def spp(ctx, ins, attrs):
+    """Spatial pyramid pooling: concat flattened pools at 1x1..2^(L-1) bins."""
+    x = one(ins, "X")
+    levels = int(attrs.get("pyramid_height", 3))
+    ptype = str(attrs.get("pooling_type", "max"))
+    N, C, H, W = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        kh, kw = -(-H // bins), -(-W // bins)  # ceil
+        sh, sw = kh, kw
+        ph, pw = (kh * bins - H + 1) // 2, (kw * bins - W + 1) // 2
+        pooled = _pool_nd(x, ptype, [kh, kw], [sh, sw], [ph, pw],
+                          False, False, 2)
+        outs.append(pooled.reshape(N, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+@register_op("roi_pool", no_grad=("ROIs",),
+             ref="paddle/fluid/operators/roi_pool_op.cc")
+def roi_pool(ctx, ins, attrs):
+    """Max-pool each ROI into a fixed pooled_h x pooled_w grid.
+    ROIs [R, 5]: (batch_idx, x1, y1, x2, y2) in input scale."""
+    x = one(ins, "X")  # [N, C, H, W]
+    rois = one(ins, "ROIs")
+    pooled_h = int(attrs.get("pooled_height", 1))
+    pooled_w = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+
+    def pool_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+        rw = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+        bin_h, bin_w = rh / pooled_h, rw / pooled_w
+        fmap = x[b]  # [C, H, W]
+
+        hh = jnp.arange(H)[None, :]
+        ww = jnp.arange(W)[None, :]
+        ph = jnp.arange(pooled_h)[:, None].astype(jnp.float32)
+        pw = jnp.arange(pooled_w)[:, None].astype(jnp.float32)
+        h_lo = (y1 + jnp.floor(ph * bin_h)).astype(jnp.int32)
+        h_hi = (y1 + jnp.ceil((ph + 1) * bin_h)).astype(jnp.int32)
+        w_lo = (x1 + jnp.floor(pw * bin_w)).astype(jnp.int32)
+        w_hi = (x1 + jnp.ceil((pw + 1) * bin_w)).astype(jnp.int32)
+        h_in = (hh >= jnp.clip(h_lo, 0, H)) & (hh < jnp.clip(h_hi, 0, H))
+        w_in = (ww >= jnp.clip(w_lo, 0, W)) & (ww < jnp.clip(w_hi, 0, W))
+        # [ph, pw, H, W] bin membership masks
+        m = h_in[:, None, :, None] & w_in[None, :, None, :]
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        masked = jnp.where(m[None], fmap[:, None, None, :, :], neg)
+        out = jnp.max(masked, axis=(3, 4))  # [C, ph, pw]
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return {"Out": jax.vmap(pool_roi)(rois),
+            "Argmax": jnp.zeros((rois.shape[0], C, pooled_h, pooled_w),
+                                jnp.int32)}
+
+
+@register_op("row_conv", ref="paddle/fluid/operators/row_conv_op.cc")
+def row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (DeepSpeech2): out[t] = sum_{k<ctx}
+    x[t+k] * w[k]. X [N, T, D] dense (reference is LoD), Filter [ctx, D]."""
+    x = one(ins, "X")
+    w = one(ins, "Filter")
+    ctx_len = w.shape[0]
+    outs = jnp.zeros_like(x)
+    T = x.shape[1]
+    for k in range(ctx_len):
+        shifted = jnp.pad(x[:, k:], ((0, 0), (0, k), (0, 0)))
+        outs = outs + shifted * w[k][None, None, :]
+    return {"Out": outs}
+
+
+@register_op("conv_shift", ref="paddle/fluid/operators/conv_shift_op.cc")
+def conv_shift(ctx, ins, attrs):
+    """Circular 1-D correlation (NTM shift): X [B, M], Y [B, N] (N odd,
+    N <= M); out[i] = sum_j x[(i + j - N/2) mod M] * y[j]."""
+    x = one(ins, "X")
+    y = one(ins, "Y")
+    B, M = x.shape
+    N = y.shape[1]
+    half = N // 2
+    idx = (jnp.arange(M)[:, None] + jnp.arange(N)[None, :] - half) % M
+    # [B, M, N] gather then contract with y
+    gathered = x[:, idx]  # [B, M, N]
+    return {"Out": jnp.einsum("bmn,bn->bm", gathered, y)}
+
+
+@register_op("bilinear_tensor_product",
+             ref="paddle/fluid/operators/bilinear_tensor_product_op.cc")
+def bilinear_tensor_product(ctx, ins, attrs):
+    """out[:, k] = x W_k y^T + bias: X [B, M], Y [B, N], Weight [K, M, N]."""
+    x, y = one(ins, "X"), one(ins, "Y")
+    w = one(ins, "Weight")
+    bias = one(ins, "Bias")
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return {"Out": out}
+
+
+@register_op("lstmp", no_grad=("Lengths",),
+             ref="paddle/fluid/operators/lstmp_op.cc")
+def lstmp(ctx, ins, attrs):
+    """LSTM with recurrent projection: like lstm but the recurrent state fed
+    back is r_t = proj(h_t), Weight is [P, 4H], ProjWeight [H, P]."""
+    x = one(ins, "Input")          # [N, T, 4H] pre-activated (matches lstm op)
+    w = one(ins, "Weight")         # [P, 4H]
+    proj_w = one(ins, "ProjWeight")  # [H, P]
+    bias = one(ins, "Bias")
+    h0, c0 = one(ins, "H0"), one(ins, "C0")
+    lengths = one(ins, "Lengths")
+    use_peepholes = bool(attrs.get("use_peepholes", False))
+
+    N, T, H4 = x.shape
+    H = H4 // 4
+    P = proj_w.shape[1]
+    if bias is not None:
+        b_in = bias[:, :4 * H] if bias.ndim == 2 else bias[None, :4 * H]
+        x = x + b_in
+        if use_peepholes and bias.shape[-1] >= 7 * H:
+            w_ic = bias[..., 4 * H:5 * H].reshape(1, H)
+            w_fc = bias[..., 5 * H:6 * H].reshape(1, H)
+            w_oc = bias[..., 6 * H:7 * H].reshape(1, H)
+        else:
+            w_ic = w_fc = w_oc = jnp.zeros((1, H), x.dtype)
+    else:
+        w_ic = w_fc = w_oc = jnp.zeros((1, H), x.dtype)
+    r0 = jnp.zeros((N, P), x.dtype) if h0 is None else h0 @ proj_w
+    c0 = jnp.zeros((N, H), x.dtype) if c0 is None else c0
+    if lengths is None:
+        lengths = jnp.full((N,), T, jnp.int32)
+
+    def step(carry, xs):
+        r, c = carry
+        g, t = xs  # [N, 4H]
+        g = g + r @ w
+        i = jax.nn.sigmoid(g[:, :H] + w_ic * c)
+        f = jax.nn.sigmoid(g[:, H:2 * H] + w_fc * c)
+        cand = jnp.tanh(g[:, 2 * H:3 * H])
+        c_new = f * c + i * cand
+        o = jax.nn.sigmoid(g[:, 3 * H:] + w_oc * c_new)
+        h_new = o * jnp.tanh(c_new)
+        r_new = h_new @ proj_w
+        valid = (t < lengths)[:, None]
+        r_new = jnp.where(valid, r_new, r)
+        c_new = jnp.where(valid, c_new, c)
+        return (r_new, c_new), (r_new, c_new)
+
+    (_, _), (rs, cs) = jax.lax.scan(
+        step, (r0, c0), (jnp.swapaxes(x, 0, 1), jnp.arange(T)))
+    proj = jnp.swapaxes(rs, 0, 1)  # [N, T, P]
+    cell = jnp.swapaxes(cs, 0, 1)
+    mask = (jnp.arange(T)[None, :] < lengths[:, None])[:, :, None]
+    return {"Projection": jnp.where(mask, proj, 0.0),
+            "Cell": jnp.where(mask, cell, 0.0),
+            "BatchedProjection": proj, "BatchedCell": cell,
+            "BatchedInput": x, "BatchedHidden": cell,
+            "OrderedP0": r0}

@@ -1,0 +1,103 @@
+"""Detection layer builders end-to-end (reference test_detection.py +
+book SSD-style usage): build an SSD head over tiny feature maps, run the
+loss, check it is finite and decreases under SGD."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def test_prior_box_and_detection_output():
+    main, startup = Program(), Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), program_guard(main, startup):
+        img = layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+        feat = layers.conv2d(img, num_filters=8, filter_size=3, padding=1)
+        boxes, variances = layers.prior_box(
+            feat, img, min_sizes=[8.0], max_sizes=[16.0],
+            aspect_ratios=[2.0], flip=True)
+        loc = layers.data(name="loc", shape=[boxes.shape[0] * boxes.shape[1]
+                                             * boxes.shape[2], 4],
+                          dtype="float32")
+        exe = fluid.Executor()
+        exe.run(startup)
+        b, v = exe.run(
+            main,
+            feed={"img": np.random.rand(2, 3, 32, 32).astype(np.float32),
+                  "loc": np.zeros((2, 32 * 32 * 4, 4), np.float32)},
+            fetch_list=[boxes, variances])
+    assert b.shape == (32, 32, 4, 4)
+    assert v.shape == (32, 32, 4, 4)
+    assert np.all(np.isfinite(b))
+
+
+def test_ssd_loss_trains():
+    np.random.seed(7)
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 5
+    scope = fluid.Scope()
+    N, P, G, C = 2, 8, 3, 4
+    with fluid.scope_guard(scope), program_guard(main, startup):
+        feat = layers.data(name="feat", shape=[16], dtype="float32")
+        loc = layers.fc(feat, size=P * 4)
+        loc = layers.reshape(loc, shape=[-1, P, 4])
+        conf = layers.fc(feat, size=P * C)
+        conf = layers.reshape(conf, shape=[-1, P, C])
+        gt_box = layers.data(name="gt_box", shape=[G, 4], dtype="float32")
+        gt_label = layers.data(name="gt_label", shape=[G], dtype="int64")
+        prior = layers.data(name="prior", shape=[P, 4], dtype="float32",
+                            append_batch_size=False)
+        pvar = layers.data(name="pvar", shape=[P, 4], dtype="float32",
+                           append_batch_size=False)
+        loss = layers.ssd_loss(loc, conf, gt_box, gt_label, prior, pvar)
+        avg = layers.mean(loss)
+        opt = fluid.optimizer.SGD(learning_rate=0.05)
+        opt.minimize(avg)
+
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        prior_np = np.random.rand(P, 4).astype(np.float32)
+        prior_np[:, 2:] += prior_np[:, :2]
+        feed = {
+            "feat": np.random.rand(N, 16).astype(np.float32),
+            "gt_box": np.abs(np.random.rand(N, G, 4)).astype(np.float32),
+            "gt_label": np.random.randint(1, C, (N, G)).astype(np.int64),
+            "prior": prior_np,
+            "pvar": np.full((P, 4), 0.1, np.float32),
+        }
+        feed["gt_box"][..., 2:] += feed["gt_box"][..., :2]
+        losses = []
+        for _ in range(8):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[avg])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_detection_output_shapes():
+    main, startup = Program(), Program()
+    scope = fluid.Scope()
+    N, P, C = 1, 6, 3
+    with fluid.scope_guard(scope), program_guard(main, startup):
+        loc = layers.data(name="loc", shape=[P, 4], dtype="float32")
+        scores = layers.data(name="scores", shape=[P, C], dtype="float32")
+        prior = layers.data(name="prior", shape=[P, 4], dtype="float32",
+                            append_batch_size=False)
+        pvar = layers.data(name="pvar", shape=[P, 4], dtype="float32",
+                           append_batch_size=False)
+        out = layers.detection_output(loc, scores, prior, pvar,
+                                      nms_top_k=P, keep_top_k=4)
+        exe = fluid.Executor()
+        exe.run(startup)
+        prior_np = np.random.rand(P, 4).astype(np.float32)
+        prior_np[:, 2:] += prior_np[:, :2]
+        (res,) = exe.run(
+            main,
+            feed={"loc": np.random.randn(N, P, 4).astype(np.float32) * 0.1,
+                  "scores": np.random.randn(N, P, C).astype(np.float32),
+                  "prior": prior_np,
+                  "pvar": np.full((P, 4), 0.1, np.float32)},
+            fetch_list=[out])
+    assert res.shape == (N, 4, 6)

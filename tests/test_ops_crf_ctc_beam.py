@@ -1,0 +1,294 @@
+"""CRF / CTC / beam-search / NCE / lstmp op correctness vs brute force
+(reference test_linear_chain_crf_op.py, test_crf_decoding_op.py,
+test_warpctc_op.py, test_ctc_align_op.py, test_nce.py,
+test_beam_search_op.py, test_beam_search_decode_op.py, test_lstmp_op.py)."""
+import itertools
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+from paddle_tpu.fluid.registry import EmitCtx, run_forward
+
+
+def crf_brute_force(emission, transition, lengths):
+    """Enumerate all paths; returns (logZ [N], best_path list)."""
+    a, b, w = transition[0], transition[1], transition[2:]
+    N, T, D = emission.shape
+    logZ, best = [], []
+    for n in range(N):
+        L = int(lengths[n])
+        scores = []
+        paths = []
+        for path in itertools.product(range(D), repeat=L):
+            s = a[path[0]] + emission[n, 0, path[0]] + b[path[-1]]
+            for t in range(1, L):
+                s += w[path[t - 1], path[t]] + emission[n, t, path[t]]
+            scores.append(s)
+            paths.append(path)
+        scores = np.array(scores)
+        m = scores.max()
+        logZ.append(m + np.log(np.exp(scores - m).sum()))
+        best.append(paths[int(np.argmax(scores))])
+    return np.array(logZ), best
+
+
+class TestLinearChainCRF(OpTest):
+    def test_nll_vs_brute_force(self):
+        D, T, N = 3, 4, 2
+        emission = np.random.randn(N, T, D).astype(np.float32)
+        transition = np.random.randn(D + 2, D).astype(np.float32) * 0.5
+        label = np.random.randint(0, D, (N, T)).astype(np.int64)
+        lengths = np.array([4, 3], np.int32)
+        logZ, _ = crf_brute_force(emission, transition, lengths)
+
+        gold = []
+        a, b, w = transition[0], transition[1], transition[2:]
+        for n in range(N):
+            L = int(lengths[n])
+            s = a[label[n, 0]] + emission[n, 0, label[n, 0]] + b[label[n, L - 1]]
+            for t in range(1, L):
+                s += w[label[n, t - 1], label[n, t]] + emission[n, t, label[n, t]]
+            gold.append(s)
+        expected_nll = logZ - np.array(gold)
+
+        self.op_type = "linear_chain_crf"
+        self.inputs = {"Emission": emission, "Transition": transition,
+                       "Label": label, "Lengths": lengths}
+        self.outputs = {"LogLikelihood": expected_nll.reshape(-1, 1)
+                        .astype(np.float32)}
+        self.check_output(atol=1e-4, rtol=1e-3,
+                          no_check_set=("Alpha", "EmissionExps",
+                                        "TransitionExps"))
+
+    def test_grad(self):
+        D, T, N = 3, 3, 2
+        emission = np.random.randn(N, T, D).astype(np.float32)
+        transition = (np.random.randn(D + 2, D) * 0.3).astype(np.float32)
+        label = np.random.randint(0, D, (N, T)).astype(np.int64)
+        self.op_type = "linear_chain_crf"
+        self.inputs = {"Emission": emission, "Transition": transition,
+                       "Label": label}
+        self.outputs = {"LogLikelihood": np.zeros((N, 1), np.float32)}
+        self.check_grad(["Emission", "Transition"], "LogLikelihood",
+                        max_relative_error=2e-2)
+
+
+class TestCRFDecoding(OpTest):
+    def test_viterbi_vs_brute_force(self):
+        D, T, N = 3, 4, 2
+        emission = np.random.randn(N, T, D).astype(np.float32)
+        transition = np.random.randn(D + 2, D).astype(np.float32) * 0.5
+        lengths = np.array([4, 3], np.int32)
+        _, best = crf_brute_force(emission, transition, lengths)
+        expected = np.zeros((N, T), np.int64)
+        for n, path in enumerate(best):
+            expected[n, :len(path)] = path
+
+        ctx = EmitCtx()
+        out = run_forward(ctx, "crf_decoding",
+                          {"Emission": [emission], "Transition": [transition],
+                           "Lengths": [lengths]}, {})
+        got = np.asarray(out["ViterbiPath"][0])
+        np.testing.assert_array_equal(got, expected)
+
+    def test_label_agreement(self):
+        D, T, N = 3, 3, 1
+        emission = np.random.randn(N, T, D).astype(np.float32)
+        transition = np.random.randn(D + 2, D).astype(np.float32)
+        lengths = np.array([3], np.int32)
+        _, best = crf_brute_force(emission, transition, lengths)
+        label = np.array([list(best[0])], np.int64)
+        label[0, 1] = (label[0, 1] + 1) % D  # one mismatch
+        ctx = EmitCtx()
+        out = run_forward(ctx, "crf_decoding",
+                          {"Emission": [emission], "Transition": [transition],
+                           "Label": [label], "Lengths": [lengths]}, {})
+        got = np.asarray(out["ViterbiPath"][0])
+        expected = np.array([[1, 0, 1]], np.int64)
+        np.testing.assert_array_equal(got, expected)
+
+
+def ctc_brute_force(logits, label, blank=0):
+    """-log p(label|x) by summing over all alignments."""
+    T, C = logits.shape
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: merge repeats then remove blanks
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev:
+                collapsed.append(s)
+            prev = s
+        collapsed = [s for s in collapsed if s != blank]
+        if collapsed == list(label):
+            pp = 1.0
+            for t, s in enumerate(path):
+                pp *= p[t, s]
+            total += pp
+    return -np.log(max(total, 1e-300))
+
+
+class TestWarpCTC(OpTest):
+    def test_loss_vs_brute_force(self):
+        T, C = 4, 3
+        logits = np.random.randn(1, T, C).astype(np.float32)
+        label = np.array([[1, 2]], np.int64)
+        expected = ctc_brute_force(logits[0], [1, 2])
+        ctx = EmitCtx()
+        out = run_forward(ctx, "warpctc",
+                          {"Logits": [logits], "Label": [label]}, {})
+        got = float(np.asarray(out["Loss"][0])[0, 0])
+        assert got == pytest.approx(expected, rel=1e-4)
+
+    def test_grad(self):
+        T, C = 4, 3
+        logits = np.random.randn(2, T, C).astype(np.float32)
+        label = np.array([[1, 2], [2, -1]], np.int64)
+        self.op_type = "warpctc"
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Loss": np.zeros((2, 1), np.float32)}
+        self.check_grad(["Logits"], "Loss", max_relative_error=2e-2)
+
+
+class TestCTCAlign(OpTest):
+    def test_align(self):
+        self.op_type = "ctc_align"
+        x = np.array([[0, 1, 1, 0, 2, 2, 0, 3]], np.int32)
+        self.inputs = {"Input": x}
+        self.attrs = {"blank": 0, "merge_repeated": True}
+        out = np.full((1, 8), -1, np.int32)
+        out[0, :3] = [1, 2, 3]
+        self.outputs = {"Output": out,
+                        "OutputLength": np.array([[3]], np.int32)}
+        self.check_output()
+
+
+class TestNCE(OpTest):
+    def test_shapes_and_grad(self):
+        """Grad vs central differences through the emitter with a FIXED rng
+        key (executor RNG advances per run, so sampled negatives would change
+        between numeric evaluations)."""
+        import jax
+        import jax.numpy as jnp
+
+        N, D, V = 4, 6, 20
+        x = np.random.randn(N, D).astype(np.float32)
+        w = (np.random.randn(V, D) * 0.1).astype(np.float32)
+        bias = np.zeros(V, np.float32)
+        label = np.random.randint(0, V, (N, 1)).astype(np.int64)
+        attrs = {"num_total_classes": V, "num_neg_samples": 5}
+        ctx = EmitCtx(root_key=jax.random.key(7))
+
+        def loss(xv, wv):
+            out = run_forward(ctx, "nce",
+                              {"Input": [xv], "Weight": [wv],
+                               "Bias": [bias], "Label": [label]}, attrs)
+            return jnp.sum(out["Cost"][0])
+
+        cost = loss(x, w)
+        assert np.isfinite(float(cost))
+        gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
+        eps = 1e-3
+        for _ in range(5):
+            i, j = np.random.randint(N), np.random.randint(D)
+            xp, xm = x.copy(), x.copy()
+            xp[i, j] += eps
+            xm[i, j] -= eps
+            num = (float(loss(xp, w)) - float(loss(xm, w))) / (2 * eps)
+            assert num == pytest.approx(float(gx[i, j]), rel=2e-2, abs=1e-4)
+
+
+class TestBeamSearch(OpTest):
+    def test_step(self):
+        B, K, V = 1, 2, 4
+        pre_ids = np.array([[3, 3]], np.int64)  # no end tokens yet
+        pre_scores = np.array([[-1.0, -2.0]], np.float32)
+        scores = np.log(np.array([[[0.1, 0.2, 0.3, 0.4],
+                                   [0.4, 0.3, 0.2, 0.1]]], np.float32))
+        total = pre_scores[0][:, None] + scores[0]
+        flat = total.reshape(-1)
+        top = np.argsort(-flat)[:K]
+        ctx = EmitCtx()
+        out = run_forward(ctx, "beam_search",
+                          {"PreIds": [pre_ids], "PreScores": [pre_scores],
+                           "Scores": [scores]},
+                          {"beam_size": K, "end_id": 0})
+        np.testing.assert_allclose(np.asarray(out["SelectedScores"][0])[0],
+                                   flat[top], rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(out["SelectedIds"][0])[0],
+                                      top % V)
+        np.testing.assert_array_equal(np.asarray(out["ParentIdx"][0])[0],
+                                      top // V)
+
+    def test_finished_beam_frozen(self):
+        B, K, V = 1, 2, 3
+        pre_ids = np.array([[0, 2]], np.int64)  # beam 0 finished (end_id=0)
+        pre_scores = np.array([[-0.5, -1.0]], np.float32)
+        scores = np.full((B, K, V), -10.0, np.float32)
+        ctx = EmitCtx()
+        out = run_forward(ctx, "beam_search",
+                          {"PreIds": [pre_ids], "PreScores": [pre_scores],
+                           "Scores": [scores]},
+                          {"beam_size": K, "end_id": 0})
+        # best selection: finished beam keeps end_id at score -0.5
+        assert np.asarray(out["SelectedIds"][0])[0, 0] == 0
+        assert np.asarray(out["SelectedScores"][0])[0, 0] == pytest.approx(-0.5)
+        assert np.asarray(out["ParentIdx"][0])[0, 0] == 0
+
+
+class TestBeamSearchDecode(OpTest):
+    def test_backtrack(self):
+        # T=3, B=1, K=2; construct known parent chain
+        ids = np.array([[[5, 6]], [[7, 8]], [[9, 10]]], np.int64)
+        parents = np.array([[[0, 1]], [[1, 0]], [[0, 1]]], np.int32)
+        scores = np.zeros((3, 1, 2), np.float32)
+        scores[2] = [[-1.0, -2.0]]
+        ctx = EmitCtx()
+        out = run_forward(ctx, "beam_search_decode",
+                          {"Ids": [ids], "Parents": [parents],
+                           "Scores": [scores]}, {"end_id": 0})
+        seq = np.asarray(out["SentenceIds"][0])
+        # hyp 0 at t=2: token 9, parent 0 -> t=1 slot0: token 7, parent 1
+        # -> t=0 slot1: token 6
+        np.testing.assert_array_equal(seq[0, 0], [6, 7, 9])
+        # hyp 1 at t=2: token 10, parent 1 -> t=1 slot1: token 8, parent 0
+        # -> t=0 slot0: token 5
+        np.testing.assert_array_equal(seq[0, 1], [5, 8, 10])
+        np.testing.assert_allclose(np.asarray(out["SentenceScores"][0])[0],
+                                   [-1.0, -2.0])
+
+
+class TestLSTMP(OpTest):
+    def test_recurrence(self):
+        N, T, H, P = 2, 3, 4, 3
+        x = np.random.randn(N, T, 4 * H).astype(np.float32) * 0.5
+        w = np.random.randn(P, 4 * H).astype(np.float32) * 0.3
+        proj_w = np.random.randn(H, P).astype(np.float32) * 0.3
+
+        def sigmoid(v):
+            return 1 / (1 + np.exp(-v))
+
+        r = np.zeros((N, P), np.float32)
+        c = np.zeros((N, H), np.float32)
+        expected = np.zeros((N, T, P), np.float32)
+        for t in range(T):
+            g = x[:, t] + r @ w
+            i = sigmoid(g[:, :H])
+            f = sigmoid(g[:, H:2 * H])
+            cand = np.tanh(g[:, 2 * H:3 * H])
+            c = f * c + i * cand
+            o = sigmoid(g[:, 3 * H:])
+            h = o * np.tanh(c)
+            r = h @ proj_w
+            expected[:, t] = r
+
+        ctx = EmitCtx()
+        out = run_forward(ctx, "lstmp",
+                          {"Input": [x], "Weight": [w],
+                           "ProjWeight": [proj_w]}, {})
+        got = np.asarray(out["Projection"][0])
+        np.testing.assert_allclose(got, expected, atol=1e-5, rtol=1e-4)

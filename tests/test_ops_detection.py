@@ -1,0 +1,410 @@
+"""Detection op correctness (reference test_iou_similarity_op.py,
+test_prior_box_op.py, test_box_coder_op.py, test_bipartite_match_op.py,
+test_target_assign_op.py, test_multiclass_nms_op.py,
+test_mine_hard_examples_op.py, test_detection_map_op.py)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def iou_np(a, b):
+    area_a = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    out = np.zeros((a.shape[0], b.shape[0]), np.float32)
+    for i in range(a.shape[0]):
+        for j in range(b.shape[0]):
+            x1 = max(a[i, 0], b[j, 0]); y1 = max(a[i, 1], b[j, 1])
+            x2 = min(a[i, 2], b[j, 2]); y2 = min(a[i, 3], b[j, 3])
+            inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+            union = area_a[i] + area_b[j] - inter
+            out[i, j] = inter / max(union, 1e-10)
+    return out
+
+
+class TestIouSimilarity(OpTest):
+    def test_basic(self):
+        self.op_type = "iou_similarity"
+        x = np.random.rand(5, 4).astype(np.float32)
+        x[:, 2:] += x[:, :2]  # ensure xmax >= xmin
+        y = np.random.rand(7, 4).astype(np.float32)
+        y[:, 2:] += y[:, :2]
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": iou_np(x, y)}
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+class TestBoxCoder(OpTest):
+    def test_encode_decode_roundtrip(self):
+        self.op_type = "box_coder"
+        P, M = 4, 3
+        prior = np.random.rand(P, 4).astype(np.float32)
+        prior[:, 2:] = prior[:, :2] + 0.5 + prior[:, 2:]
+        pvar = np.full((P, 4), 0.1, np.float32)
+        target = np.random.rand(M, 4).astype(np.float32)
+        target[:, 2:] = target[:, :2] + 0.5 + target[:, 2:]
+
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = prior[:, 0] + pw / 2
+        pcy = prior[:, 1] + ph / 2
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        tcx = target[:, 0] + tw / 2
+        tcy = target[:, 1] + th / 2
+        expected = np.zeros((M, P, 4), np.float32)
+        for m in range(M):
+            for p in range(P):
+                expected[m, p, 0] = (tcx[m] - pcx[p]) / pw[p] / 0.1
+                expected[m, p, 1] = (tcy[m] - pcy[p]) / ph[p] / 0.1
+                expected[m, p, 2] = np.log(tw[m] / pw[p]) / 0.1
+                expected[m, p, 3] = np.log(th[m] / ph[p]) / 0.1
+        self.inputs = {"PriorBox": prior, "PriorBoxVar": pvar,
+                       "TargetBox": target}
+        self.attrs = {"code_type": "encode_center_size"}
+        self.outputs = {"OutputBox": expected}
+        self.check_output(atol=1e-4, rtol=1e-3)
+
+    def test_decode(self):
+        # decode(encode(t)) == t
+        import jax
+        import sys, os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from paddle_tpu.fluid.registry import run_forward, EmitCtx
+
+        P = 5
+        prior = np.random.rand(P, 4).astype(np.float32)
+        prior[:, 2:] = prior[:, :2] + 0.5 + prior[:, 2:]
+        pvar = np.full((P, 4), 0.2, np.float32)
+        target = np.random.rand(P, 4).astype(np.float32)
+        target[:, 2:] = target[:, :2] + 0.5 + target[:, 2:]
+        ctx = EmitCtx()
+        enc = run_forward(ctx, "box_coder",
+                          {"PriorBox": [prior], "PriorBoxVar": [pvar],
+                           "TargetBox": [target]},
+                          {"code_type": "encode_center_size"})["OutputBox"][0]
+        # diag of [M, P, 4]: encoding of target m against prior m
+        diag = np.stack([np.asarray(enc)[i, i] for i in range(P)])
+        dec = run_forward(ctx, "box_coder",
+                          {"PriorBox": [prior], "PriorBoxVar": [pvar],
+                           "TargetBox": [diag[None].repeat(P, 0)
+                                         .transpose(1, 0, 2)]},
+                          {"code_type": "decode_center_size"})["OutputBox"][0]
+        got = np.stack([np.asarray(dec)[i, i] for i in range(P)])
+        np.testing.assert_allclose(got, target, atol=1e-4, rtol=1e-3)
+
+
+class TestPriorBox(OpTest):
+    def test_shapes_and_center(self):
+        self.op_type = "prior_box"
+        feat = np.zeros((1, 8, 4, 4), np.float32)
+        image = np.zeros((1, 3, 32, 32), np.float32)
+        min_sizes, max_sizes = [8.0], [16.0]
+        ar = [2.0]
+        # priors: ar=1 for each min + sqrt(min*max) + ar 2 & 1/2 -> 4
+        H = W = 4
+        num_priors = 4
+        boxes = np.zeros((H, W, num_priors, 4), np.float32)
+        variances = np.tile(np.array([0.1, 0.1, 0.2, 0.2], np.float32),
+                            (H, W, num_priors, 1))
+        step = 32 / 4
+        widths = [8, np.sqrt(8 * 16), 8 * np.sqrt(2), 8 / np.sqrt(2)]
+        heights = [8, np.sqrt(8 * 16), 8 / np.sqrt(2), 8 * np.sqrt(2)]
+        # emitter order: [min ar1, flips...], then sqrt(min*max): recompute in
+        # emitter order: for ms: ar list = [1, 2, 0.5] -> w: 8, 8√2, 8/√2
+        # then max: √(8·16); so reorder:
+        widths = [8, 8 * np.sqrt(2), 8 / np.sqrt(2), np.sqrt(128)]
+        heights = [8, 8 / np.sqrt(2), 8 * np.sqrt(2), np.sqrt(128)]
+        for h in range(H):
+            for w in range(W):
+                cx, cy = (w + 0.5) * step, (h + 0.5) * step
+                for k in range(num_priors):
+                    boxes[h, w, k] = [
+                        (cx - widths[k] / 2) / 32, (cy - heights[k] / 2) / 32,
+                        (cx + widths[k] / 2) / 32, (cy + heights[k] / 2) / 32]
+        self.inputs = {"Input": feat, "Image": image}
+        self.attrs = {"min_sizes": min_sizes, "max_sizes": max_sizes,
+                      "aspect_ratios": ar, "flip": True,
+                      "variances": [0.1, 0.1, 0.2, 0.2]}
+        self.outputs = {"Boxes": boxes, "Variances": variances}
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+class TestBipartiteMatch(OpTest):
+    def test_greedy(self):
+        self.op_type = "bipartite_match"
+        # 2 gt rows x 3 priors
+        dist = np.array([[0.9, 0.2, 0.5],
+                         [0.6, 0.8, 0.1]], np.float32)
+        # greedy: global max 0.9 -> row0/col0; then 0.8 -> row1/col1; col2
+        # unmatched
+        self.inputs = {"DistMat": dist}
+        self.attrs = {"match_type": "bipartite"}
+        self.outputs = {
+            "ColToRowMatchIndices": np.array([[0, 1, -1]], np.int32),
+            "ColToRowMatchDist": np.array([[0.9, 0.8, 0.0]], np.float32),
+        }
+        self.check_output()
+
+    def test_per_prediction(self):
+        self.op_type = "bipartite_match"
+        dist = np.array([[0.9, 0.2, 0.6],
+                         [0.6, 0.8, 0.1]], np.float32)
+        # per_prediction adds col2 -> best row 0 (0.6 > 0.5)
+        self.inputs = {"DistMat": dist}
+        self.attrs = {"match_type": "per_prediction", "dist_threshold": 0.5}
+        self.outputs = {
+            "ColToRowMatchIndices": np.array([[0, 1, 0]], np.int32),
+            "ColToRowMatchDist": np.array([[0.9, 0.8, 0.6]], np.float32),
+        }
+        self.check_output()
+
+
+class TestTargetAssign(OpTest):
+    def test_assign(self):
+        self.op_type = "target_assign"
+        x = np.random.rand(2, 3, 4).astype(np.float32)  # [B, M, K]
+        match = np.array([[0, -1, 2, 1], [2, 2, -1, 0]], np.int32)  # [B, P]
+        out = np.zeros((2, 4, 4), np.float32)
+        w = np.zeros((2, 4, 1), np.float32)
+        for b in range(2):
+            for p in range(4):
+                if match[b, p] >= 0:
+                    out[b, p] = x[b, match[b, p]]
+                    w[b, p] = 1.0
+        self.inputs = {"X": x, "MatchIndices": match}
+        self.attrs = {"mismatch_value": 0}
+        self.outputs = {"Out": out, "OutWeight": w}
+        self.check_output()
+
+
+class TestMineHardExamples(OpTest):
+    def test_max_negative(self):
+        self.op_type = "mine_hard_examples"
+        cls_loss = np.array([[5.0, 1.0, 3.0, 2.0, 4.0]], np.float32)
+        match = np.array([[0, -1, -1, -1, -1]], np.int32)  # 1 positive
+        # ratio 2 -> keep 2 negatives with largest loss: idx 4 (4.0), idx 2 (3.0)
+        self.inputs = {"ClsLoss": cls_loss, "MatchIndices": match}
+        self.attrs = {"neg_pos_ratio": 2.0}
+        self.outputs = {
+            "NegIndices": np.array([[4, 2, -1, -1, -1]], np.int32),
+            "UpdatedMatchIndices": match,
+        }
+        self.check_output()
+
+
+class TestMulticlassNMS(OpTest):
+    def test_suppress(self):
+        self.op_type = "multiclass_nms"
+        # 3 boxes: 0 and 1 overlap heavily; 2 disjoint. class 1 scores favor 0.
+        bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                            [20, 20, 30, 30]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]  # class 1 (class 0 = background)
+        self.inputs = {"BBoxes": bboxes, "Scores": scores}
+        self.attrs = {"score_threshold": 0.1, "nms_threshold": 0.5,
+                      "nms_top_k": 3, "keep_top_k": 3, "background_label": 0}
+        out = np.full((1, 3, 6), -1.0, np.float32)
+        out[0, 0] = [1, 0.9, 0, 0, 10, 10]
+        out[0, 1] = [1, 0.7, 20, 20, 30, 30]
+        self.outputs = {"Out": out}
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+
+class TestDetectionMAP(OpTest):
+    def test_perfect(self):
+        self.op_type = "detection_map"
+        # 1 image, 2 gt, 2 perfect detections -> mAP 1
+        det = np.array([[[1, 0.9, 0, 0, 10, 10],
+                         [2, 0.8, 20, 20, 30, 30]]], np.float32)
+        gt = np.array([[[1, 0, 0, 10, 10, 0],
+                        [2, 20, 20, 30, 30, 0]]], np.float32)
+        self.inputs = {"DetectRes": det, "Label": gt}
+        self.attrs = {"class_num": 3, "background_label": 0,
+                      "ap_type": "integral"}
+        self.outputs = {"MAP": np.array([1.0], np.float32)}
+        self.check_output(no_check_set=("AccumPosCount", "AccumTruePos",
+                                        "AccumFalsePos"))
+
+
+class TestVisionExtras(OpTest):
+    def test_maxout(self):
+        self.op_type = "maxout"
+        x = np.random.rand(2, 6, 3, 3).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"groups": 2}
+        self.outputs = {"Out": x.reshape(2, 3, 2, 3, 3).max(axis=2)}
+        self.check_output()
+        # near-ties inside a max group make central differences noisy
+        self.check_grad(["X"], "Out", max_relative_error=5e-2)
+
+    def test_norm(self):
+        self.op_type = "norm"
+        x = np.random.rand(2, 4, 3, 3).astype(np.float32) + 0.1
+        scale = np.random.rand(4).astype(np.float32)
+        l2 = np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+        self.inputs = {"X": x, "Scale": scale}
+        self.outputs = {"Out": x / l2 * scale.reshape(1, 4, 1, 1)}
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+    def test_pool3d(self):
+        self.op_type = "pool3d"
+        x = np.random.rand(1, 2, 4, 4, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2, 2],
+                      "strides": [2, 2, 2], "paddings": [0, 0, 0]}
+        out = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+        self.outputs = {"Out": out}
+        self.check_output()
+
+    def test_max_pool_with_index_and_unpool(self):
+        import sys, os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from paddle_tpu.fluid.registry import run_forward, EmitCtx
+
+        x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+        ctx = EmitCtx()
+        r = run_forward(ctx, "max_pool2d_with_index", {"X": [x]},
+                        {"ksize": [2, 2], "strides": [2, 2]})
+        vals, idx = np.asarray(r["Out"][0]), np.asarray(r["Mask"][0])
+        assert vals.shape == (1, 1, 2, 2)
+        # index points at the argmax within the full 4x4 map
+        for i in range(2):
+            for j in range(2):
+                win = x[0, 0, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                assert vals[0, 0, i, j] == win.max()
+                fi = idx[0, 0, i, j]
+                assert x[0, 0, fi // 4, fi % 4] == win.max()
+        # unpool scatters back
+        r2 = run_forward(ctx, "unpool",
+                         {"X": [vals], "Indices": [idx]},
+                         {"ksize": [2, 2], "strides": [2, 2]})
+        up = np.asarray(r2["Out"][0])
+        assert up.shape == x.shape
+        assert up.sum() == pytest.approx(vals.sum(), rel=1e-5)
+
+    def test_spp(self):
+        self.op_type = "spp"
+        x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"pyramid_height": 2, "pooling_type": "max"}
+        # level 0: 1x1 bins (global max), level 1: 2x2 bins
+        l0 = x.max(axis=(2, 3)).reshape(2, -1)
+        l1 = x.reshape(2, 3, 2, 4, 2, 4).max(axis=(3, 5)).reshape(2, -1)
+        self.outputs = {"Out": np.concatenate([l0, l1], axis=1)}
+        self.check_output()
+
+    def test_roi_pool(self):
+        self.op_type = "roi_pool"
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0, 0, 0, 3, 3]], np.float32)  # whole image
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0}
+        out = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5)).reshape(1, 1, 2, 2)
+        self.outputs = {"Out": out}
+        self.check_output(no_check_set=("Argmax",))
+
+    def test_row_conv(self):
+        self.op_type = "row_conv"
+        x = np.random.rand(2, 5, 3).astype(np.float32)
+        w = np.random.rand(2, 3).astype(np.float32)
+        out = np.zeros_like(x)
+        for t in range(5):
+            for k in range(2):
+                if t + k < 5:
+                    out[:, t] += x[:, t + k] * w[k]
+        self.inputs = {"X": x, "Filter": w}
+        self.outputs = {"Out": out}
+        self.check_output(atol=1e-5, rtol=1e-4)
+        self.check_grad(["X", "Filter"], "Out")
+
+    def test_conv_shift(self):
+        self.op_type = "conv_shift"
+        x = np.random.rand(2, 7).astype(np.float32)
+        y = np.random.rand(2, 3).astype(np.float32)
+        out = np.zeros_like(x)
+        M, N = 7, 3
+        for b in range(2):
+            for i in range(M):
+                for j in range(N):
+                    out[b, i] += x[b, (i + j - N // 2) % M] * y[b, j]
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": out}
+        self.check_output(atol=1e-5, rtol=1e-4)
+        self.check_grad(["X", "Y"], "Out")
+
+    def test_bilinear_tensor_product(self):
+        self.op_type = "bilinear_tensor_product"
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 5).astype(np.float32)
+        w = np.random.rand(2, 4, 5).astype(np.float32)
+        bias = np.random.rand(2).astype(np.float32)
+        out = np.einsum("bm,kmn,bn->bk", x, w, y) + bias
+        self.inputs = {"X": x, "Y": y, "Weight": w, "Bias": bias}
+        self.outputs = {"Out": out.astype(np.float32)}
+        self.check_output(atol=1e-5, rtol=1e-4)
+        self.check_grad(["X", "Y", "Weight"], "Out",
+                        max_relative_error=2e-2)
+
+
+class TestPositiveNegativePair(OpTest):
+    def test_pairs(self):
+        self.op_type = "positive_negative_pair"
+        score = np.array([0.9, 0.2, 0.5, 0.6], np.float32)
+        label = np.array([1.0, 0.0, 0.0, 1.0], np.float32)
+        qid = np.array([0, 0, 0, 0], np.int32)
+        # pairs with differing labels: (0,1): s 0.9>0.2, l 1>0 -> pos
+        # (0,2): 0.9>0.5, 1>0 -> pos; (1,3): 0.2<0.6, 0<1 -> pos
+        # (2,3): 0.5<0.6, 0<1 -> pos  => 4 pos, 0 neg
+        self.inputs = {"Score": score, "Label": label, "QueryID": qid}
+        self.outputs = {"PositivePair": np.array([4.0], np.float32),
+                        "NegativePair": np.array([0.0], np.float32),
+                        "NeutralPair": np.array([0.0], np.float32)}
+        self.check_output()
+
+
+class TestDetectionMAPDifficult(OpTest):
+    def test_difficult_included_by_default(self):
+        self.op_type = "detection_map"
+        det = np.array([[[1, 0.9, 0, 0, 10, 10]]], np.float32)
+        gt = np.array([[[1, 0, 0, 10, 10, 1]]], np.float32)  # difficult
+        self.inputs = {"DetectRes": det, "Label": gt}
+        self.attrs = {"class_num": 2, "background_label": 0,
+                      "ap_type": "integral", "evaluate_difficult": True}
+        self.outputs = {"MAP": np.array([1.0], np.float32)}
+        self.check_output(no_check_set=("AccumPosCount", "AccumTruePos",
+                                        "AccumFalsePos"))
+
+
+class TestEditDistanceIgnored(OpTest):
+    def test_ignored_tokens_and_padding(self):
+        self.op_type = "edit_distance"
+        # hyp "1 2 3" vs ref "1 3" after dropping token 9 and -1 padding
+        hyps = np.array([[1, 9, 2, 3, -1, -1]], np.int64)
+        refs = np.array([[1, 3, -1, -1, -1, -1]], np.int64)
+        self.inputs = {"Hyps": hyps, "Refs": refs}
+        self.attrs = {"ignored_tokens": [9]}
+        self.outputs = {"Out": np.array([[1.0]], np.float32),
+                        "SequenceNum": np.array([1], np.int64)}
+        self.check_output()
+
+
+class TestChunkEvalPadding(OpTest):
+    def test_padding_not_counted(self):
+        self.op_type = "chunk_eval"
+        # IOB, 2 chunk types; seq "B0 I0" then -1 padding: exactly 1 chunk
+        inf = np.array([[0, 1, -1, -1]], np.int64)
+        self.inputs = {"Inference": inf, "Label": inf.copy()}
+        self.attrs = {"num_chunk_types": 2, "chunk_scheme": "IOB"}
+        self.outputs = {
+            "Precision": np.array([1.0], np.float32),
+            "Recall": np.array([1.0], np.float32),
+            "F1-Score": np.array([1.0], np.float32),
+            "NumInferChunks": np.array([1], np.int64),
+            "NumLabelChunks": np.array([1], np.int64),
+            "NumCorrectChunks": np.array([1], np.int64),
+        }
+        self.check_output()
