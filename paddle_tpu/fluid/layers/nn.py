@@ -21,6 +21,7 @@ __all__ = [
     "sigmoid_cross_entropy_with_logits", "smooth_l1", "lrn", "expand", "pad",
     "im2sequence", "prelu", "autoincreased_step_counter", "cos_sim",
     "dot_product_attention", "edit_distance", "chunk_eval",
+    "ring_attention",
 ]
 
 
@@ -775,3 +776,28 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
     )
     return (precision, recall, f1_score, num_infer_chunks, num_label_chunks,
             num_correct_chunks)
+
+
+def ring_attention(q, k, v, causal=False, scale=0.0, impl="ring",
+                   seq_axis="sp", batch_axis="dp", head_axis="", name=None):
+    """Fused flash attention with optional sequence/context parallelism.
+
+    q/k/v: [batch, seq, heads, head_dim]. Single-device this is one-block
+    flash attention (f32 online softmax); under a ParallelExecutor mesh with
+    `seq_axis`, the sequence dim is sharded and attention runs as a ring
+    (K/V rotate over ICI via ppermute) or Ulysses (head<->seq all_to_all)
+    — see paddle_tpu/parallel/sequence_parallel.py. No 2018 reference
+    counterpart (attention composed from mul/softmax, nets.py:345); this is
+    the TPU-native long-context capability (SURVEY.md §5.7).
+    """
+    helper = LayerHelper("ring_attention", name=name)
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    helper.append_op(
+        type="ring_attention",
+        inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"causal": causal, "scale": scale, "impl": impl,
+               "seq_axis": seq_axis, "batch_axis": batch_axis,
+               "head_axis": head_axis},
+    )
+    return out

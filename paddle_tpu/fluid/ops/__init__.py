@@ -5,6 +5,7 @@ SURVEY.md §2.4). Importing this package registers all ops.
 """
 from . import (  # noqa: F401
     activations,
+    attention_ops,
     beam_search_ops,
     compare_ops,
     control_flow,

@@ -1,0 +1,50 @@
+"""Fused / sequence-parallel attention ops.
+
+The reference has no fused attention op (2018 — attention is composed from
+mul/softmax, e.g. `python/paddle/fluid/nets.py:345`
+scaled_dot_product_attention). These ops are the TPU-native capability
+extension (SURVEY.md §5.7): flash-style attention on one chip, ring or
+Ulysses sequence parallelism over a mesh axis when lowered under a mesh.
+"""
+from __future__ import annotations
+
+from ..registry import register_op
+from .common import one
+
+
+@register_op("ring_attention", no_grad=(),
+             ref="python/paddle/fluid/nets.py:345 (composed attention)")
+def ring_attention(ctx, ins, attrs):
+    """Q/K/V: [B, S, H, D]. Attrs: causal (bool), scale (float or 0 =
+    1/sqrt(D)), impl ('ring' | 'ulysses'), seq_axis, batch_axis, head_axis.
+
+    Under a mesh (ParallelExecutor sets parallel.mesh_context) with the
+    seq_axis present, runs SPMD via shard_map; otherwise falls back to the
+    same math single-device (one-block flash attention). The custom_vjp on
+    the shard function makes the generic grad path take the ring backward.
+    """
+    from ...parallel import current_mesh
+    from ...parallel.sequence_parallel import (
+        ring_attention_shard,
+        sequence_parallel_attention,
+    )
+
+    q, k, v = one(ins, "Q"), one(ins, "K"), one(ins, "V")
+    causal = bool(attrs.get("causal", False))
+    scale = float(attrs.get("scale", 0.0)) or None
+    impl = attrs.get("impl", "ring")
+    seq_axis = attrs.get("seq_axis", "sp")
+
+    mesh = current_mesh()
+    if mesh is None or seq_axis not in mesh.axis_names:
+        return ring_attention_shard(q, k, v, None, causal, scale)
+    batch_axis = attrs.get("batch_axis", "") or None
+    if batch_axis is not None and batch_axis not in mesh.axis_names:
+        batch_axis = None
+    head_axis = attrs.get("head_axis", "") or None
+    if head_axis is not None and head_axis not in mesh.axis_names:
+        head_axis = None
+    return sequence_parallel_attention(
+        q, k, v, mesh, seq_axis=seq_axis, batch_axis=batch_axis,
+        head_axis=head_axis, causal=causal, scale=scale, impl=impl,
+    )

@@ -83,16 +83,28 @@ class ParallelExecutor:
         fetch_names = tuple(_as_name(v) for v in fetch_list)
         mesh = self._mesh
 
-        batch_ax = self._plan.batch_axis
-        dp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(batch_ax, 1)
+        axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+        def _divisible(arr, spec):
+            # every sharded dim must divide by its mesh-axis size, else
+            # fall back to replication (reference PE pads/splits feeds;
+            # here an indivisible feed just stays unsharded)
+            for dim, ax in enumerate(spec):
+                if ax is None:
+                    continue
+                axes = ax if isinstance(ax, tuple) else (ax,)
+                size = int(np.prod([axis_sizes.get(a, 1) for a in axes]))
+                if dim >= arr.ndim or arr.shape[dim] % size != 0:
+                    return False
+            return True
+
         feed_arrays = {}
         for k, v in feed.items():
             arr = np.asarray(v)
-            if arr.shape and batch_ax and arr.shape[0] % dp_size == 0:
-                sharding = NamedSharding(mesh, self._plan.feed_spec(arr.ndim))
-            else:
-                sharding = NamedSharding(mesh, P(*([None] * arr.ndim)))
-            feed_arrays[k] = jax.device_put(arr, sharding)
+            spec = self._plan.feed_spec(arr.ndim)
+            if not (arr.shape and self._plan.batch_axis and _divisible(arr, spec)):
+                spec = P(*([None] * arr.ndim))
+            feed_arrays[k] = jax.device_put(arr, NamedSharding(mesh, spec))
 
         feed_sig = tuple(
             sorted((k, tuple(v.shape), str(v.dtype)) for k, v in feed_arrays.items())
@@ -139,7 +151,12 @@ class ParallelExecutor:
         state_ro = {n: _place(n, self._scope.find_var(n)) for n in ro_names}
         state_rw = {n: _place(n, self._scope.find_var(n)) for n in rw_names}
         key = _next_key(program)
-        fetches, new_state = jfn(feed_arrays, state_ro, state_rw, key)
+        from ..parallel import mesh_context
+
+        # emitters that need explicit SPMD (ring attention) see the mesh
+        # during tracing, which happens inside this first call
+        with mesh_context(mesh):
+            fetches, new_state = jfn(feed_arrays, state_ro, state_rw, key)
         for n, v in new_state.items():
             self._scope.set_var(n, v)
         if return_numpy:

@@ -29,6 +29,13 @@ class TransformerConfig:
     n_layers: int = 2
     dropout: float = 0.1
     is_test: bool = False
+    # sequence/context parallelism: attention runs as the fused
+    # ring/ulysses op (layers.ring_attention) with the sequence dim sharded
+    # over `sp_axis` of the ParallelExecutor mesh. Attention-prob dropout is
+    # skipped in this mode (flash attention never materializes the probs).
+    seq_parallel: bool = False
+    sp_impl: str = "ring"
+    sp_axis: str = "sp"
 
 
 def _pos_encoding_table(max_len, d_model):
@@ -49,9 +56,12 @@ def _const_param(name, value):
     )
 
 
-def _mha(cfg: TransformerConfig, q_in, kv_in, mask=None, name=""):
+def _mha(cfg: TransformerConfig, q_in, kv_in, mask=None, causal=False,
+         name=""):
     """Multi-head attention: fc projections on [N, L, D] (num_flatten_dims=2),
-    batched 4D matmuls on the MXU."""
+    batched 4D matmuls on the MXU. With cfg.seq_parallel, the score/softmax/
+    context chain is replaced by the fused ring attention op (sequence dim
+    sharded over the mesh's sp axis)."""
     d, h = cfg.d_model, cfg.n_heads
     dh = d // h
 
@@ -61,23 +71,36 @@ def _mha(cfg: TransformerConfig, q_in, kv_in, mask=None, name=""):
             param_attr=ParamAttr(name=f"{name}.{pname}.w"),
         )
 
-    def split_heads(x):
-        r = layers.reshape(x, shape=[0, 0, h, dh])
-        return layers.transpose(r, perm=[0, 2, 1, 3])  # [N, H, L, dh]
+    if cfg.seq_parallel:
+        if mask is not None:
+            raise ValueError(
+                "seq_parallel _mha only supports causal masking (the fused "
+                "ring attention op takes no additive mask)"
+            )
+        q = layers.reshape(proj(q_in, "q"), shape=[0, 0, h, dh])
+        k = layers.reshape(proj(kv_in, "k"), shape=[0, 0, h, dh])
+        v = layers.reshape(proj(kv_in, "v"), shape=[0, 0, h, dh])
+        ctx = layers.ring_attention(
+            q, k, v, causal=causal, impl=cfg.sp_impl, seq_axis=cfg.sp_axis,
+        )  # [N, L, H, dh]
+    else:
+        def split_heads(x):
+            r = layers.reshape(x, shape=[0, 0, h, dh])
+            return layers.transpose(r, perm=[0, 2, 1, 3])  # [N, H, L, dh]
 
-    q = split_heads(proj(q_in, "q"))
-    k = split_heads(proj(kv_in, "k"))
-    v = split_heads(proj(kv_in, "v"))
+        q = split_heads(proj(q_in, "q"))
+        k = split_heads(proj(kv_in, "k"))
+        v = split_heads(proj(kv_in, "v"))
 
-    scores = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
-    if mask is not None:
-        scores = layers.elementwise_add(scores, mask)  # bcast [L,L] onto tail
-    weights = layers.softmax(scores)
-    if cfg.dropout and not cfg.is_test:
-        weights = layers.dropout(weights, dropout_prob=cfg.dropout,
-                                 is_test=cfg.is_test)
-    ctx = layers.matmul(weights, v)  # [N, H, L, dh]
-    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+        scores = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
+        if mask is not None:
+            scores = layers.elementwise_add(scores, mask)  # bcast [L,L] on tail
+        weights = layers.softmax(scores)
+        if cfg.dropout and not cfg.is_test:
+            weights = layers.dropout(weights, dropout_prob=cfg.dropout,
+                                     is_test=cfg.is_test)
+        ctx = layers.matmul(weights, v)  # [N, H, L, dh]
+        ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])  # [N, L, H, dh]
     ctx = layers.reshape(ctx, shape=[0, 0, d])
     return layers.fc(
         input=ctx, size=d, num_flatten_dims=2, bias_attr=False,
@@ -130,13 +153,17 @@ def encoder(cfg: TransformerConfig, src_ids):
 
 
 def decoder(cfg: TransformerConfig, trg_ids, enc_out):
-    causal = np.triu(
-        np.full((cfg.max_len, cfg.max_len), -1e9, dtype=np.float32), k=1
-    )
-    mask = _const_param("dec.causal_mask", causal)
+    if cfg.seq_parallel:
+        mask = None  # causal handled inside the ring attention op
+    else:
+        causal = np.triu(
+            np.full((cfg.max_len, cfg.max_len), -1e9, dtype=np.float32), k=1
+        )
+        mask = _const_param("dec.causal_mask", causal)
     x = _embed(cfg, trg_ids, cfg.trg_vocab, "dec")
     for i in range(cfg.n_layers):
-        x = _residual_ln(x, _mha(cfg, x, x, mask=mask, name=f"dec{i}.self"),
+        x = _residual_ln(x, _mha(cfg, x, x, mask=mask, causal=True,
+                                 name=f"dec{i}.self"),
                          name=f"dec{i}.a")
         x = _residual_ln(x, _mha(cfg, x, enc_out, name=f"dec{i}.cross"),
                          name=f"dec{i}.b")

@@ -7,7 +7,15 @@ Mesh and XLA's SPMD partitioner inserts the ICI collectives.
 """
 from .api import (  # noqa: F401
     ShardingPlan,
+    current_mesh,
     make_mesh,
+    mesh_context,
     plan_data_parallel,
+    plan_sequence_parallel,
     plan_transformer_tp,
+)
+from .sequence_parallel import (  # noqa: F401
+    ring_attention_shard,
+    sequence_parallel_attention,
+    ulysses_attention_shard,
 )

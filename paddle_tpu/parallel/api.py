@@ -6,12 +6,33 @@ SPMD with XLA-inserted collectives.
 """
 from __future__ import annotations
 
+import contextlib
 import re
+import threading
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_mesh_tls = threading.local()
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The mesh active during program lowering, if any. Op emitters that need
+    explicit SPMD (ring attention's shard_map) read it here; None means
+    single-device lowering."""
+    return getattr(_mesh_tls, "mesh", None)
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Optional[Mesh]):
+    prev = current_mesh()
+    _mesh_tls.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _mesh_tls.mesh = prev
 
 
 def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
@@ -31,9 +52,11 @@ class ShardingPlan:
     unmatched vars are replicated."""
 
     def __init__(self, rules: Sequence[Tuple[str, P]] = (),
-                 batch_axis: Optional[str] = "dp"):
+                 batch_axis: Optional[str] = "dp",
+                 seq_axis: Optional[str] = None):
         self.rules = [(re.compile(pat), spec) for pat, spec in rules]
         self.batch_axis = batch_axis
+        self.seq_axis = seq_axis
 
     def add(self, pattern: str, spec: P) -> "ShardingPlan":
         self.rules.append((re.compile(pattern), spec))
@@ -53,6 +76,9 @@ class ShardingPlan:
     def feed_spec(self, ndim: int) -> P:
         if self.batch_axis is None or ndim == 0:
             return P()
+        if self.seq_axis is not None and ndim >= 2:
+            # sequence-parallel feeds: [batch, seq, ...] shard both leading dims
+            return P(self.batch_axis, self.seq_axis, *([None] * (ndim - 2)))
         return P(self.batch_axis, *([None] * (ndim - 1)))
 
 
@@ -81,3 +107,12 @@ def plan_transformer_tp() -> ShardingPlan:
         ],
         batch_axis="dp",
     )
+
+
+def plan_sequence_parallel(batch_axis: str = "dp",
+                           seq_axis: str = "sp") -> ShardingPlan:
+    """Context parallelism: feeds shard on [batch, seq]; params replicated.
+    Attention itself must use a sequence-parallel op (ring/ulysses, see
+    sequence_parallel.py) — pointwise/fc layers shard over seq for free
+    under GSPMD."""
+    return ShardingPlan(batch_axis=batch_axis, seq_axis=seq_axis)
