@@ -21,7 +21,7 @@ __all__ = [
     "sigmoid_cross_entropy_with_logits", "smooth_l1", "lrn", "expand", "pad",
     "im2sequence", "prelu", "autoincreased_step_counter", "cos_sim",
     "dot_product_attention", "edit_distance", "chunk_eval",
-    "ring_attention",
+    "ring_attention", "moe",
 ]
 
 
@@ -801,3 +801,40 @@ def ring_attention(q, k, v, causal=False, scale=0.0, impl="ring",
                "head_axis": head_axis},
     )
     return out
+
+
+def moe(input, num_experts, d_ff, capacity_factor=1.25, ep_axis="ep",
+        name=None):
+    """Mixture-of-experts FFN layer (Switch-style top-1 routing, moe_ffn op).
+
+    input: [..., d]. Creates router weights [d, E] and expert weight stacks
+    `<name>.experts.w1` [E, d, d_ff] / `<name>.experts.w2` [E, d_ff, d];
+    under a ParallelExecutor mesh with `ep_axis` (plan_moe_ep) the expert
+    stacks shard over it. Returns (out, aux_loss) — add a small multiple of
+    aux_loss to the training loss for load balancing. TPU-native capability
+    extension; no 2018 reference counterpart.
+    """
+    helper = LayerHelper("moe", name=name)
+    dtype = input.dtype
+    d = input.shape[-1]
+    base = name or helper.name or "moe"
+    from ..param_attr import ParamAttr
+
+    router_w = helper.create_parameter(
+        ParamAttr(name=f"{base}.router.w"), [d, num_experts], dtype
+    )
+    w1 = helper.create_parameter(
+        ParamAttr(name=f"{base}.experts.w1"), [num_experts, d, d_ff], dtype
+    )
+    w2 = helper.create_parameter(
+        ParamAttr(name=f"{base}.experts.w2"), [num_experts, d_ff, d], dtype
+    )
+    out = helper.create_variable_for_type_inference(dtype)
+    aux = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="moe_ffn",
+        inputs={"X": [input], "RouterW": [router_w], "W1": [w1], "W2": [w2]},
+        outputs={"Out": [out], "AuxLoss": [aux]},
+        attrs={"capacity_factor": capacity_factor, "ep_axis": ep_axis},
+    )
+    return out, aux

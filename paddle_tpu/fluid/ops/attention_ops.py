@@ -48,3 +48,26 @@ def ring_attention(ctx, ins, attrs):
         q, k, v, mesh, seq_axis=seq_axis, batch_axis=batch_axis,
         head_axis=head_axis, causal=causal, scale=scale, impl=impl,
     )
+
+
+@register_op("moe_ffn", no_grad=(), ref="(TPU-native capability extension)")
+def moe_ffn_op(ctx, ins, attrs):
+    """Mixture-of-experts FFN (Switch-style top-1, dense dispatch). Inputs:
+    X [.., d], RouterW [d, E], W1 [E, d, ff], W2 [E, ff, d]. Outputs: Out,
+    AuxLoss (load-balancing loss — add a multiple of it to the model loss).
+    Under a mesh with attr `ep_axis`, experts shard over it and XLA inserts
+    the token all-to-alls."""
+    from ...parallel import current_mesh
+    from ...parallel.moe import moe_ffn
+
+    x = one(ins, "X")
+    router_w, w1, w2 = one(ins, "RouterW"), one(ins, "W1"), one(ins, "W2")
+    ep_axis = attrs.get("ep_axis", "ep")
+    mesh = current_mesh()
+    if mesh is not None and ep_axis not in mesh.axis_names:
+        mesh = None
+    out, aux = moe_ffn(
+        x, router_w, w1, w2, mesh=mesh, ep_axis=ep_axis,
+        capacity_factor=float(attrs.get("capacity_factor", 1.25)),
+    )
+    return {"Out": out, "AuxLoss": aux}

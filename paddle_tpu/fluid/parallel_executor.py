@@ -123,12 +123,16 @@ class ParallelExecutor:
                 block, tuple(feed_arrays), fetch_names, tuple(state_in),
                 tuple(state_out),
             )
+            def _state_spec(n):
+                # _divisible only reads .shape/.ndim — no host transfer
+                val = jnp.asarray(self._scope.find_var(n))
+                spec = self._plan.spec_for(n, val.ndim)
+                if not _divisible(val, spec):
+                    spec = P(*([None] * val.ndim))
+                return spec
+
             out_state_shardings = {
-                n: NamedSharding(
-                    mesh,
-                    self._plan.spec_for(n, np.ndim(self._scope.find_var(n))),
-                )
-                for n in state_out
+                n: NamedSharding(mesh, _state_spec(n)) for n in state_out
             }
             jfn = jax.jit(
                 fn,
@@ -143,6 +147,10 @@ class ParallelExecutor:
         def _place(name, x):
             x = jnp.asarray(x)
             spec = self._plan.spec_for(name, x.ndim)
+            if not _divisible(x, spec):
+                # e.g. a plan rule matching a param also catches its scalar
+                # optimizer accumulators — those stay replicated
+                spec = P(*([None] * x.ndim))
             target = NamedSharding(mesh, spec)
             if getattr(x, "sharding", None) == target:
                 return x

@@ -11,8 +11,15 @@ from .api import (  # noqa: F401
     make_mesh,
     mesh_context,
     plan_data_parallel,
+    plan_moe_ep,
     plan_sequence_parallel,
     plan_transformer_tp,
+)
+from .moe import moe_ffn, top1_dispatch  # noqa: F401
+from .pipeline import (  # noqa: F401
+    pipeline_apply,
+    shard_stage_params,
+    stack_stage_params,
 )
 from .sequence_parallel import (  # noqa: F401
     ring_attention_shard,

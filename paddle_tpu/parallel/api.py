@@ -109,6 +109,16 @@ def plan_transformer_tp() -> ShardingPlan:
     )
 
 
+def plan_moe_ep(batch_axis: str = "dp", ep_axis: str = "ep") -> ShardingPlan:
+    """Expert parallelism: expert weight stacks ([E, ...], created by
+    layers.moe as `<name>.experts.w{1,2}`) shard their expert axis over ep;
+    router + everything else replicated; feeds on batch."""
+    return ShardingPlan(
+        rules=[(r"\.experts\.w[12](_\w+)?$", P(ep_axis))],
+        batch_axis=batch_axis,
+    )
+
+
 def plan_sequence_parallel(batch_axis: str = "dp",
                            seq_axis: str = "sp") -> ShardingPlan:
     """Context parallelism: feeds shard on [batch, seq]; params replicated.

@@ -1,0 +1,83 @@
+"""Mixture-of-Experts with expert parallelism over a mesh axis.
+
+No reference counterpart (2018). TPU-native design: Switch/GShard-style
+dense dispatch — routing is expressed as one-hot einsums with static
+capacity (XLA-friendly: no dynamic shapes), expert weights carry a leading
+expert axis sharded over `ep`, and sharding constraints make XLA's SPMD
+partitioner insert the token all-to-alls over ICI.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _constrain(x, mesh: Optional[Mesh], spec: P):
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def top1_dispatch(gates, capacity: int):
+    """Switch-style top-1 routing. gates: [T, E] softmax probs. Returns
+    (dispatch [T, E, C] one-hot, combine [T, E, C] gate-weighted, aux_loss).
+    Tokens beyond an expert's capacity C are dropped (output 0 for them —
+    the residual connection around the MoE layer carries them through)."""
+    t, e = gates.shape
+    expert_idx = jnp.argmax(gates, axis=-1)                     # [T]
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=gates.dtype)   # [T, E]
+    # load-balancing aux loss (Switch Transformer eq. 4):
+    # E * sum_e (fraction of tokens to e) * (mean gate prob of e)
+    density = onehot.mean(axis=0)
+    density_proxy = gates.mean(axis=0)
+    aux_loss = (density * density_proxy).sum() * e
+    # position of each token within its expert's queue
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot           # [T, E]
+    pos = pos.sum(axis=-1)                                      # [T]
+    keep = (pos < capacity).astype(gates.dtype)
+    onehot = onehot * keep[:, None]
+    pos_onehot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=gates.dtype)  # [T, C]
+    dispatch = onehot[:, :, None] * pos_onehot[:, None, :]      # [T, E, C]
+    gate_val = (gates * onehot).sum(axis=-1)                    # [T]
+    combine = dispatch * gate_val[:, None, None]
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(
+    x, router_w, w1, w2,
+    mesh: Optional[Mesh] = None, ep_axis: str = "ep",
+    capacity_factor: float = 1.25, activation=jax.nn.relu,
+) -> Tuple[jax.Array, jax.Array]:
+    """MoE feed-forward. x: [..., d]; router_w: [d, E]; w1: [E, d, ff];
+    w2: [E, ff, d]. Returns (out [..., d], aux_loss scalar).
+
+    The [E, ...] dims of the dispatched activations are constrained to shard
+    over `ep_axis`; with w1/w2 sharded the same way each device computes only
+    its experts and XLA all-to-alls the tokens in and out.
+    """
+    d = x.shape[-1]
+    e = router_w.shape[1]
+    xt = x.reshape(-1, d)
+    t = xt.shape[0]
+    capacity = int(np.ceil(t / e * capacity_factor))
+
+    logits = jnp.einsum("td,de->te", xt, router_w,
+                        preferred_element_type=jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine, aux_loss = top1_dispatch(gates, capacity)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)
+    expert_in = _constrain(expert_in, mesh, P(ep_axis, None, None))
+    h = activation(jnp.einsum("ecd,edf->ecf", expert_in, w1))
+    h = _constrain(h, mesh, P(ep_axis, None, None))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w2)
+    expert_out = _constrain(expert_out, mesh, P(ep_axis, None, None))
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out.reshape(x.shape), aux_loss.astype(jnp.float32)
