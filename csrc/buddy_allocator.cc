@@ -1,0 +1,126 @@
+// Buddy allocator over a host arena.
+//
+// Capability parity with the reference's
+// paddle/fluid/memory/detail/buddy_allocator.h:33 (buddy system over chunks
+// from a SystemAllocator). On TPU the device HBM is managed by PJRT, so the
+// native allocator's role here is the *host* staging side: pinned-style
+// aligned buffers for input pipelines and checkpoint IO, with O(log n)
+// alloc/free and coalescing — metadata kept out-of-band like the
+// reference's MetadataCache (detail/meta_cache.cc).
+
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <set>
+#include <vector>
+
+namespace {
+
+struct Buddy {
+  unsigned char* arena = nullptr;
+  uint64_t total = 0;       // power of two
+  uint64_t min_block = 0;   // power of two
+  int levels = 0;           // level 0 = whole arena
+  // free offsets per level; allocated offset -> level
+  std::vector<std::set<uint64_t>> free_lists;
+  std::map<uint64_t, int> allocated;
+  uint64_t used = 0;
+  std::mutex mu;
+
+  uint64_t block_size(int level) const { return total >> level; }
+};
+
+uint64_t next_pow2(uint64_t v) {
+  uint64_t p = 1;
+  while (p < v) p <<= 1;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_buddy_create(uint64_t total_bytes, uint64_t min_block) {
+  if (total_bytes == 0) return nullptr;
+  auto* b = new Buddy();
+  b->total = next_pow2(total_bytes);
+  b->min_block = next_pow2(min_block ? min_block : 256);
+  if (b->min_block > b->total) b->min_block = b->total;
+  b->levels = 0;
+  for (uint64_t s = b->total; s > b->min_block; s >>= 1) b->levels++;
+  b->free_lists.resize(b->levels + 1);
+  if (posix_memalign(reinterpret_cast<void**>(&b->arena), 4096, b->total)) {
+    delete b;
+    return nullptr;
+  }
+  b->free_lists[0].insert(0);
+  return b;
+}
+
+void* pt_buddy_alloc(void* bp, uint64_t size) {
+  auto* b = static_cast<Buddy*>(bp);
+  if (size == 0 || size > b->total) return nullptr;
+  uint64_t want = next_pow2(size < b->min_block ? b->min_block : size);
+  int level = 0;
+  while (b->block_size(level) > want && level < b->levels) level++;
+  if (b->block_size(level) < want) level--;
+
+  std::lock_guard<std::mutex> lk(b->mu);
+  // find the lowest level <= target with a free block
+  int l = level;
+  while (l >= 0 && b->free_lists[l].empty()) l--;
+  if (l < 0) return nullptr;
+  uint64_t off = *b->free_lists[l].begin();
+  b->free_lists[l].erase(b->free_lists[l].begin());
+  // split down to the target level
+  while (l < level) {
+    l++;
+    uint64_t buddy_off = off + b->block_size(l);
+    b->free_lists[l].insert(buddy_off);
+  }
+  b->allocated[off] = level;
+  b->used += b->block_size(level);
+  return b->arena + off;
+}
+
+int pt_buddy_free(void* bp, void* p) {
+  auto* b = static_cast<Buddy*>(bp);
+  uint64_t off = static_cast<unsigned char*>(p) - b->arena;
+  std::lock_guard<std::mutex> lk(b->mu);
+  auto it = b->allocated.find(off);
+  if (it == b->allocated.end()) return -1;  // double free / bad pointer
+  int level = it->second;
+  b->allocated.erase(it);
+  b->used -= b->block_size(level);
+  // coalesce with buddy while possible
+  while (level > 0) {
+    uint64_t buddy_off = off ^ b->block_size(level);
+    auto& fl = b->free_lists[level];
+    auto bit = fl.find(buddy_off);
+    if (bit == fl.end()) break;
+    fl.erase(bit);
+    off = off < buddy_off ? off : buddy_off;
+    level--;
+  }
+  b->free_lists[level].insert(off);
+  return 0;
+}
+
+uint64_t pt_buddy_used(void* bp) {
+  auto* b = static_cast<Buddy*>(bp);
+  std::lock_guard<std::mutex> lk(b->mu);
+  return b->used;
+}
+
+uint64_t pt_buddy_total(void* bp) {
+  return static_cast<Buddy*>(bp)->total;
+}
+
+void pt_buddy_destroy(void* bp) {
+  auto* b = static_cast<Buddy*>(bp);
+  free(b->arena);
+  delete b;
+}
+
+}  // extern "C"

@@ -1,0 +1,244 @@
+// RecordIO: chunked, compressed, checksummed record file + threaded
+// multi-file prefetch reader.
+//
+// Capability parity with the reference's paddle/fluid/recordio/ (chunk.h:26,
+// header.h:25 — snappy-compressed chunks) redesigned for this stack: zlib
+// (always present) instead of snappy, crc32 over the compressed payload,
+// and a C ABI consumed from Python via ctypes (the reference binds through
+// pybind). The multi-file reader is the native data-plane: a ThreadPool
+// decompresses chunks off the Python thread (no GIL) into a bounded
+// ByteChannel (reference operators/reader/open_files_op.cc).
+//
+// File layout:
+//   magic "PTRIO1\n\0" (8 bytes) | chunk*
+//   chunk := u32 n_records | u32 raw_len | u32 comp_len | u32 crc32(comp)
+//            | comp bytes (zlib of records)
+//   records := (u32 len | bytes)*
+// All integers little-endian.
+
+#include <zlib.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "channel.h"
+#include "threadpool.h"
+
+namespace {
+
+constexpr char kMagic[8] = {'P', 'T', 'R', 'I', 'O', '1', '\n', '\0'};
+
+void put_u32(std::string* s, uint32_t v) {
+  char b[4] = {static_cast<char>(v & 0xff), static_cast<char>((v >> 8) & 0xff),
+               static_cast<char>((v >> 16) & 0xff),
+               static_cast<char>((v >> 24) & 0xff)};
+  s->append(b, 4);
+}
+
+uint32_t get_u32(const unsigned char* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+struct Writer {
+  FILE* f = nullptr;
+  std::string buf;          // raw records of the open chunk
+  uint32_t n_records = 0;
+  uint32_t max_chunk;
+
+  bool FlushChunk() {
+    if (n_records == 0) return true;
+    uLongf comp_cap = compressBound(buf.size());
+    std::vector<unsigned char> comp(comp_cap);
+    if (compress2(comp.data(), &comp_cap,
+                  reinterpret_cast<const unsigned char*>(buf.data()),
+                  buf.size(), Z_DEFAULT_COMPRESSION) != Z_OK)
+      return false;
+    uint32_t crc =
+        crc32(0L, comp.data(), static_cast<uInt>(comp_cap));
+    std::string head;
+    put_u32(&head, n_records);
+    put_u32(&head, static_cast<uint32_t>(buf.size()));
+    put_u32(&head, static_cast<uint32_t>(comp_cap));
+    put_u32(&head, crc);
+    if (fwrite(head.data(), 1, head.size(), f) != head.size()) return false;
+    if (fwrite(comp.data(), 1, comp_cap, f) != comp_cap) return false;
+    buf.clear();
+    n_records = 0;
+    return true;
+  }
+};
+
+struct Reader {
+  FILE* f = nullptr;
+  std::string chunk;        // decompressed records of the current chunk
+  size_t pos = 0;           // cursor within chunk
+  std::string cur;          // last record returned (owned until next call)
+  bool error = false;
+
+  // returns 1 ok, 0 eof, -1 corrupt
+  int LoadChunk() {
+    unsigned char head[16];
+    size_t n = fread(head, 1, 16, f);
+    if (n == 0) return 0;
+    if (n != 16) return -1;
+    uint32_t n_records = get_u32(head);
+    uint32_t raw_len = get_u32(head + 4);
+    uint32_t comp_len = get_u32(head + 8);
+    uint32_t crc = get_u32(head + 12);
+    (void)n_records;
+    std::vector<unsigned char> comp(comp_len);
+    if (fread(comp.data(), 1, comp_len, f) != comp_len) return -1;
+    if (crc32(0L, comp.data(), comp_len) != crc) return -1;
+    chunk.resize(raw_len);
+    uLongf dst = raw_len;
+    if (uncompress(reinterpret_cast<unsigned char*>(&chunk[0]), &dst,
+                   comp.data(), comp_len) != Z_OK || dst != raw_len)
+      return -1;
+    pos = 0;
+    return 1;
+  }
+};
+
+bool read_magic(FILE* f) {
+  char m[8];
+  return fread(m, 1, 8, f) == 8 && memcmp(m, kMagic, 8) == 0;
+}
+
+// Multi-file prefetch reader: pool threads parse files into a channel.
+struct MultiReader {
+  std::unique_ptr<ptnative::ByteChannel> chan;
+  std::unique_ptr<ptnative::ThreadPool> pool;
+  std::atomic<int> pending{0};
+  std::string cur;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path, int max_chunk_bytes) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  if (fwrite(kMagic, 1, 8, f) != 8) {
+    fclose(f);
+    return nullptr;
+  }
+  auto* w = new Writer();
+  w->f = f;
+  w->max_chunk = max_chunk_bytes > 0 ? max_chunk_bytes : (1 << 20);
+  return w;
+}
+
+int rio_writer_write(void* wp, const char* data, uint64_t len) {
+  auto* w = static_cast<Writer*>(wp);
+  put_u32(&w->buf, static_cast<uint32_t>(len));
+  w->buf.append(data, len);
+  w->n_records++;
+  if (w->buf.size() >= w->max_chunk) return w->FlushChunk() ? 0 : -1;
+  return 0;
+}
+
+int rio_writer_close(void* wp) {
+  auto* w = static_cast<Writer*>(wp);
+  bool ok = w->FlushChunk();
+  ok = (fclose(w->f) == 0) && ok;
+  delete w;
+  return ok ? 0 : -1;
+}
+
+void* rio_reader_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  if (!read_magic(f)) {
+    fclose(f);
+    return nullptr;
+  }
+  auto* r = new Reader();
+  r->f = f;
+  return r;
+}
+
+// returns record length; -1 = EOF; -2 = corrupt file
+int64_t rio_reader_next(void* rp, const char** data) {
+  auto* r = static_cast<Reader*>(rp);
+  if (r->error) return -2;
+  while (r->pos >= r->chunk.size()) {
+    int rc = r->LoadChunk();
+    if (rc == 0) return -1;
+    if (rc < 0) {
+      r->error = true;
+      return -2;
+    }
+  }
+  if (r->pos + 4 > r->chunk.size()) {
+    r->error = true;
+    return -2;
+  }
+  uint32_t len = get_u32(
+      reinterpret_cast<const unsigned char*>(r->chunk.data()) + r->pos);
+  r->pos += 4;
+  if (r->pos + len > r->chunk.size()) {
+    r->error = true;
+    return -2;
+  }
+  r->cur.assign(r->chunk, r->pos, len);
+  r->pos += len;
+  *data = r->cur.data();
+  return static_cast<int64_t>(len);
+}
+
+void rio_reader_close(void* rp) {
+  auto* r = static_cast<Reader*>(rp);
+  fclose(r->f);
+  delete r;
+}
+
+void* rio_multi_reader_open(const char** paths, int n_files, int n_threads,
+                            int queue_capacity) {
+  auto* m = new MultiReader();
+  m->chan.reset(new ptnative::ByteChannel(
+      queue_capacity > 0 ? queue_capacity : 256));
+  m->pool.reset(new ptnative::ThreadPool(n_threads > 0 ? n_threads : 2));
+  m->pending.store(n_files);
+  for (int i = 0; i < n_files; ++i) {
+    std::string path(paths[i]);
+    auto* chan = m->chan.get();
+    auto* pending = &m->pending;
+    m->pool->Submit([path, chan, pending] {
+      void* r = rio_reader_open(path.c_str());
+      if (r) {
+        const char* data;
+        int64_t len;
+        while ((len = rio_reader_next(r, &data)) >= 0) {
+          if (!chan->Send(std::string(data, static_cast<size_t>(len)))) break;
+        }
+        rio_reader_close(r);
+      }
+      if (pending->fetch_sub(1) == 1) chan->Close();  // last file done
+    });
+  }
+  return m;
+}
+
+int64_t rio_multi_reader_next(void* mp, const char** data) {
+  auto* m = static_cast<MultiReader*>(mp);
+  if (!m->chan->Recv(&m->cur)) return -1;
+  *data = m->cur.data();
+  return static_cast<int64_t>(m->cur.size());
+}
+
+void rio_multi_reader_close(void* mp) {
+  auto* m = static_cast<MultiReader*>(mp);
+  m->chan->Close();   // unblocks producer threads
+  m->pool.reset();    // joins threads
+  delete m;
+}
+
+}  // extern "C"

@@ -85,24 +85,40 @@ class ParallelExecutor:
 
         axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
 
-        def _divisible(arr, spec):
-            # every sharded dim must divide by its mesh-axis size, else
-            # fall back to replication (reference PE pads/splits feeds;
-            # here an indivisible feed just stays unsharded)
+        def _divisible(shape, spec):
+            # every sharded dim must divide by its mesh-axis size
             for dim, ax in enumerate(spec):
                 if ax is None:
                     continue
                 axes = ax if isinstance(ax, tuple) else (ax,)
                 size = int(np.prod([axis_sizes.get(a, 1) for a in axes]))
-                if dim >= arr.ndim or arr.shape[dim] % size != 0:
+                if dim >= len(shape) or shape[dim] % size != 0:
                     return False
             return True
+
+        def _resolve_spec(name, shape):
+            """Plan spec for a state var. Size-1 arrays (scalar optimizer
+            accumulators whose names match a param rule) fall back to
+            replication; a genuinely indivisible param is a misconfigured
+            plan and fails loudly."""
+            spec = self._plan.spec_for(name, len(shape))
+            if _divisible(shape, spec):
+                return spec
+            if int(np.prod(shape, dtype=np.int64)) <= 1:
+                return P(*([None] * len(shape)))
+            raise ValueError(
+                f"sharding plan maps var '{name}' (shape {tuple(shape)}) to "
+                f"{spec}, but a dimension does not divide the mesh axis size "
+                f"{axis_sizes} — fix the plan rules or the model dims"
+            )
 
         feed_arrays = {}
         for k, v in feed.items():
             arr = np.asarray(v)
             spec = self._plan.feed_spec(arr.ndim)
-            if not (arr.shape and self._plan.batch_axis and _divisible(arr, spec)):
+            if not (arr.shape and self._plan.batch_axis
+                    and _divisible(arr.shape, spec)):
+                # indivisible feeds stay replicated (reference PE pads/splits)
                 spec = P(*([None] * arr.ndim))
             feed_arrays[k] = jax.device_put(arr, NamedSharding(mesh, spec))
 
@@ -124,16 +140,10 @@ class ParallelExecutor:
                 tuple(state_out),
             )
             def _state_spec(n):
-                # _divisible only reads .shape/.ndim — no host transfer
-                val = jnp.asarray(self._scope.find_var(n))
-                spec = self._plan.spec_for(n, val.ndim)
-                if not _divisible(val, spec):
-                    spec = P(*([None] * val.ndim))
-                return spec
+                shape = np.shape(self._scope.find_var(n))  # metadata only
+                return NamedSharding(mesh, _resolve_spec(n, shape))
 
-            out_state_shardings = {
-                n: NamedSharding(mesh, _state_spec(n)) for n in state_out
-            }
+            out_state_shardings = {n: _state_spec(n) for n in state_out}
             jfn = jax.jit(
                 fn,
                 donate_argnums=(2,),
@@ -146,12 +156,7 @@ class ParallelExecutor:
 
         def _place(name, x):
             x = jnp.asarray(x)
-            spec = self._plan.spec_for(name, x.ndim)
-            if not _divisible(x, spec):
-                # e.g. a plan rule matching a param also catches its scalar
-                # optimizer accumulators — those stay replicated
-                spec = P(*([None] * x.ndim))
-            target = NamedSharding(mesh, spec)
+            target = NamedSharding(mesh, _resolve_spec(name, x.shape))
             if getattr(x, "sharding", None) == target:
                 return x
             return jax.device_put(x, target)

@@ -1,0 +1,125 @@
+"""Native runtime bindings (csrc/ C++ library via ctypes).
+
+The reference implements its runtime plumbing in C++ (recordio chunks
+`paddle/fluid/recordio/`, buddy allocator `paddle/fluid/memory/detail/`,
+channels `paddle/fluid/framework/channel.h`, threadpool
+`framework/threadpool.h`, threaded file readers
+`operators/reader/open_files_op.cc`). This package is the TPU build's
+native layer: the same capabilities compiled from csrc/ into
+libpaddle_tpu_native.so, loaded with ctypes (no pybind11 in this
+environment), built on demand with g++ and cached. Every consumer has a
+pure-Python fallback so the framework degrades gracefully without a
+toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     os.pardir, os.pardir, "csrc")
+_SO = os.path.join(_CSRC, "libpaddle_tpu_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _stale() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_m = os.path.getmtime(_SO)
+    for f in os.listdir(_CSRC):
+        if f.endswith((".cc", ".h")) and \
+                os.path.getmtime(os.path.join(_CSRC, f)) > so_m:
+            return True
+    return False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _CSRC], check=True,
+                       capture_output=True, timeout=300)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return False
+
+
+def _declare(lib):
+    c = ctypes
+    lib.rio_writer_open.restype = c.c_void_p
+    lib.rio_writer_open.argtypes = [c.c_char_p, c.c_int]
+    lib.rio_writer_write.restype = c.c_int
+    lib.rio_writer_write.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    lib.rio_writer_close.restype = c.c_int
+    lib.rio_writer_close.argtypes = [c.c_void_p]
+    lib.rio_reader_open.restype = c.c_void_p
+    lib.rio_reader_open.argtypes = [c.c_char_p]
+    lib.rio_reader_next.restype = c.c_int64
+    lib.rio_reader_next.argtypes = [c.c_void_p, c.POINTER(c.POINTER(c.c_char))]
+    lib.rio_reader_close.argtypes = [c.c_void_p]
+    lib.rio_multi_reader_open.restype = c.c_void_p
+    lib.rio_multi_reader_open.argtypes = [
+        c.POINTER(c.c_char_p), c.c_int, c.c_int, c.c_int]
+    lib.rio_multi_reader_next.restype = c.c_int64
+    lib.rio_multi_reader_next.argtypes = [
+        c.c_void_p, c.POINTER(c.POINTER(c.c_char))]
+    lib.rio_multi_reader_close.argtypes = [c.c_void_p]
+
+    lib.pt_buddy_create.restype = c.c_void_p
+    lib.pt_buddy_create.argtypes = [c.c_uint64, c.c_uint64]
+    lib.pt_buddy_alloc.restype = c.c_void_p
+    lib.pt_buddy_alloc.argtypes = [c.c_void_p, c.c_uint64]
+    lib.pt_buddy_free.restype = c.c_int
+    lib.pt_buddy_free.argtypes = [c.c_void_p, c.c_void_p]
+    lib.pt_buddy_used.restype = c.c_uint64
+    lib.pt_buddy_used.argtypes = [c.c_void_p]
+    lib.pt_buddy_total.restype = c.c_uint64
+    lib.pt_buddy_total.argtypes = [c.c_void_p]
+    lib.pt_buddy_destroy.argtypes = [c.c_void_p]
+
+    lib.pt_chan_create.restype = c.c_void_p
+    lib.pt_chan_create.argtypes = [c.c_int64]
+    lib.pt_chan_send.restype = c.c_int
+    lib.pt_chan_send.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    lib.pt_chan_recv.restype = c.c_int64
+    lib.pt_chan_recv.argtypes = [c.c_void_p, c.POINTER(c.POINTER(c.c_char))]
+    lib.pt_buf_free.argtypes = [c.POINTER(c.c_char)]
+    lib.pt_chan_try_send.restype = c.c_int
+    lib.pt_chan_try_send.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    lib.pt_chan_try_recv.restype = c.c_int64
+    lib.pt_chan_try_recv.argtypes = [c.c_void_p, c.POINTER(c.POINTER(c.c_char))]
+    lib.pt_chan_close.argtypes = [c.c_void_p]
+    lib.pt_chan_size.restype = c.c_int64
+    lib.pt_chan_size.argtypes = [c.c_void_p]
+    lib.pt_chan_destroy.argtypes = [c.c_void_p]
+    return lib
+
+
+def load_native():
+    """The loaded CDLL, building it first if missing/stale; None if the
+    native library can't be built (consumers fall back to Python)."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if _stale() and not _build():
+            _load_failed = True
+            return None
+        try:
+            _lib = _declare(ctypes.CDLL(_SO))
+        except OSError:
+            _load_failed = True
+        return _lib
+
+
+def available() -> bool:
+    return load_native() is not None
+
+
+from . import channel, memory, recordio  # noqa: E402,F401
+from .channel import Channel  # noqa: E402,F401
+from .memory import BuddyAllocator  # noqa: E402,F401
+from .recordio import RecordIOReader, RecordIOWriter, multi_file_reader  # noqa: E402,F401

@@ -1,0 +1,181 @@
+"""CSP channel of Python objects over the native ByteChannel
+(csrc/channel.cc; reference framework/channel.h + channel_impl.h). Payloads
+are pickled; capacity 0 = rendezvous like the reference's unbuffered
+channel. Pure-Python fallback uses queue.Queue semantics."""
+from __future__ import annotations
+
+import ctypes
+import pickle
+import queue
+import threading
+from typing import Any, Optional, Tuple
+
+from . import load_native
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class _PyChannel:
+    """Fallback with the same close/rendezvous semantics."""
+
+    def __init__(self, capacity: int):
+        self._q = queue.Queue(maxsize=max(capacity, 0) or 1)
+        self._rendezvous = capacity == 0
+        self._closed = threading.Event()
+        self._pop_cv = threading.Condition()
+        self._pops = 0
+
+    def send(self, obj) -> bool:
+        if self._closed.is_set():
+            return False
+        if not self._rendezvous:
+            while True:
+                if self._closed.is_set():
+                    return False
+                try:
+                    self._q.put(obj, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+        with self._pop_cv:
+            target = self._pops + self._q.qsize() + 1
+            self._q.put(obj)
+            while self._pops < target and not self._closed.is_set():
+                self._pop_cv.wait(0.05)
+            return self._pops >= target
+
+    def recv(self) -> Tuple[bool, Any]:
+        while True:
+            try:
+                obj = self._q.get(timeout=0.05)
+                with self._pop_cv:
+                    self._pops += 1
+                    self._pop_cv.notify_all()
+                return True, obj
+            except queue.Empty:
+                if self._closed.is_set() and self._q.empty():
+                    return False, None
+
+    def try_send(self, obj) -> str:
+        if self._closed.is_set():
+            return "closed"
+        if self._rendezvous:
+            return "full"  # no waiting-receiver bookkeeping in the fallback
+        try:
+            self._q.put_nowait(obj)
+            return "sent"
+        except queue.Full:
+            return "full"
+
+    def try_recv(self):
+        try:
+            obj = self._q.get_nowait()
+            with self._pop_cv:
+                self._pops += 1
+                self._pop_cv.notify_all()
+            return "ok", obj
+        except queue.Empty:
+            if self._closed.is_set():
+                return "closed", None
+            return "empty", None
+
+    def close(self):
+        self._closed.set()
+        with self._pop_cv:
+            self._pop_cv.notify_all()
+
+    def destroy(self):
+        self.close()
+
+    def size(self) -> int:
+        return self._q.qsize()
+
+
+class Channel:
+    """Blocking send/recv of arbitrary picklable objects.
+
+    send(obj) -> bool (False if closed); recv() -> obj or raises
+    ChannelClosed when closed and drained.
+    """
+
+    def __init__(self, capacity: int = 0):
+        self._lib = load_native()
+        if self._lib is not None:
+            self._h: Optional[int] = self._lib.pt_chan_create(capacity)
+            self._py = None
+        else:
+            self._h = None
+            self._py = _PyChannel(capacity)
+
+    def send(self, obj) -> bool:
+        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        if self._py is not None:
+            return self._py.send(obj)
+        return self._lib.pt_chan_send(self._h, data, len(data)) == 0
+
+    def recv(self):
+        if self._py is not None:
+            ok, obj = self._py.recv()
+            if not ok:
+                raise ChannelClosed()
+            return obj
+        out = ctypes.POINTER(ctypes.c_char)()
+        n = self._lib.pt_chan_recv(self._h, ctypes.byref(out))
+        if n < 0:
+            raise ChannelClosed()
+        try:
+            return pickle.loads(ctypes.string_at(out, n))
+        finally:
+            self._lib.pt_buf_free(out)
+
+    def try_send(self, obj) -> str:
+        """'sent' | 'full' | 'closed' — non-blocking (Select cases)."""
+        if self._py is not None:
+            return self._py.try_send(obj)
+        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        rc = self._lib.pt_chan_try_send(self._h, data, len(data))
+        return "sent" if rc == 1 else ("full" if rc == 0 else "closed")
+
+    def try_recv(self):
+        """(status, value): 'ok' | 'empty' | 'closed' — non-blocking."""
+        if self._py is not None:
+            return self._py.try_recv()
+        out = ctypes.POINTER(ctypes.c_char)()
+        n = self._lib.pt_chan_try_recv(self._h, ctypes.byref(out))
+        if n == -2:
+            return "empty", None
+        if n == -1:
+            return "closed", None
+        try:
+            return "ok", pickle.loads(ctypes.string_at(out, n))
+        finally:
+            self._lib.pt_buf_free(out)
+
+    def close(self):
+        if self._py is not None:
+            self._py.close()
+        elif self._h:
+            self._lib.pt_chan_close(self._h)
+
+    def size(self) -> int:
+        if self._py is not None:
+            return self._py.size()
+        return int(self._lib.pt_chan_size(self._h))
+
+    def __iter__(self):
+        while True:
+            try:
+                yield self.recv()
+            except ChannelClosed:
+                return
+
+    def __del__(self):
+        try:
+            if self._h and self._lib is not None:
+                self._lib.pt_chan_close(self._h)
+                self._lib.pt_chan_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass

@@ -1,0 +1,81 @@
+"""Host buddy allocator (csrc/buddy_allocator.cc) — the staging-buffer side
+of the reference's memory layer (paddle/fluid/memory/detail/
+buddy_allocator.h:33; device HBM itself is managed by PJRT on TPU).
+
+numpy views into the arena let input pipelines fill buffers without per-batch
+allocation. Pure-Python fallback: plain numpy allocation (same API)."""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Optional
+
+import numpy as np
+
+from . import load_native
+
+
+class BuddyAllocator:
+    def __init__(self, total_bytes: int, min_block: int = 256):
+        self._lib = load_native()
+        self._handles: Dict[int, int] = {}
+        if self._lib is not None:
+            self._h = self._lib.pt_buddy_create(total_bytes, min_block)
+            if not self._h:
+                raise MemoryError("buddy arena allocation failed")
+        else:
+            self._h = None
+            self._total = total_bytes
+            self._used = 0
+
+    def alloc(self, nbytes: int, dtype="uint8") -> Optional[np.ndarray]:
+        """A numpy array view over a fresh block (None if arena exhausted)."""
+        dt = np.dtype(dtype)
+        n = nbytes * dt.itemsize if dtype != "uint8" else nbytes
+        if self._h is not None:
+            p = self._lib.pt_buddy_alloc(self._h, n)
+            if not p:
+                return None
+            buf = (ctypes.c_char * n).from_address(p)
+            arr = np.frombuffer(buf, dtype=dt)
+            self._handles[id(arr)] = p
+            return arr
+        self._used += n
+        if self._used > self._total:
+            self._used -= n
+            return None
+        arr = np.zeros(n // dt.itemsize, dtype=dt)
+        self._handles[id(arr)] = 0
+        return arr
+
+    def free(self, arr: np.ndarray):
+        p = self._handles.pop(id(arr), None)
+        if p is None:
+            raise ValueError("array was not allocated by this allocator")
+        if self._h is not None:
+            if self._lib.pt_buddy_free(self._h, p):
+                raise ValueError("double free or bad pointer")
+        else:
+            self._used -= arr.nbytes
+
+    def memory_usage(self) -> int:
+        """Bytes currently allocated (reference memory::memory_usage)."""
+        if self._h is not None:
+            return int(self._lib.pt_buddy_used(self._h))
+        return self._used
+
+    @property
+    def total(self) -> int:
+        if self._h is not None:
+            return int(self._lib.pt_buddy_total(self._h))
+        return self._total
+
+    def close(self):
+        if self._h is not None:
+            self._lib.pt_buddy_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,181 @@
+"""RecordIO reader/writer — native (csrc/recordio.cc via ctypes) with a
+pure-Python fallback implementing the identical on-disk format, so files are
+interchangeable (reference paddle/fluid/recordio/, chunk.h:26)."""
+from __future__ import annotations
+
+import ctypes
+import struct
+import zlib
+from typing import Iterator, List, Optional, Sequence
+
+from . import load_native
+
+MAGIC = b"PTRIO1\n\0"
+DEFAULT_MAX_CHUNK = 1 << 20
+
+
+class _PyWriter:
+    def __init__(self, path: str, max_chunk_bytes: int = DEFAULT_MAX_CHUNK):
+        self._f = open(path, "wb")
+        self._f.write(MAGIC)
+        self._buf: List[bytes] = []
+        self._size = 0
+        self._max = max_chunk_bytes
+
+    def write(self, record: bytes):
+        self._buf.append(struct.pack("<I", len(record)) + record)
+        self._size += len(record) + 4
+        if self._size >= self._max:
+            self._flush()
+
+    def _flush(self):
+        if not self._buf:
+            return
+        raw = b"".join(self._buf)
+        comp = zlib.compress(raw)
+        self._f.write(struct.pack("<IIII", len(self._buf), len(raw),
+                                  len(comp), zlib.crc32(comp)))
+        self._f.write(comp)
+        self._buf, self._size = [], 0
+
+    def close(self):
+        self._flush()
+        self._f.close()
+
+
+class _PyReader:
+    def __init__(self, path: str):
+        self._f = open(path, "rb")
+        if self._f.read(8) != MAGIC:
+            self._f.close()
+            raise IOError(f"{path}: not a recordio file")
+        self._records: List[bytes] = []
+        self._idx = 0
+
+    def read(self) -> Optional[bytes]:
+        while self._idx >= len(self._records):
+            head = self._f.read(16)
+            if not head:
+                return None
+            if len(head) != 16:
+                raise IOError("truncated chunk header")
+            _, raw_len, comp_len, crc = struct.unpack("<IIII", head)
+            comp = self._f.read(comp_len)
+            if len(comp) != comp_len or zlib.crc32(comp) != crc:
+                raise IOError("corrupt chunk (crc mismatch)")
+            raw = zlib.decompress(comp)
+            if len(raw) != raw_len:
+                raise IOError("corrupt chunk (length mismatch)")
+            self._records, self._idx, pos = [], 0, 0
+            while pos < len(raw):
+                (n,) = struct.unpack_from("<I", raw, pos)
+                pos += 4
+                self._records.append(raw[pos:pos + n])
+                pos += n
+        rec = self._records[self._idx]
+        self._idx += 1
+        return rec
+
+    def close(self):
+        self._f.close()
+
+
+class _CWriter:
+    def __init__(self, lib, path: str, max_chunk_bytes: int):
+        self._lib = lib
+        self._h = lib.rio_writer_open(path.encode(), max_chunk_bytes)
+        if not self._h:
+            raise IOError(f"cannot open {path} for writing")
+
+    def write(self, record: bytes):
+        if self._lib.rio_writer_write(self._h, record, len(record)):
+            raise IOError("recordio write failed")
+
+    def close(self):
+        if self._h:
+            rc = self._lib.rio_writer_close(self._h)
+            self._h = None
+            if rc:
+                raise IOError("recordio flush/close failed")
+
+
+class _CReader:
+    def __init__(self, lib, path: str):
+        self._lib = lib
+        self._h = lib.rio_reader_open(path.encode())
+        if not self._h:
+            raise IOError(f"{path}: not a recordio file")
+
+    def read(self) -> Optional[bytes]:
+        data = ctypes.POINTER(ctypes.c_char)()
+        n = self._lib.rio_reader_next(self._h, ctypes.byref(data))
+        if n == -1:
+            return None
+        if n < 0:
+            raise IOError("corrupt recordio file")
+        return ctypes.string_at(data, n)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_reader_close(self._h)
+            self._h = None
+
+
+def RecordIOWriter(path: str, max_chunk_bytes: int = DEFAULT_MAX_CHUNK):
+    lib = load_native()
+    if lib is not None:
+        return _CWriter(lib, path, max_chunk_bytes)
+    return _PyWriter(path, max_chunk_bytes)
+
+
+def RecordIOReader(path: str):
+    lib = load_native()
+    if lib is not None:
+        return _CReader(lib, path)
+    return _PyReader(path)
+
+
+def read_all(path: str) -> List[bytes]:
+    r = RecordIOReader(path)
+    out = []
+    try:
+        while True:
+            rec = r.read()
+            if rec is None:
+                return out
+            out.append(rec)
+    finally:
+        r.close()
+
+
+def multi_file_reader(paths: Sequence[str], n_threads: int = 2,
+                      queue_capacity: int = 256) -> Iterator[bytes]:
+    """Threaded multi-file prefetch: C++ pool threads decompress chunks off
+    the Python thread into a bounded channel (reference
+    operators/reader/open_files_op.cc). Record order interleaves across
+    files. Python fallback reads files sequentially."""
+    lib = load_native()
+    if lib is None:
+        for p in paths:
+            r = _PyReader(p)
+            try:
+                while True:
+                    rec = r.read()
+                    if rec is None:
+                        break
+                    yield rec
+            finally:
+                r.close()
+        return
+
+    arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+    h = lib.rio_multi_reader_open(arr, len(paths), n_threads, queue_capacity)
+    try:
+        data = ctypes.POINTER(ctypes.c_char)()
+        while True:
+            n = lib.rio_multi_reader_next(h, ctypes.byref(data))
+            if n < 0:
+                return
+            yield ctypes.string_at(data, n)
+    finally:
+        lib.rio_multi_reader_close(h)

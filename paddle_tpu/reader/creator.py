@@ -1,0 +1,46 @@
+"""Reader creators (reference python/paddle/reader/creator.py: np_array,
+text_file, recordio)."""
+from __future__ import annotations
+
+import pickle
+from typing import Sequence, Union
+
+
+def np_array(x):
+    """Reader yielding rows of a numpy array."""
+
+    def reader():
+        for row in x:
+            yield row
+
+    return reader
+
+
+def text_file(path: str):
+    """Reader yielding stripped lines of a text file."""
+
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths: Union[str, Sequence[str]], num_threads: int = 2,
+             queue_capacity: int = 256):
+    """Reader over recordio file(s) written by
+    fluid.recordio_writer.convert_reader_to_recordio_file* — unpickles each
+    record. Multiple paths stream through the native threaded prefetcher
+    (csrc/recordio.cc rio_multi_reader)."""
+    if isinstance(paths, str):
+        paths = [p for p in paths.split(",") if p]
+
+    def reader():
+        from ..native.recordio import multi_file_reader
+
+        for rec in multi_file_reader(list(paths), n_threads=num_threads,
+                                     queue_capacity=queue_capacity):
+            yield pickle.loads(rec)
+
+    return reader
