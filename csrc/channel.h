@@ -31,6 +31,7 @@ class ByteChannel {
       recv_cv_.notify_one();
       return true;
     }
+    if (closed_) return false;  // never enqueue into a closed channel
     // rendezvous: enqueue, then wait until a receiver pops it
     uint64_t my_seq = ++send_seq_;
     q_.push_back(std::move(data));

@@ -116,6 +116,7 @@ struct MultiReader {
   std::unique_ptr<ptnative::ByteChannel> chan;
   std::unique_ptr<ptnative::ThreadPool> pool;
   std::atomic<int> pending{0};
+  std::atomic<bool> error{false};
   std::string cur;
 };
 
@@ -207,17 +208,26 @@ void* rio_multi_reader_open(const char** paths, int n_files, int n_threads,
       queue_capacity > 0 ? queue_capacity : 256));
   m->pool.reset(new ptnative::ThreadPool(n_threads > 0 ? n_threads : 2));
   m->pending.store(n_files);
+  if (n_files == 0) m->chan->Close();
   for (int i = 0; i < n_files; ++i) {
     std::string path(paths[i]);
     auto* chan = m->chan.get();
     auto* pending = &m->pending;
-    m->pool->Submit([path, chan, pending] {
+    auto* error = &m->error;
+    m->pool->Submit([path, chan, pending, error] {
       void* r = rio_reader_open(path.c_str());
-      if (r) {
+      if (!r) {
+        error->store(true);  // unopenable shard is an error, not EOF
+        chan->Close();
+      } else {
         const char* data;
         int64_t len;
         while ((len = rio_reader_next(r, &data)) >= 0) {
           if (!chan->Send(std::string(data, static_cast<size_t>(len)))) break;
+        }
+        if (len == -2) {  // corrupt chunk — propagate, don't truncate
+          error->store(true);
+          chan->Close();
         }
         rio_reader_close(r);
       }
@@ -227,9 +237,10 @@ void* rio_multi_reader_open(const char** paths, int n_files, int n_threads,
   return m;
 }
 
+// record length; -1 = clean EOF; -2 = a shard failed (corrupt/unreadable)
 int64_t rio_multi_reader_next(void* mp, const char** data) {
   auto* m = static_cast<MultiReader*>(mp);
-  if (!m->chan->Recv(&m->cur)) return -1;
+  if (!m->chan->Recv(&m->cur)) return m->error.load() ? -2 : -1;
   *data = m->cur.data();
   return static_cast<int64_t>(m->cur.size());
 }

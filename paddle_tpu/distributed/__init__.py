@@ -1,0 +1,17 @@
+"""Distributed layer: multi-host initialization, elastic data service,
+distribute-transpiler facade.
+
+TPU-native replacement for the reference's distributed stack (SURVEY.md
+§2.10): gRPC pserver ops + NCCL handles + Go master/pserver become
+  - `init_distributed` — jax.distributed over DCN (coordinator + N hosts),
+    after which jax.devices() spans all hosts and the same pjit program is
+    data/model-parallel across them (collectives ride ICI within a slice,
+    DCN across slices),
+  - `MasterService`/`MasterClient` — go/master-parity elastic task queue
+    over recordio shards with lease timeouts, failure counts and snapshot
+    recovery (file-based instead of etcd),
+  - `fluid.DistributeTranspiler` — API-parity facade mapping the pserver
+    program-rewrite world onto mesh+sharding-plan SPMD.
+"""
+from .env import get_world_info, global_mesh, init_distributed  # noqa: F401
+from .master import MasterClient, MasterService  # noqa: F401

@@ -1,0 +1,56 @@
+"""Multi-host environment (reference capability: trainer/pserver endpoints
+lists + gRPC, distribute_transpiler.py:136; TPU-native: the JAX distributed
+runtime over DCN)."""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Dict[str, int]:
+    """Initialize the multi-host runtime. Arguments default to the standard
+    env vars (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID — the role
+    the reference fills with PADDLE_INIT_PSERVERS / TRAINER_ID). Single
+    process with no coordinator is a no-op (local run).
+
+    After this, jax.devices() spans every host and one pjit/shard_map
+    program is the whole cluster's step — there is no separate pserver
+    program to build."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = int(os.environ.get("NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("PROCESS_ID", "0"))
+    if coordinator_address and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return get_world_info()
+
+
+def get_world_info() -> Dict[str, int]:
+    return {
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }
+
+
+def global_mesh(axes: Dict[str, int], devices=None):
+    """Mesh over ALL hosts' devices (axis sizes multiply to the global
+    device count). Put the data-parallel axis outermost so it maps across
+    hosts (collectives on it cross DCN; inner axes stay on-slice ICI)."""
+    from ..parallel import make_mesh
+
+    return make_mesh(axes, devices=devices or jax.devices())

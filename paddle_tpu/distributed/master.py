@@ -1,0 +1,304 @@
+"""Elastic master data service: fault-tolerant task queue over dataset
+shards.
+
+Capability parity with the reference's Go master (go/master/service.go:
+Service:89 todo/pending/done/failed queues, partition:106, GetTask:368
+leased with timeout, checkTimeoutFunc:341, TaskFinished:411,
+TaskFailed:455 re-queue with failureMax drop, snapshot:207/recover:166 via
+etcd). TPU-era redesign: the queue state snapshots to a local file (crc32 +
+atomic rename — the same integrity trick as the Go pserver checkpoints,
+go/pserver/service.go:53); service runs in-process or over a TCP
+pickle-RPC for multi-trainer jobs. Tasks are recordio shard path groups,
+exactly like the reference partitions chunks.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass
+class Task:
+    id: int
+    paths: List[str]
+    num_failures: int = 0
+    epoch: int = 0  # lease generation; stale finish/fail calls are rejected
+
+
+@dataclass
+class _Pending:
+    task: Task
+    epoch: int
+    deadline: float
+
+
+class MasterService:
+    """Task queue with leases. Thread-safe; optionally snapshot-backed."""
+
+    def __init__(self, chunks_per_task: int = 1, lease_timeout: float = 60.0,
+                 failure_max: int = 3, snapshot_path: Optional[str] = None):
+        self._chunks_per_task = chunks_per_task
+        self._timeout = lease_timeout
+        self._failure_max = failure_max
+        self._snapshot_path = snapshot_path
+        self._mu = threading.Lock()
+        self._todo: List[Task] = []
+        self._pending: Dict[int, _Pending] = {}
+        self._done: List[Task] = []
+        self._failed_dropped: List[Task] = []
+        self._epoch = 0
+        self._next_id = 0
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._recover()
+
+    # -- dataset ----------------------------------------------------------
+    def set_dataset(self, shard_paths: Sequence[str]):
+        """Partition shards into tasks (reference partition:106)."""
+        with self._mu:
+            self._todo = []
+            self._pending.clear()
+            self._done = []
+            self._failed_dropped = []
+            cur: List[str] = []
+            for p in shard_paths:
+                cur.append(p)
+                if len(cur) >= self._chunks_per_task:
+                    self._todo.append(Task(self._next_id, cur))
+                    self._next_id += 1
+                    cur = []
+            if cur:
+                self._todo.append(Task(self._next_id, cur))
+                self._next_id += 1
+            self._snapshot_locked()
+
+    # -- task protocol ----------------------------------------------------
+    def get_task(self) -> Optional[Task]:
+        """Lease a task; None when nothing is available right now (reference
+        GetTask:368). Re-queues timed-out leases first."""
+        with self._mu:
+            self._check_timeouts_locked()
+            if not self._todo:
+                return None
+            task = self._todo.pop(0)
+            self._epoch += 1
+            task.epoch = self._epoch
+            self._pending[task.id] = _Pending(
+                task, self._epoch, time.monotonic() + self._timeout
+            )
+            self._snapshot_locked()
+            # hand out a copy: in-process clients must not alias the queue's
+            # mutable task (its epoch advances on re-lease)
+            import dataclasses as _dc
+
+            return _dc.replace(task, paths=list(task.paths))
+
+    def _pop_pending(self, task_id: int, epoch: Optional[int]):
+        """A stale lease holder (its lease timed out and the task was
+        re-leased) must not affect the new holder's lease — the epoch check
+        (reference go/master keeps per-lease epochs for exactly this)."""
+        p = self._pending.get(task_id)
+        if p is None or (epoch is not None and p.epoch != epoch):
+            return None
+        return self._pending.pop(task_id)
+
+    def task_finished(self, task_id: int, epoch: Optional[int] = None) -> bool:
+        """reference TaskFinished:411."""
+        with self._mu:
+            p = self._pop_pending(task_id, epoch)
+            if p is None:
+                return False
+            self._done.append(p.task)
+            self._snapshot_locked()
+            return True
+
+    def task_failed(self, task_id: int, epoch: Optional[int] = None) -> bool:
+        """Requeue; drop after failure_max (reference TaskFailed:455,
+        :313-339)."""
+        with self._mu:
+            p = self._pop_pending(task_id, epoch)
+            if p is None:
+                return False
+            self._fail_locked(p.task)
+            self._snapshot_locked()
+            return True
+
+    def all_done(self) -> bool:
+        with self._mu:
+            self._check_timeouts_locked()
+            return not self._todo and not self._pending
+
+    def stats(self) -> Dict[str, int]:
+        with self._mu:
+            return {
+                "todo": len(self._todo), "pending": len(self._pending),
+                "done": len(self._done),
+                "dropped": len(self._failed_dropped),
+            }
+
+    def _fail_locked(self, task: Task):
+        task.num_failures += 1
+        if task.num_failures >= self._failure_max:
+            self._failed_dropped.append(task)
+        else:
+            self._todo.append(task)
+
+    def _check_timeouts_locked(self):
+        now = time.monotonic()
+        expired = [tid for tid, p in self._pending.items()
+                   if p.deadline <= now]
+        for tid in expired:
+            p = self._pending.pop(tid)
+            self._fail_locked(p.task)
+        if expired:
+            self._snapshot_locked()
+
+    # -- snapshot / recover (reference snapshot:207, recover:166) ---------
+    def _snapshot_locked(self):
+        if not self._snapshot_path:
+            return
+        state = {
+            "todo": self._todo,
+            # pending leases survive as todo on recovery (the lease holder
+            # may be the one that died)
+            "pending": [p.task for p in self._pending.values()],
+            "done": self._done,
+            "dropped": self._failed_dropped,
+            "next_id": self._next_id,
+        }
+        payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        blob = struct.pack("<I", zlib.crc32(payload)) + payload
+        tmp = self._snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._snapshot_path)
+
+    def _recover(self):
+        with open(self._snapshot_path, "rb") as f:
+            blob = f.read()
+        (crc,) = struct.unpack("<I", blob[:4])
+        payload = blob[4:]
+        if zlib.crc32(payload) != crc:
+            raise IOError(f"{self._snapshot_path}: snapshot corrupt")
+        state = pickle.loads(payload)
+        self._todo = state["todo"] + state["pending"]
+        self._done = state["done"]
+        self._failed_dropped = state["dropped"]
+        self._next_id = state["next_id"]
+
+    # -- TCP server (role of the reference's net/rpc endpoint) ------------
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        """Start serving in a daemon thread; returns (host, port)."""
+        service = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        head = self.rfile.read(4)
+                        if len(head) != 4:
+                            return
+                        (n,) = struct.unpack("<I", head)
+                        method, args = pickle.loads(self.rfile.read(n))
+                        result = getattr(service, method)(*args)
+                        out = pickle.dumps(result,
+                                           protocol=pickle.HIGHEST_PROTOCOL)
+                        self.wfile.write(struct.pack("<I", len(out)) + out)
+                        self.wfile.flush()
+                except (ConnectionError, EOFError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        return self._server.server_address
+
+    def shutdown(self):
+        srv = getattr(self, "_server", None)
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+
+
+class MasterClient:
+    """Trainer-side client (reference go/master/client.go + the ctypes
+    python/paddle/v2/master/client.py). Also usable in-process by passing
+    the service itself."""
+
+    def __init__(self, addr=None, service: Optional[MasterService] = None):
+        self._service = service
+        self._addr = addr
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _call(self, method: str, *args):
+        if self._service is not None:
+            return getattr(self._service, method)(*args)
+        with self._lock:
+            if self._sock is None:
+                self._sock = socket.create_connection(self._addr)
+            payload = pickle.dumps((method, args),
+                                   protocol=pickle.HIGHEST_PROTOCOL)
+            self._sock.sendall(struct.pack("<I", len(payload)) + payload)
+            head = self._sock.recv(4, socket.MSG_WAITALL)
+            (n,) = struct.unpack("<I", head)
+            buf = b""
+            while len(buf) < n:
+                buf += self._sock.recv(n - len(buf))
+            return pickle.loads(buf)
+
+    def set_dataset(self, shard_paths: Sequence[str]):
+        return self._call("set_dataset", list(shard_paths))
+
+    def get_task(self) -> Optional[Task]:
+        return self._call("get_task")
+
+    def task_finished(self, task_id: int, epoch: Optional[int] = None) -> bool:
+        return self._call("task_finished", task_id, epoch)
+
+    def task_failed(self, task_id: int, epoch: Optional[int] = None) -> bool:
+        return self._call("task_failed", task_id, epoch)
+
+    def all_done(self) -> bool:
+        return self._call("all_done")
+
+    def stats(self):
+        return self._call("stats")
+
+    def records(self, poll_interval: float = 0.2):
+        """Iterate every record of the leased tasks until the dataset is
+        exhausted (role of client.go NextRecord): lease task -> stream its
+        recordio shards -> mark finished; crashes mid-task just let the
+        lease expire and another trainer re-reads it."""
+        from ..native.recordio import multi_file_reader
+
+        while True:
+            task = self.get_task()
+            if task is None:
+                if self.all_done():
+                    return
+                time.sleep(poll_interval)
+                continue
+            try:
+                for rec in multi_file_reader(task.paths):
+                    yield rec
+            except Exception:
+                self.task_failed(task.id, task.epoch)
+                raise
+            self.task_finished(task.id, task.epoch)
+
+    def close(self):
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None

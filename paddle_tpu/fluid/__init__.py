@@ -4,6 +4,7 @@ from . import ops as _ops  # registers all op emitters  # noqa: F401
 from . import (  # noqa: F401
     backward,
     clip,
+    concurrency,
     evaluator,
     initializer,
     io,
@@ -13,9 +14,11 @@ from . import (  # noqa: F401
     optimizer,
     param_attr,
     profiler,
+    recordio_writer,
     regularizer,
     unique_name,
 )
+from .distribute_transpiler import DistributeTranspiler  # noqa: F401
 from .backward import append_backward, calc_gradient  # noqa: F401
 from .clip import (  # noqa: F401
     ErrorClipByValue,

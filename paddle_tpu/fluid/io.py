@@ -178,7 +178,7 @@ def get_inference_program(target_vars, main_program=None):
 # --- checkpoint/resume with integrity check (Go pserver capability,
 #     go/pserver/service.go:119-227) ------------------------------------
 def save_checkpoint(dirname, main_program=None, step: int = 0,
-                    scope: Optional[Scope] = None):
+                    scope: Optional[Scope] = None, max_to_keep: int = 3):
     main_program = main_program or default_main_program()
     scope = scope or global_scope()
     os.makedirs(dirname, exist_ok=True)
@@ -197,6 +197,25 @@ def save_checkpoint(dirname, main_program=None, step: int = 0,
     with open(tmp, "w") as f:
         json.dump(meta, f)
     os.replace(tmp, os.path.join(dirname, "META"))  # atomic, like the Go pserver
+    # rotate: drop oldest payloads beyond max_to_keep, but never the one
+    # META points to (a restart may save at a lower step than old files),
+    # and ignore non-numeric ckpt_* names
+    if max_to_keep > 0:
+        def _step_of(f):
+            try:
+                return int(f[5:-4])
+            except ValueError:
+                return None
+
+        current = os.path.basename(payload_path)
+        ckpts = sorted(
+            (f for f in os.listdir(dirname)
+             if f.startswith("ckpt_") and f.endswith(".npz")
+             and _step_of(f) is not None and f != current),
+            key=_step_of,
+        )
+        for old in ckpts[:-(max_to_keep - 1) or len(ckpts)]:
+            os.remove(os.path.join(dirname, old))
     return payload_path
 
 

@@ -83,7 +83,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
         type="lookup_table",
         inputs={"W": [w], "Ids": [input]},
         outputs={"Out": [tmp]},
-        attrs={"is_sparse": is_sparse, "padding_idx": padding_idx},
+        attrs={"is_sparse": is_sparse, "is_distributed": is_distributed,
+               "padding_idx": padding_idx},
     )
     from .sequence import _propagate_lengths
 

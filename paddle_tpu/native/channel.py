@@ -6,7 +6,6 @@ from __future__ import annotations
 
 import ctypes
 import pickle
-import queue
 import threading
 from typing import Any, Optional, Tuple
 
@@ -18,79 +17,88 @@ class ChannelClosed(Exception):
 
 
 class _PyChannel:
-    """Fallback with the same close/rendezvous semantics."""
+    """Fallback mirroring ByteChannel's semantics (one condition variable,
+    sequence-number rendezvous — csrc/channel.h)."""
 
     def __init__(self, capacity: int):
-        self._q = queue.Queue(maxsize=max(capacity, 0) or 1)
-        self._rendezvous = capacity == 0
-        self._closed = threading.Event()
-        self._pop_cv = threading.Condition()
-        self._pops = 0
+        import collections
+
+        self._cap = capacity
+        self._q = collections.deque()
+        self._closed = False
+        self._cv = threading.Condition()
+        self._send_seq = 0
+        self._pop_seq = 0
+        self._recv_waiting = 0
 
     def send(self, obj) -> bool:
-        if self._closed.is_set():
-            return False
-        if not self._rendezvous:
-            while True:
-                if self._closed.is_set():
+        with self._cv:
+            if self._cap > 0:
+                while not self._closed and len(self._q) >= self._cap:
+                    self._cv.wait()
+                if self._closed:
                     return False
-                try:
-                    self._q.put(obj, timeout=0.05)
-                    return True
-                except queue.Full:
-                    continue
-        with self._pop_cv:
-            target = self._pops + self._q.qsize() + 1
-            self._q.put(obj)
-            while self._pops < target and not self._closed.is_set():
-                self._pop_cv.wait(0.05)
-            return self._pops >= target
+                self._q.append(obj)
+                self._cv.notify_all()
+                return True
+            if self._closed:
+                return False
+            self._send_seq += 1
+            my_seq = self._send_seq
+            self._q.append(obj)
+            self._cv.notify_all()
+            while not self._closed and self._pop_seq < my_seq:
+                self._cv.wait()
+            return self._pop_seq >= my_seq
 
     def recv(self) -> Tuple[bool, Any]:
-        while True:
-            try:
-                obj = self._q.get(timeout=0.05)
-                with self._pop_cv:
-                    self._pops += 1
-                    self._pop_cv.notify_all()
-                return True, obj
-            except queue.Empty:
-                if self._closed.is_set() and self._q.empty():
-                    return False, None
+        with self._cv:
+            self._recv_waiting += 1
+            while not self._closed and not self._q:
+                self._cv.wait()
+            self._recv_waiting -= 1
+            if not self._q:
+                return False, None
+            obj = self._q.popleft()
+            self._pop_seq += 1
+            self._cv.notify_all()
+            return True, obj
 
     def try_send(self, obj) -> str:
-        if self._closed.is_set():
-            return "closed"
-        if self._rendezvous:
-            return "full"  # no waiting-receiver bookkeeping in the fallback
-        try:
-            self._q.put_nowait(obj)
+        with self._cv:
+            if self._closed:
+                return "closed"
+            if self._cap > 0:
+                if len(self._q) >= self._cap:
+                    return "full"
+            elif self._recv_waiting <= len(self._q):
+                return "full"  # rendezvous: need a waiting receiver
+            if self._cap == 0:
+                self._send_seq += 1
+            self._q.append(obj)
+            self._cv.notify_all()
             return "sent"
-        except queue.Full:
-            return "full"
 
     def try_recv(self):
-        try:
-            obj = self._q.get_nowait()
-            with self._pop_cv:
-                self._pops += 1
-                self._pop_cv.notify_all()
-            return "ok", obj
-        except queue.Empty:
-            if self._closed.is_set():
-                return "closed", None
-            return "empty", None
+        with self._cv:
+            if self._q:
+                obj = self._q.popleft()
+                self._pop_seq += 1
+                self._cv.notify_all()
+                return "ok", obj
+            return ("closed", None) if self._closed else ("empty", None)
 
     def close(self):
-        self._closed.set()
-        with self._pop_cv:
-            self._pop_cv.notify_all()
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
 
     def destroy(self):
         self.close()
 
     def size(self) -> int:
-        return self._q.qsize()
+        with self._cv:
+            return len(self._q)
 
 
 class Channel:
@@ -110,9 +118,9 @@ class Channel:
             self._py = _PyChannel(capacity)
 
     def send(self, obj) -> bool:
-        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
         if self._py is not None:
             return self._py.send(obj)
+        data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
         return self._lib.pt_chan_send(self._h, data, len(data)) == 0
 
     def recv(self):

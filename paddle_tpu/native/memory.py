@@ -27,32 +27,38 @@ class BuddyAllocator:
             self._total = total_bytes
             self._used = 0
 
-    def alloc(self, nbytes: int, dtype="uint8") -> Optional[np.ndarray]:
-        """A numpy array view over a fresh block (None if arena exhausted)."""
+    def alloc(self, count: int, dtype="uint8") -> Optional[np.ndarray]:
+        """A numpy view over a fresh block of `count` elements of `dtype`
+        (bytes for the default uint8); None if the arena is exhausted.
+        Blocks must be returned with free() — dropping the view without
+        freeing leaks its block (the allocator keeps the view alive in its
+        ledger until then)."""
         dt = np.dtype(dtype)
-        n = nbytes * dt.itemsize if dtype != "uint8" else nbytes
+        n = count * dt.itemsize
         if self._h is not None:
             p = self._lib.pt_buddy_alloc(self._h, n)
             if not p:
                 return None
             buf = (ctypes.c_char * n).from_address(p)
             arr = np.frombuffer(buf, dtype=dt)
-            self._handles[id(arr)] = p
+            # hold the view: keeps id(arr) unique for the ledger's lifetime
+            self._handles[id(arr)] = (p, arr)
             return arr
         self._used += n
         if self._used > self._total:
             self._used -= n
             return None
-        arr = np.zeros(n // dt.itemsize, dtype=dt)
-        self._handles[id(arr)] = 0
+        arr = np.zeros(count, dtype=dt)
+        self._handles[id(arr)] = (0, arr)
         return arr
 
     def free(self, arr: np.ndarray):
-        p = self._handles.pop(id(arr), None)
-        if p is None:
+        entry = self._handles.get(id(arr))
+        if entry is None or entry[1] is not arr:
             raise ValueError("array was not allocated by this allocator")
+        del self._handles[id(arr)]
         if self._h is not None:
-            if self._lib.pt_buddy_free(self._h, p):
+            if self._lib.pt_buddy_free(self._h, entry[0]):
                 raise ValueError("double free or bad pointer")
         else:
             self._used -= arr.nbytes

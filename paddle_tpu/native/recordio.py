@@ -174,8 +174,12 @@ def multi_file_reader(paths: Sequence[str], n_threads: int = 2,
         data = ctypes.POINTER(ctypes.c_char)()
         while True:
             n = lib.rio_multi_reader_next(h, ctypes.byref(data))
-            if n < 0:
+            if n == -1:
                 return
+            if n < 0:
+                raise IOError(
+                    f"a recordio shard failed (corrupt or unreadable): {paths}"
+                )
             yield ctypes.string_at(data, n)
     finally:
         lib.rio_multi_reader_close(h)

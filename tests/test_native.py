@@ -265,3 +265,47 @@ def test_concurrency_go_channel_select():
         g.join()
     assert got[id(a)] == [0, 1, 2]
     assert got[id(b)] == [0, 10, 20]
+
+
+def test_multi_file_reader_empty_and_corrupt(tmp_path):
+    # empty path list terminates cleanly
+    assert list(multi_file_reader([])) == []
+    # a corrupt shard raises instead of silently truncating
+    good = str(tmp_path / "good.rio")
+    w = RecordIOWriter(good)
+    w.write(b"fine")
+    w.close()
+    bad = str(tmp_path / "bad.rio")
+    w = RecordIOWriter(bad)
+    w.write(b"a" * 500)
+    w.close()
+    blob = bytearray(open(bad, "rb").read())
+    blob[-2] ^= 0xFF
+    open(bad, "wb").write(bytes(blob))
+    with pytest.raises(IOError):
+        list(multi_file_reader([good, bad]))
+    with pytest.raises(IOError):
+        list(multi_file_reader([str(tmp_path / "missing.rio")]))
+
+
+def test_channel_rendezvous_try_send():
+    import time
+
+    ch = Channel(0)
+    assert ch.try_send("x") == "full"  # no receiver waiting
+    got = []
+
+    def consumer():
+        got.append(ch.recv())
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    deadline = time.monotonic() + 2.0
+    status = "full"
+    while status == "full" and time.monotonic() < deadline:
+        status = ch.try_send("y")
+        time.sleep(0.01)
+    assert status == "sent"
+    t.join()
+    assert got == ["y"]
+    ch.close()
