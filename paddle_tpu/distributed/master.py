@@ -172,6 +172,9 @@ class MasterService:
             "done": self._done,
             "dropped": self._failed_dropped,
             "next_id": self._next_id,
+            # epoch must survive recovery or pre-crash stale leases could
+            # collide with fresh ones and defeat the epoch guard
+            "epoch": self._epoch,
         }
         payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
         blob = struct.pack("<I", zlib.crc32(payload)) + payload
@@ -192,10 +195,21 @@ class MasterService:
         self._done = state["done"]
         self._failed_dropped = state["dropped"]
         self._next_id = state["next_id"]
+        self._epoch = state.get("epoch", 0)
 
     # -- TCP server (role of the reference's net/rpc endpoint) ------------
+    # RPC surface exposed over TCP — everything else is unreachable
+    _RPC_METHODS = frozenset({
+        "set_dataset", "get_task", "task_finished", "task_failed",
+        "all_done", "stats",
+    })
+
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
-        """Start serving in a daemon thread; returns (host, port)."""
+        """Start serving in a daemon thread; returns (host, port).
+
+        Trust boundary: frames are pickle (like the reference's in-cluster
+        protobuf RPC, trusted network only) — bind beyond 127.0.0.1 only
+        inside the job's private network."""
         service = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -207,6 +221,8 @@ class MasterService:
                             return
                         (n,) = struct.unpack("<I", head)
                         method, args = pickle.loads(self.rfile.read(n))
+                        if method not in MasterService._RPC_METHODS:
+                            raise ValueError(f"unknown RPC method {method!r}")
                         result = getattr(service, method)(*args)
                         out = pickle.dumps(result,
                                            protocol=pickle.HIGHEST_PROTOCOL)

@@ -19,7 +19,27 @@ FLAGS: Dict[str, Any] = {
     "benchmark": False,
     # donate state buffers to jit for in-place HBM updates
     "donate_state": True,
+    # hand-written Pallas kernels for hot ops (flash attention, fused
+    # layer norm): 'auto' = on when running on TPU; True forces them on
+    # (interpret-mode off-TPU, slow — tests only); False = plain XLA
+    "use_pallas_kernels": "auto",
 }
+
+
+def pallas_enabled() -> bool:
+    import jax
+
+    v = FLAGS["use_pallas_kernels"]
+    if v == "auto":
+        return jax.default_backend() == "tpu"
+    return bool(v)
+
+
+def pallas_interpret() -> bool:
+    """Off-TPU the kernels must run in interpreter mode."""
+    import jax
+
+    return jax.default_backend() != "tpu"
 
 
 def set_flags(d: Dict[str, Any]):

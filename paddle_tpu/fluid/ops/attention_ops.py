@@ -37,6 +37,16 @@ def ring_attention(ctx, ins, attrs):
 
     mesh = current_mesh()
     if mesh is None or seq_axis not in mesh.axis_names:
+        from ..flags import pallas_enabled, pallas_interpret
+
+        # pallas_call has no SPMD partitioning rule — kernel path only when
+        # lowering truly single-device (mesh present but without the seq
+        # axis still means GSPMD shards batch/heads)
+        if pallas_enabled() and mesh is None:
+            from .pallas_kernels import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale,
+                                   interpret=pallas_interpret())
         return ring_attention_shard(q, k, v, None, causal, scale)
     batch_axis = attrs.get("batch_axis", "") or None
     if batch_axis is not None and batch_axis not in mesh.axis_names:

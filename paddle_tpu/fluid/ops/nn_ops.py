@@ -169,6 +169,20 @@ def layer_norm(ctx, ins, attrs):
     scale, bias = one(ins, "Scale"), one(ins, "Bias")
     eps = float(attrs.get("epsilon", 1e-5))
     begin = int(attrs.get("begin_norm_axis", 1))
+    from ...parallel import current_mesh
+    from ..flags import pallas_enabled, pallas_interpret
+
+    # pallas_call has no SPMD partitioning rule — only take the kernel path
+    # in single-device lowering (under a ParallelExecutor mesh, plain jnp
+    # lets GSPMD shard the op)
+    if pallas_enabled() and current_mesh() is None:
+        from .pallas_kernels import fused_layer_norm
+
+        y, mean, var = fused_layer_norm(
+            x, scale, bias, eps=eps, begin_norm_axis=begin,
+            interpret=pallas_interpret(),
+        )
+        return {"Y": y, "Mean": mean, "Variance": var}
     axes = tuple(range(begin, x.ndim))
     mean = jnp.mean(x, axis=axes, keepdims=True)
     var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)

@@ -1,0 +1,17 @@
+"""Hand-written Pallas TPU kernels for hot ops.
+
+The reference's hand-tuned CUDA lives in paddle/fluid/operators/*.cu and
+operators/math/ (fused LSTM cells, depthwise conv, warp softmax). On TPU
+XLA fuses most of that automatically; the kernels here cover the cases
+where explicit VMEM blocking beats XLA's default schedule:
+
+  - flash_attention: online-softmax attention, O(S) VMEM per query block
+    (never materializes the [Sq, Sk] score matrix in HBM)
+  - fused layer_norm: one pass over rows, mean/var/normalize/affine fused
+
+Each has a jnp reference backward (custom_vjp), and `interpret=True` runs
+on CPU for tests. Enable via FLAGS['use_pallas_kernels'] (auto-picked by
+emitters when the backend is TPU).
+"""
+from .flash_attention import flash_attention  # noqa: F401
+from .layer_norm import fused_layer_norm  # noqa: F401

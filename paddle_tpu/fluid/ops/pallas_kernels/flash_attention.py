@@ -1,0 +1,172 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Forward: grid (batch*heads, Sq/block_q); each program streams K/V blocks
+from VMEM with an online softmax (running max / sum), so only
+[block_q, block_k] scores ever exist — the [Sq, Sk] matrix never hits HBM.
+Backward: recompute-based jnp formulas under custom_vjp (same math as
+parallel/sequence_parallel.py's ring backward with one block), which XLA
+fuses well; the kernel win is the forward's VMEM locality.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k, sk_real):
+    q = q_ref[0].astype(jnp.float32)  # [bq, D]
+    bq = q.shape[0]
+    sk_pad = k_ref.shape[1]
+    nk = sk_pad // block_k
+    iq = pl.program_id(1)
+    mask_pad = sk_pad > sk_real  # static: key padding needs masking
+
+    def body(kb, carry):
+        m, l, acc = carry  # [bq,1], [bq,1], [bq,D]
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        keep = None
+        if causal or mask_pad:
+            kpos = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            keep = kpos < sk_real if mask_pad else None
+            if causal:
+                qpos = iq * bq + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                c = qpos >= kpos
+                keep = c if keep is None else jnp.logical_and(keep, c)
+            s = jnp.where(keep, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        l_new = l * alpha + p.sum(axis=1, keepdims=True)
+        pv = lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc * alpha + pv
+
+    d = q.shape[1]
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    if causal:
+        # skip K blocks entirely above the diagonal for this query block
+        nk_iter = jnp.minimum(nk, pl.cdiv((iq + 1) * bq, block_k))
+    else:
+        nk_iter = nk
+    m, l, acc = lax.fori_loop(0, nk_iter, body, (m0, l0, acc0))
+    l = jnp.maximum(l, jnp.finfo(jnp.float32).tiny)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    # blocks are multiples of 8 (TPU sublane); inputs are zero-padded to a
+    # whole number of blocks and padded keys masked inside the kernel
+    bq = min(_round_up(block_q, 8), _round_up(sq, 8))
+    bk = min(_round_up(block_k, 8), _round_up(sk, 8))
+    sq_pad, sk_pad = _round_up(sq, bq), _round_up(sk, bk)
+    if sq_pad != sq:
+        q = jnp.pad(q, ((0, 0), (0, sq_pad - sq), (0, 0)))
+    if sk_pad != sk:
+        k = jnp.pad(k, ((0, 0), (0, sk_pad - sk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, sk_pad - sk), (0, 0)))
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=bk, sk_real=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, sq_pad // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :sq], lse[:, :sq]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd_pallas(q, k, v, scale, causal, block_q, block_k,
+                           interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, dout):
+    q, k, v, out, lse = res
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+    do32 = dout.astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", q32, k32,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = s.shape[1], s.shape[2]
+        keep = (jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :])[None]
+        s = jnp.where(keep, s, NEG_INF)
+    p = jnp.exp(s - lse[:, :, None])
+    if causal:
+        p = jnp.where(keep, p, 0.0)
+    dv = jnp.einsum("bqk,bqd->bkd", p, do32,
+                    preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bqd,bkd->bqk", do32, v32,
+                    preferred_element_type=jnp.float32)
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [b,q,1]
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bqk,bkd->bqd", ds, k32,
+                    preferred_element_type=jnp.float32) * scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q32,
+                    preferred_element_type=jnp.float32) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_bhsd.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """q/k/v: [B, S, H, D] (the layout of layers.ring_attention). Returns
+    [B, Sq, H, D]."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = float(scale) if scale else d ** -0.5
+
+    def to_bhsd(x, s):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash_bhsd(to_bhsd(q, sq), to_bhsd(k, sk), to_bhsd(v, sk),
+                      scale, causal, block_q, block_k, interpret)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -51,7 +51,8 @@ def test_master_lease_timeout_requeues(tmp_path):
     t2 = svc.get_task()  # expired lease requeued
     assert t2 is not None and t2.id == t1.id
     assert t2.num_failures == 1
-    assert not svc.task_finished(t1.id) or True  # old lease gone either way
+    # the stale holder cannot finish the re-leased task
+    assert not svc.task_finished(t1.id, t1.epoch)
 
 
 def test_master_failure_max_drops(tmp_path):

@@ -1,0 +1,169 @@
+"""Pallas kernels (flash attention, fused layer norm) in interpret mode on
+CPU vs dense references, forward and backward."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.fluid.ops.pallas_kernels import flash_attention, fused_layer_norm
+
+
+def dense_attention(q, k, v, causal=False, scale=None):
+    q, k, v = (np.asarray(x, np.float64) for x in (q, k, v))
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = s.shape[2], s.shape[3]
+        mask = np.arange(sq)[:, None] >= np.arange(sk)[None, :]
+        s = np.where(mask[None, None], s, -np.inf)
+    s = s - s.max(axis=-1, keepdims=True)
+    p = np.exp(s)
+    p /= p.sum(axis=-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 64, 2, 16), (1, 24, 3, 8)])
+def test_flash_attention_forward(causal, shape):
+    b, s, h, d = shape
+    rng = np.random.RandomState(0)
+    q, k, v = (rng.randn(b, s, h, d).astype(np.float32) for _ in range(3))
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          causal=causal, block_q=16, block_k=16,
+                          interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), dense_attention(q, k, v, causal), atol=2e-5
+    )
+
+
+def test_flash_attention_cross_lengths():
+    rng = np.random.RandomState(1)
+    q = rng.randn(2, 16, 2, 8).astype(np.float32)
+    k = rng.randn(2, 48, 2, 8).astype(np.float32)
+    v = rng.randn(2, 48, 2, 8).astype(np.float32)
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          block_q=8, block_k=16, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), dense_attention(q, k, v), atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grads(causal):
+    rng = np.random.RandomState(2)
+    q, k, v = (rng.randn(1, 16, 2, 8).astype(np.float32) for _ in range(3))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, causal=causal, block_q=8, block_k=8, interpret=True)))
+
+    def loss_dense(q, k, v):
+        scale = q.shape[-1] ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            sq = s.shape[2]
+            m = jnp.arange(sq)[:, None] >= jnp.arange(sq)[None, :]
+            s = jnp.where(m[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.sin(jnp.einsum("bhqk,bkhd->bqhd", p, v)))
+
+    args = tuple(jnp.asarray(x) for x in (q, k, v))
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(*args)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(*args)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_fused_layer_norm_matches_reference():
+    rng = np.random.RandomState(3)
+    x = rng.randn(6, 5, 32).astype(np.float32) * 3 + 1
+    scale = rng.randn(5 * 32).astype(np.float32)
+    bias = rng.randn(5 * 32).astype(np.float32)
+    y, mean, var = fused_layer_norm(
+        jnp.asarray(x), jnp.asarray(scale), jnp.asarray(bias),
+        begin_norm_axis=1, interpret=True)
+    x2 = x.reshape(6, -1).astype(np.float64)
+    mu = x2.mean(1, keepdims=True)
+    vr = x2.var(1, keepdims=True)
+    ref = ((x2 - mu) / np.sqrt(vr + 1e-5) * scale + bias).reshape(x.shape)
+    np.testing.assert_allclose(np.asarray(y), ref, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(mean), mu[:, 0], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), vr[:, 0], atol=2e-4)
+
+
+def test_fused_layer_norm_grads():
+    rng = np.random.RandomState(4)
+    x = rng.randn(8, 16).astype(np.float32)
+    scale = rng.randn(16).astype(np.float32)
+    bias = rng.randn(16).astype(np.float32)
+
+    def loss_fused(x, s, b):
+        y, _, _ = fused_layer_norm(x, s, b, interpret=True)
+        return jnp.sum(jnp.sin(y))
+
+    def loss_ref(x, s, b):
+        mu = x.mean(1, keepdims=True)
+        vr = ((x - mu) ** 2).mean(1, keepdims=True)
+        y = (x - mu) * jax.lax.rsqrt(vr + 1e-5) * s + b
+        return jnp.sum(jnp.sin(y))
+
+    args = tuple(jnp.asarray(a) for a in (x, scale, bias))
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(*args)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(*args)
+    for a, b, name in zip(gf, gr, ["dx", "dscale", "dbias"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5,
+                                   err_msg=name)
+
+
+def test_layer_norm_op_uses_pallas_when_forced():
+    """Program-level: forcing the flag routes layer_norm through the fused
+    kernel (interpret mode on CPU) and still trains."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.flags import set_flags
+    from paddle_tpu.fluid.framework import Program, program_guard
+
+    set_flags({"use_pallas_kernels": True})
+    try:
+        main, startup, scope = Program(), Program(), fluid.Scope()
+        with fluid.scope_guard(scope):
+            with program_guard(main, startup):
+                x = layers.data(name="x", shape=[16], dtype="float32")
+                y = layers.data(name="y", shape=[16], dtype="float32")
+                h = layers.layer_norm(x)
+                # ring_attention falls through to the pallas flash kernel
+                q = layers.data(name="q", shape=[8, 2, 4], dtype="float32")
+                att = layers.ring_attention(q, q, q, causal=True)
+                cost = layers.elementwise_add(
+                    layers.mean(layers.square_error_cost(input=h, label=y)),
+                    layers.scale(layers.mean(att), scale=0.0))
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+            exe = fluid.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            xs = rng.randn(4, 16).astype(np.float32)
+            ys = np.tanh(xs)
+            l0 = exe.run(main, feed={"x": xs, "y": ys,
+                                     "q": rng.randn(2, 8, 2, 4).astype(np.float32)},
+                         fetch_list=[cost])[0].item()
+            assert np.isfinite(l0)
+    finally:
+        set_flags({"use_pallas_kernels": "auto"})
+
+
+def test_flash_attention_non_multiple_of_8_lengths():
+    # padding path: sequence lengths not divisible by the block or by 8
+    rng = np.random.RandomState(5)
+    q = rng.randn(1, 13, 2, 8).astype(np.float32)
+    k = rng.randn(1, 21, 2, 8).astype(np.float32)
+    v = rng.randn(1, 21, 2, 8).astype(np.float32)
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          causal=False, block_q=8, block_k=8, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), dense_attention(q, k, v, False), atol=2e-5)
+    # causal with equal ragged lengths
+    out = flash_attention(jnp.asarray(q), jnp.asarray(q), jnp.asarray(q),
+                          causal=True, block_q=8, block_k=8, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), dense_attention(q, q, q, True), atol=2e-5)
