@@ -5,6 +5,24 @@ same reader-creator API backed by deterministic synthetic data with the real
 shapes/vocab sizes; pass `data_dir`/env PADDLE_TPU_DATA to use real data laid
 out on disk where available.
 """
-from . import cifar, flowers, imdb, imikolov, mnist, movielens, uci_housing, wmt14  # noqa: F401
+from . import (  # noqa: F401
+    cifar,
+    conll05,
+    flowers,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    mq2007,
+    sentiment,
+    uci_housing,
+    voc2012,
+    wmt14,
+    wmt16,
+)
 
-__all__ = ["mnist", "cifar", "uci_housing", "imdb", "flowers", "movielens", "wmt14"]
+__all__ = [
+    "mnist", "cifar", "uci_housing", "imdb", "imikolov", "flowers",
+    "movielens", "wmt14", "wmt16", "conll05", "sentiment", "voc2012",
+    "mq2007",
+]

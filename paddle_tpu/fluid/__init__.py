@@ -19,6 +19,10 @@ from . import (  # noqa: F401
     unique_name,
 )
 from .distribute_transpiler import DistributeTranspiler  # noqa: F401
+from .memory_optimization_transpiler import (  # noqa: F401
+    memory_optimize,
+    release_memory,
+)
 from .backward import append_backward, calc_gradient  # noqa: F401
 from .clip import (  # noqa: F401
     ErrorClipByValue,

@@ -139,7 +139,7 @@ def _lower(block, feed_names: Tuple[str, ...], fetch_names: Tuple[str, ...],
         env.update(state_rw)
         env.update(feeds)
         ctx = EmitCtx(root_key=key, program=program)
-        exec_op_descs(ctx, ops, env)
+        exec_op_descs(ctx, ops, env, keep=frozenset(fetch_names))
         fetches = []
         for n in fetch_names:
             if n not in env:

@@ -24,6 +24,7 @@ __all__ = [
     "load_vars", "load_params", "load_persistables",
     "save_inference_model", "load_inference_model", "get_inference_program",
     "save_checkpoint", "load_checkpoint",
+    "export_compiled_model", "load_exported_model",
 ]
 
 MODEL_FILENAME = "__model__"
@@ -173,6 +174,87 @@ def get_inference_program(target_vars, main_program=None):
     if not isinstance(target_vars, (list, tuple)):
         target_vars = [target_vars]
     return _prune_for_inference(main_program, [], target_vars)
+
+
+# --- compiled deploy artifact (role of the reference's C++ inference
+#     library, paddle/fluid/inference/io.h:32 + paddle/capi: run a saved
+#     model without the Python framework). The artifact is serialized
+#     StableHLO (jax.export) with the parameters baked in as constants —
+#     loadable by any PJRT runtime (C++/serving) or back into Python. ----
+def export_compiled_model(dirname, feeded_var_names, target_vars,
+                          executor=None, main_program=None,
+                          scope: Optional[Scope] = None, batch_size: int = 1):
+    """Prune to the inference slice, close over the current parameter
+    values, and serialize the whole computation as StableHLO. Returns the
+    artifact path."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+
+    from .executor import _block_io, _lower
+
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                   for v in target_vars]
+    pruned = _prune_for_inference(main_program, feeded_var_names, target_vars)
+    block = pruned.global_block()
+
+    state_in, state_out = _block_io(block, set(feeded_var_names), scope)
+    fn, ro_names, rw_names = _lower(
+        block, tuple(feeded_var_names), tuple(fetch_names),
+        tuple(state_in), tuple(state_out),
+    )
+    params = {}
+    for n in state_in:
+        val = scope.find_var(n)
+        if val is None:
+            raise RuntimeError(f"var '{n}' not initialized in scope")
+        params[n] = jnp.asarray(val)
+
+    def serve(*feed_arrays):
+        feeds = dict(zip(feeded_var_names, feed_arrays))
+        fetches, _ = fn(
+            feeds,
+            {n: params[n] for n in ro_names},
+            {n: params[n] for n in rw_names},
+            jax.random.key(0),
+        )
+        return tuple(fetches)
+
+    specs = []
+    feed_meta = []
+    for n in feeded_var_names:
+        var = block.var(n)
+        shape = [batch_size if (d is None or d < 0) else int(d)
+                 for d in var.shape]
+        specs.append(jax.ShapeDtypeStruct(tuple(shape), np.dtype(str(var.dtype))))
+        feed_meta.append({"name": n, "shape": shape, "dtype": str(var.dtype)})
+
+    exported = jax_export.export(jax.jit(serve))(*specs)
+    path = os.path.join(dirname, "__stablehlo__.bin")
+    with open(path, "wb") as f:
+        f.write(exported.serialize())
+    with open(os.path.join(dirname, "__export_meta__.json"), "w") as f:
+        json.dump({"feeds": feed_meta, "fetch_names": fetch_names}, f)
+    return path
+
+
+def load_exported_model(dirname):
+    """Load a StableHLO artifact; returns (callable(*feeds) -> [fetches],
+    feed_meta, fetch_names)."""
+    from jax import export as jax_export
+
+    with open(os.path.join(dirname, "__stablehlo__.bin"), "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(os.path.join(dirname, "__export_meta__.json")) as f:
+        meta = json.load(f)
+
+    def run(*feeds):
+        return [np.asarray(x) for x in exported.call(*feeds)]
+
+    return run, meta["feeds"], meta["fetch_names"]
 
 
 # --- checkpoint/resume with integrity check (Go pserver capability,

@@ -1,0 +1,171 @@
+"""Static memory optimization (reference
+python/paddle/fluid/memory_optimization_transpiler.py: ControlFlowGraph:40,
+liveness via _dataflow_analyze_, var reuse by shape/dtype cache pool,
+memory_optimize:332, release_memory:340).
+
+Under XLA the executable's buffer assignment already reuses dead buffers, so
+the *runtime* effect of the reference pass comes for free. What this module
+keeps is the capability surface:
+  - ControlFlowGraph + liveness analysis (used for diagnostics and tests),
+  - memory_optimize(program): the reference's name-rewriting reuse pass —
+    dead non-persistable vars with identical static shape/dtype are merged,
+    shrinking the program's var set (and giving XLA's liveness a head
+    start at trace time),
+  - release_memory(program): inserts delete_var ops after last use
+    (no-ops at XLA runtime; kept for program-level parity),
+  - estimate_peak_bytes(program): live-set peak from the same liveness.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from .framework import Parameter, Program
+
+_SUB_BLOCK_OPS = {"while", "conditional_block", "recurrent", "parallel_do"}
+_SKIP_OPS = {"feed", "fetch"}
+
+
+class ControlFlowGraph:
+    """Per-block def/use + backward liveness (reference :40)."""
+
+    def __init__(self, block):
+        self.block = block
+        self.ops = [op.desc for op in block.ops]
+        self.uses: List[Set[str]] = []
+        self.defs: List[Set[str]] = []
+        for od in self.ops:
+            self.uses.append({n for n in od.input_names() if n})
+            self.defs.append({n for n in od.output_names() if n})
+        self.live_in: List[Set[str]] = [set() for _ in self.ops]
+        self.live_out: List[Set[str]] = [set() for _ in self.ops]
+        self._analyze()
+
+    def _analyze(self):
+        changed = True
+        while changed:
+            changed = False
+            for i in range(len(self.ops) - 1, -1, -1):
+                out = set()
+                if i + 1 < len(self.ops):
+                    out = set(self.live_in[i + 1])
+                new_in = self.uses[i] | (out - self.defs[i])
+                if out != self.live_out[i] or new_in != self.live_in[i]:
+                    self.live_out[i], self.live_in[i] = out, new_in
+                    changed = True
+
+    def last_use_index(self) -> Dict[str, int]:
+        last: Dict[str, int] = {}
+        for i, od in enumerate(self.ops):
+            for n in self.uses[i] | self.defs[i]:
+                last[n] = i
+        return last
+
+
+def _reusable(block, name: str, skip: Set[str]) -> bool:
+    if name in skip:
+        return False
+    var = block.vars.get(name)
+    if var is None or isinstance(var, Parameter) or var.persistable:
+        return False
+    shape = getattr(var, "shape", None)
+    if not shape or any(d is None or d < 0 for d in shape):
+        return False
+    return True
+
+
+def _size_key(block, name):
+    var = block.vars[name]
+    return (tuple(var.shape), str(var.dtype))
+
+
+def memory_optimize(input_program: Program, skip_opt_set=None,
+                    print_log: bool = False, level: int = 0) -> int:
+    """In-place var-reuse rewrite of the global block; returns the number of
+    merged vars. Programs with sub-block control flow keep those vars
+    untouched (the reference pairs sub-blocks explicitly,
+    _process_sub_block_pair:254 — here they're conservatively skipped)."""
+    block = input_program.global_block()
+    skip: Set[str] = set(skip_opt_set or ())
+    for op in block.ops:
+        if op.desc.type in _SUB_BLOCK_OPS:
+            # anything touched by control flow stays
+            skip.update(n for n in op.desc.input_names() if n)
+            skip.update(n for n in op.desc.output_names() if n)
+    cfg = ControlFlowGraph(block)
+
+    pool: List[str] = []  # dead var names available for reuse
+    rename: Dict[str, str] = {}
+    merged = 0
+    for i, od in enumerate(cfg.ops):
+        if od.type in _SKIP_OPS:
+            continue
+        # rewrite already-merged inputs/outputs
+        od.rename_inputs(rename)
+        od.rename_outputs(rename)
+        # fresh defs may take over a dead var of identical shape/dtype
+        for out in list(od.output_names()):
+            if not out or out in rename or not _reusable(block, out, skip):
+                continue
+            key = _size_key(block, out)
+            for cand in pool:
+                if _size_key(block, cand) == key and cand != out:
+                    rename[out] = cand
+                    od.rename_outputs({out: cand})
+                    block.vars.pop(out, None)
+                    pool.remove(cand)
+                    merged += 1
+                    if print_log:
+                        print(f"[memory_optimize] {out} -> {cand}")
+                    break
+        # vars whose live range ends at this op join the pool
+        dead = (cfg.uses[i] | cfg.defs[i]) - cfg.live_out[i]
+        for n in dead:
+            n = rename.get(n, n)
+            if _reusable(block, n, skip) and n not in pool:
+                pool.append(n)
+    input_program._bump_version()
+    return merged
+
+
+def release_memory(input_program: Program, skip_opt_set=None) -> int:
+    """Insert delete_var ops after each var's last use (reference :340).
+    At XLA runtime these are no-ops (buffer lifetime is the executable's),
+    so this keeps program-shape parity only; returns ops inserted."""
+    block = input_program.global_block()
+    skip = set(skip_opt_set or ())
+    cfg = ControlFlowGraph(block)
+    last = cfg.last_use_index()
+    inserts = []  # (index, name)
+    for name, idx in last.items():
+        if _reusable(block, name, skip) and name not in cfg.live_out[idx]:
+            inserts.append((idx, name))
+    from .framework import Operator
+
+    for idx, name in sorted(inserts, reverse=True):
+        op = Operator(block, "delete_var", inputs={"X": [name]})
+        block.ops.insert(idx + 1, op)
+    input_program._bump_version()
+    return len(inserts)
+
+
+def estimate_peak_bytes(input_program: Program) -> int:
+    """Peak of sum(live var bytes) over the op schedule — the quantity the
+    reference pass minimizes."""
+    block = input_program.global_block()
+    cfg = ControlFlowGraph(block)
+
+    def nbytes(name) -> int:
+        var = block.vars.get(name)
+        shape = getattr(var, "shape", None) if var is not None else None
+        if not shape or any(d is None or d < 0 for d in shape):
+            return 0
+        return int(np.prod(shape)) * np.dtype(
+            str(getattr(var, "dtype", "float32"))).itemsize
+
+    peak = 0
+    for i in range(len(cfg.ops)):
+        live = cfg.live_in[i] | cfg.defs[i]
+        peak = max(peak, sum(nbytes(n) for n in live))
+    return peak

@@ -26,17 +26,19 @@ def _ln_kernel(x_ref, scale_ref, bias_ref, y_ref, mean_ref, rstd_ref, *, eps):
     rstd_ref[:] = rstd[:, 0]
 
 
-def _pick_rows(n: int, want: int) -> int:
-    want = min(want, n)
-    for b in range(want, 0, -1):
-        if n % b == 0:
-            return b
-    return n
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
 
 
 def _ln_pallas(x, scale, bias, eps, block_rows, interpret):
-    n, f = x.shape
-    bn = _pick_rows(n, block_rows)
+    n_real, f = x.shape
+    # zero-pad rows to a whole number of 8-multiple blocks (padded rows
+    # compute garbage stats that are sliced off) — same trick as
+    # flash_attention; avoids degenerate 1-row programs for prime n
+    bn = min(_round_up(block_rows, 8), _round_up(n_real, 8))
+    n = _round_up(n_real, bn)
+    if n != n_real:
+        x = jnp.pad(x, ((0, n - n_real), (0, 0)))
     y, mean, rstd = pl.pallas_call(
         functools.partial(_ln_kernel, eps=eps),
         grid=(n // bn,),

@@ -65,6 +65,16 @@ class OpDesc:
     def output_names(self) -> List[str]:
         return [n for names in self.outputs.values() for n in names if n]
 
+    def rename_inputs(self, mapping: Dict[str, str]):
+        if mapping:
+            for slot, names in self.inputs.items():
+                self.inputs[slot] = [mapping.get(n, n) for n in names]
+
+    def rename_outputs(self, mapping: Dict[str, str]):
+        if mapping:
+            for slot, names in self.outputs.items():
+                self.outputs[slot] = [mapping.get(n, n) for n in names]
+
 
 @dataclasses.dataclass
 class BlockDesc:

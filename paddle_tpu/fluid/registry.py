@@ -135,12 +135,23 @@ def run_forward(ctx: EmitCtx, op_type: str, ins, attrs) -> Dict[str, List[Any]]:
 
 
 def exec_op_descs(ctx: EmitCtx, op_descs, env: Dict[str, Any],
-                  skip_types=("feed", "fetch")):
+                  skip_types=("feed", "fetch"), keep=frozenset()):
     """Trace a list of OpDescs into env — the executor's hot loop, also used
     by control-flow emitters on sub-blocks (the reference nests Executors,
-    while_op.cc:35; here it's one trace)."""
+    while_op.cc:35; here it's one trace). `keep` protects names (fetch
+    targets) from delete_var."""
     for od in op_descs:
         if od.type in skip_types:
+            continue
+        if od.type == "delete_var":
+            # memory_optimization_transpiler.release_memory marker: drop the
+            # traced value so XLA's liveness ends here (reference
+            # delete_var_op.cc frees the buffer). Fetch targets survive —
+            # this executor injects fetches at run time, so program-level
+            # liveness can't see them (unlike the reference's fetch ops).
+            for n in od.input_names():
+                if n not in keep:
+                    env.pop(n, None)
             continue
         ins = {
             slot: [env.get(n) if n else None for n in names]

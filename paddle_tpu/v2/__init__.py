@@ -1,0 +1,20 @@
+"""v2-compatible API facade (reference python/paddle/v2/ — the 2016-era
+event-loop framework: trainer.py SGD:37/train:137, layer.py, parameters.py,
+inference.py).
+
+Capability, not code, parity (SURVEY.md §2.8/§7 step 10): v2-style programs
+— build a cost layer, create parameters, run an event-handler training loop
+— execute on the fluid-equivalent TPU core underneath (one Program, XLA
+lowering). The layer DSL maps onto fluid layers."""
+from .. import batch, reader  # noqa: F401
+from .. import dataset  # noqa: F401
+from . import event, layer, networks, optimizer  # noqa: F401
+from .inference import infer  # noqa: F401
+from .parameters import Parameters, create  # noqa: F401
+from .trainer import SGD  # noqa: F401
+
+
+def init(use_gpu: bool = False, trainer_count: int = 1, **kwargs):
+    """reference paddle.init — device selection is automatic under JAX;
+    kept as a no-op for source compatibility."""
+    return None

@@ -1,0 +1,99 @@
+"""v2 layer DSL mapped onto fluid layers (reference python/paddle/v2/layer.py
++ trainer_config_helpers/layers.py — declarative layers composed by passing
+outputs as inputs). Each function appends ops to the implicit default
+program, exactly like fluid layers; the v2-specific `data_type` objects
+translate to fluid data vars."""
+from __future__ import annotations
+
+from ..fluid import layers as _fl
+
+
+class _DataType:
+    def __init__(self, kind: str, dim: int, seq: bool = False):
+        self.kind = kind
+        self.dim = dim
+        self.seq = seq
+
+
+class data_type:
+    """reference paddle.v2.data_type."""
+
+    @staticmethod
+    def dense_vector(dim):
+        return _DataType("dense", dim)
+
+    @staticmethod
+    def integer_value(dim):
+        return _DataType("int", dim)
+
+    @staticmethod
+    def integer_value_sequence(dim):
+        return _DataType("int", dim, seq=True)
+
+    @staticmethod
+    def dense_vector_sequence(dim):
+        return _DataType("dense", dim, seq=True)
+
+
+def data(name, type: _DataType, **kwargs):
+    if type.kind == "int":
+        shape = [1]
+        dtype = "int64"
+    else:
+        shape = [type.dim]
+        dtype = "float32"
+    var = _fl.data(name=name, shape=shape, dtype=dtype, **kwargs)
+    var._v2_type = type  # embedding_layer sizes its table from this
+    return var
+
+
+def fc_layer(input, size, act=None, **kwargs):
+    return _fl.fc(input=input, size=size, act=act, **kwargs)
+
+
+def embedding_layer(input, size, vocab_size=None, **kwargs):
+    """Table rows come from the input data layer's declared integer dim
+    (reference: the v2 config carries the vocab through the data type)."""
+    if vocab_size is None:
+        t = getattr(input, "_v2_type", None)
+        if t is None or t.kind != "int":
+            raise ValueError(
+                "embedding_layer needs vocab_size= or an input created by "
+                "v2.layer.data with integer_value(_sequence)(dim)"
+            )
+        vocab_size = t.dim
+    return _fl.embedding(input, size=[vocab_size, size], **kwargs)
+
+
+def mixed_layer(input, size, act=None, **kwargs):
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    return _fl.fc(input=list(ins), size=size, act=act)
+
+
+def classification_cost(input, label):
+    return _fl.mean(_fl.cross_entropy(input=input, label=label))
+
+
+def square_error_cost(input, label):
+    return _fl.mean(_fl.square_error_cost(input=input, label=label))
+
+
+def cross_entropy_cost(input, label):
+    return classification_cost(input, label)
+
+
+# direct fluid passthroughs under their v2 names
+conv_layer = _fl.conv2d
+pooling_layer = _fl.pool2d
+batch_norm_layer = _fl.batch_norm
+dropout_layer = _fl.dropout
+concat_layer = None  # set below (needs list signature)
+
+
+def _concat(input, **kwargs):
+    from ..fluid.layers import tensor as _t
+
+    return _t.concat(input, **kwargs)
+
+
+concat_layer = _concat

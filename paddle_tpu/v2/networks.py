@@ -1,0 +1,19 @@
+"""v2 network compositions (reference python/paddle/v2/networks.py →
+trainer_config_helpers/networks.py) mapped to fluid.nets."""
+from __future__ import annotations
+
+from ..fluid import nets as _nets
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, **kwargs):
+    return _nets.simple_img_conv_pool(
+        input=input, filter_size=filter_size, num_filters=num_filters,
+        pool_size=pool_size, pool_stride=pool_stride, act=act,
+    )
+
+
+def sequence_conv_pool(input, context_len, hidden_size, **kwargs):
+    return _nets.sequence_conv_pool(
+        input=input, num_filters=hidden_size, filter_size=context_len,
+    )

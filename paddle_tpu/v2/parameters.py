@@ -1,0 +1,57 @@
+"""v2 Parameters (reference python/paddle/v2/parameters.py — numpy-backed
+parameter pool with tar serialization)."""
+from __future__ import annotations
+
+import pickle
+from typing import Dict
+
+import numpy as np
+
+from .. import fluid
+
+
+class Parameters:
+    """Holds the scope + programs behind a v2 topology."""
+
+    def __init__(self, scope, main_program, startup_program):
+        self.scope = scope
+        self.main_program = main_program
+        self.startup_program = startup_program
+
+    def names(self):
+        return [p.name for p in self.main_program.global_block().all_parameters()]
+
+    def get(self, name) -> np.ndarray:
+        return np.asarray(self.scope.find_var(name))
+
+    def set(self, name, value):
+        import jax.numpy as jnp
+
+        self.scope.set_var(name, jnp.asarray(value))
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def to_tar(self, f):
+        """reference to_tar — here a pickle of name->ndarray."""
+        pickle.dump({n: self.get(n) for n in self.names()}, f,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+
+    @classmethod
+    def from_tar(cls, f, topology_cost=None):
+        data: Dict[str, np.ndarray] = pickle.load(f)
+        params = create(topology_cost)
+        for n, v in data.items():
+            params.set(n, v)
+        return params
+
+
+def create(cost=None) -> Parameters:
+    """Materialize parameters for the current default programs (reference
+    paddle.v2.parameters.create(cost)): runs the startup program into a
+    fresh scope."""
+    scope = fluid.global_scope()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return Parameters(scope, fluid.default_main_program(),
+                      fluid.default_startup_program())

@@ -1,0 +1,61 @@
+"""Dataset reader creators: every reference dataset module present with the
+right sample shapes (reference python/paddle/dataset/)."""
+import numpy as np
+
+import paddle_tpu.dataset as ds
+
+
+def _first(reader):
+    return next(iter(reader()))
+
+
+def test_all_fourteen_modules_present():
+    for name in ["mnist", "cifar", "uci_housing", "imdb", "imikolov",
+                 "flowers", "movielens", "wmt14", "wmt16", "conll05",
+                 "sentiment", "voc2012", "mq2007"]:
+        assert hasattr(ds, name), name
+
+
+def test_conll05_shapes():
+    s = _first(ds.conll05.train())
+    assert len(s) == 9
+    length = len(s[0])
+    assert all(len(f) == length for f in s)
+    w, v, l = ds.conll05.get_dict()
+    assert len(l) == ds.conll05.LABEL_DICT_LEN
+
+
+def test_sentiment_shapes():
+    ids, label = _first(ds.sentiment.train())
+    assert label in (0, 1) and len(ids) >= 10
+    assert max(ids) < ds.sentiment.VOCAB_SIZE
+
+
+def test_voc2012_shapes():
+    img, seg = _first(ds.voc2012.train())
+    assert img.shape == (3, 64, 64) and seg.shape == (64, 64)
+    assert seg.max() < ds.voc2012.NUM_CLASSES
+
+
+def test_mq2007_formats():
+    a, b = _first(ds.mq2007.train("pairwise"))
+    assert a.shape == (46,) and b.shape == (46,)
+    rel, feats = _first(ds.mq2007.train("listwise"))
+    assert feats.shape[1] == 46 and len(rel) == feats.shape[0]
+    f, r = _first(ds.mq2007.train("pointwise"))
+    assert f.shape == (46,) and r in (0, 1, 2)
+
+
+def test_wmt16_copy_task():
+    src, trg_in, trg_out = _first(ds.wmt16.train())
+    assert trg_in[0] == ds.wmt16.START_ID
+    assert trg_out[-1] == ds.wmt16.END_ID
+    assert trg_in[1:] == trg_out[:-1]
+    d = ds.wmt16.get_dict("en", 100)
+    assert d["<s>"] == 0 and len(d) == 100
+
+
+def test_determinism():
+    a = list(ds.sentiment.train()())[:5]
+    b = list(ds.sentiment.train()())[:5]
+    assert a == b

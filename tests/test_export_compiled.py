@@ -1,0 +1,38 @@
+"""StableHLO export/deploy artifact (role of the reference's C++ inference
+library, paddle/fluid/inference/io.h:32): compile-once, run without the
+framework."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def test_export_compiled_model_roundtrip(tmp_path):
+    from paddle_tpu.fluid import unique_name
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup), unique_name.guard():
+            x = layers.data(name="x", shape=[8], dtype="float32")
+            h = layers.fc(input=x, size=16, act="relu")
+            pred = layers.fc(input=h, size=3, act="softmax")
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        d = str(tmp_path / "deploy")
+        fluid.io.export_compiled_model(
+            d, ["x"], [pred], exe, main_program=main, scope=scope,
+            batch_size=4)
+
+        rng = np.random.RandomState(0)
+        xs = rng.rand(4, 8).astype(np.float32)
+        # framework result
+        (want,) = exe.run(main, feed={"x": xs}, fetch_list=[pred])
+
+    # load WITHOUT any program/scope state — the artifact is standalone
+    run, feeds, fetch_names = fluid.io.load_exported_model(d)
+    assert feeds[0]["name"] == "x" and feeds[0]["shape"] == [4, 8]
+    (got,) = run(xs)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    np.testing.assert_allclose(got.sum(axis=1), 1.0, rtol=1e-5)

@@ -1,0 +1,83 @@
+"""v2 facade: event-loop trainer, parameters, inference (reference
+python/paddle/v2/trainer.py SGD + tests/book v2-style usage)."""
+import numpy as np
+
+import paddle_tpu.v2 as paddle
+
+
+def test_v2_fit_a_line_event_loop():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import unique_name
+    from paddle_tpu.fluid.framework import Program, program_guard
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    events = {"passes": 0, "iters": 0, "costs": []}
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup), unique_name.guard():
+            paddle.init(use_gpu=False, trainer_count=1)
+            x = paddle.layer.data(
+                name="x", type=paddle.layer.data_type.dense_vector(13))
+            y = paddle.layer.data(
+                name="y", type=paddle.layer.data_type.dense_vector(1))
+            pred = paddle.layer.fc_layer(input=x, size=1)
+            cost = paddle.layer.square_error_cost(input=pred, label=y)
+
+            parameters = paddle.create(cost)
+            trainer = paddle.SGD(
+                cost=cost, parameters=parameters,
+                update_equation=paddle.optimizer.Momentum(
+                    momentum=0.9, learning_rate=1e-2),
+            )
+
+            def handler(e):
+                if isinstance(e, paddle.event.EndIteration):
+                    events["iters"] += 1
+                    events["costs"].append(e.cost)
+                elif isinstance(e, paddle.event.EndPass):
+                    events["passes"] += 1
+
+            reader = paddle.batch(
+                paddle.reader.shuffle(paddle.dataset.uci_housing.train(),
+                                      buf_size=256),
+                batch_size=32)
+            trainer.train(reader=reader, num_passes=3, event_handler=handler,
+                          feeding={"x": 0, "y": 1})
+
+            assert events["passes"] == 3
+            assert events["iters"] > 10
+            assert events["costs"][-1] < events["costs"][0] / 3
+
+            # inference through the same topology
+            samples = [s for _, s in zip(range(8),
+                                         paddle.dataset.uci_housing.test()())]
+            out = paddle.infer(output_layer=pred, parameters=parameters,
+                               input=[(s[0],) for s in samples],
+                               feeding={"x": 0})
+            assert out.shape == (8, 1)
+            assert np.isfinite(out).all()
+
+
+def test_v2_parameters_tar_roundtrip(tmp_path):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import unique_name
+    from paddle_tpu.fluid.framework import Program, program_guard
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup), unique_name.guard():
+            x = paddle.layer.data(
+                name="x", type=paddle.layer.data_type.dense_vector(4))
+            pred = paddle.layer.fc_layer(input=x, size=2)
+            params = paddle.create(pred)
+            names = params.names()
+            assert names
+            with open(tmp_path / "p.tar", "wb") as f:
+                params.to_tar(f)
+            old = {n: params.get(n).copy() for n in names}
+            for n in names:
+                params.set(n, np.zeros_like(old[n]))
+            with open(tmp_path / "p.tar", "rb") as f:
+                data = __import__("pickle").load(f)
+            for n in names:
+                params.set(n, data[n])
+                np.testing.assert_array_equal(params.get(n), old[n])
