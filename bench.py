@@ -7,13 +7,19 @@ one TPU chip. Baseline denominator: V100-class fluid-era ResNet-50 throughput
 (~300 imgs/s fp32, bs=32) — the reference tree itself only commits CPU numbers
 (ResNet-50 81.69 imgs/s on Xeon 6148, BASELINE.md), so vs_baseline > 1.0 means
 faster than a V100 would have been.
+
+Robustness: the TPU attach (PJRT plugin over a tunnel) has been observed to
+either fail fast (UNAVAILABLE) or block forever; a blocked init cannot be
+cancelled in-process. So this script is a supervisor: it launches the actual
+benchmark as a child process with a hard timeout, retries TPU attach a few
+times, then falls back to a CPU run (clearly labelled via "backend") so a
+JSON line is ALWAYS emitted with rc=0.
 """
 import json
 import os
+import subprocess
 import sys
 import time
-
-import numpy as np
 
 V100_BASELINE_IMGS_PER_SEC = 300.0
 
@@ -21,9 +27,122 @@ BATCH = int(os.environ.get("BENCH_BATCH", "32"))
 ITERS = int(os.environ.get("BENCH_ITERS", "30"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
 
+# TPU probe: quick device attach + one matmul. Bench child gets a long
+# timeout (first ResNet-50 train-step compile is slow).
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT", "2400"))
+CPU_CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CPU_CHILD_TIMEOUT", "2400"))
 
-def main():
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp; d = jax.devices();"
+    "x = jnp.ones((256, 256)); jax.block_until_ready(x @ x);"
+    "print('PROBE_OK', d[0].platform)"
+)
+
+
+def _scrubbed_cpu_env():
+    """Environment forcing a pure-CPU JAX: the site hook re-registers the
+    tunnel backend and overrides JAX_PLATFORMS, so strip it from
+    PYTHONPATH entirely."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    pp = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in pp.split(os.pathsep) if p and "axon" not in p
+    )
+    return env
+
+
+def _run_child(env, timeout, label):
+    cmd = [sys.executable, os.path.abspath(__file__)]
+    env = dict(env)
+    env["BENCH_CHILD"] = "1"
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, env=env, timeout=timeout, capture_output=True, text=True
+        )
+    except subprocess.TimeoutExpired as e:
+        print(f"# {label} bench child timed out after {timeout}s",
+              file=sys.stderr)
+        for stream in (e.stdout, e.stderr):
+            if stream:
+                if isinstance(stream, bytes):
+                    stream = stream.decode(errors="replace")
+                print(stream[-2000:], file=sys.stderr)
+        return None
+    print(proc.stderr, file=sys.stderr)
+    if proc.returncode != 0:
+        print(f"# {label} bench child rc={proc.returncode} "
+              f"after {time.time() - t0:.0f}s", file=sys.stderr)
+        return None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            return line
+    print(f"# {label} bench child produced no JSON", file=sys.stderr)
+    return None
+
+
+def supervise():
+    tpu_ok = False
+    for i in range(PROBE_RETRIES):
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                timeout=PROBE_TIMEOUT_S, capture_output=True, text=True,
+                env=dict(os.environ),
+            )
+            ok_lines = [ln for ln in p.stdout.splitlines()
+                        if ln.startswith("PROBE_OK")]
+            if p.returncode == 0 and ok_lines:
+                platform = ok_lines[0].split()[1]
+                print(f"# device probe ok: {platform}", file=sys.stderr)
+                tpu_ok = platform != "cpu"
+                break
+            print(f"# probe {i + 1}/{PROBE_RETRIES} rc={p.returncode}: "
+                  f"{p.stderr.strip()[-300:]}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"# probe {i + 1}/{PROBE_RETRIES} timed out "
+                  f"({PROBE_TIMEOUT_S}s) — tunnel blocked", file=sys.stderr)
+        if i < PROBE_RETRIES - 1:
+            time.sleep(10 * (i + 1))
+
+    if tpu_ok:
+        line = _run_child(os.environ, CHILD_TIMEOUT_S, "tpu")
+        if line:
+            print(line)
+            return 0
+        print("# tpu bench failed despite probe ok; falling back to cpu",
+              file=sys.stderr)
+
+    env = _scrubbed_cpu_env()
+    # CPU fallback exists to keep the contract (a JSON line, rc=0), not to
+    # claim a perf result — shrink the workload so it finishes.
+    env.setdefault("BENCH_ITERS", "4")
+    env.setdefault("BENCH_WARMUP", "1")
+    line = _run_child(env, CPU_CHILD_TIMEOUT_S, "cpu")
+    if line:
+        print(line)
+        return 0
+    # Last resort: still emit the contract line so the driver records
+    # evidence of the failure mode instead of rc!=0 with no artifact.
+    print(json.dumps({
+        "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+        "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+        "backend": "none", "error": "tpu attach blocked and cpu run failed",
+    }))
+    return 0
+
+
+def child_main():
+    import numpy as np
     import jax
+
+    backend = jax.default_backend()
+    print(f"# child backend={backend} devices={jax.devices()}",
+          file=sys.stderr)
 
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import layers
@@ -47,7 +166,10 @@ def main():
                 avg_cost
             )
         exe = fluid.Executor()
+        t0 = time.perf_counter()
         exe.run(startup)
+        print(f"# startup ran in {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
 
         # device-resident synthetic batch (the reference benchmark's
         # --use_fake_data mode, resnet.py:44) — measures the training step,
@@ -61,9 +183,14 @@ def main():
         feed = {"img": x, "label": y}
         a_param = main_prog.global_block().all_parameters()[0].name
 
-        for _ in range(WARMUP):
+        t0 = time.perf_counter()
+        for i in range(WARMUP):
             exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
                     return_numpy=False)
+            if i == 0:
+                jax.block_until_ready(scope.find_var(a_param))
+                print(f"# first step (trace+compile) "
+                      f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
         jax.block_until_ready(scope.find_var(a_param))
 
         t0 = time.perf_counter()
@@ -81,9 +208,13 @@ def main():
             "value": round(imgs_per_sec, 2),
             "unit": "images/sec",
             "vs_baseline": round(imgs_per_sec / V100_BASELINE_IMGS_PER_SEC, 3),
+            "backend": backend,
         }))
 
 
 if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    main()
+    if os.environ.get("BENCH_CHILD") == "1":
+        child_main()
+    else:
+        sys.exit(supervise())

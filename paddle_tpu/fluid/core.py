@@ -92,8 +92,62 @@ class TPUPlace(Place):
 CUDAPlace = TPUPlace
 
 
+def init_backend(retries: int = 3, backoff_s: float = 5.0) -> str:
+    """Initialize the accelerator backend with retry/backoff.
+
+    TPU runtime attach (PJRT over a tunnel) can fail transiently with
+    UNAVAILABLE during chip grab/driver init; a blind jax.default_backend()
+    then raises deep inside framework construction. Retry a few times, and
+    on persistent failure fall back to the CPU backend with a clear warning
+    instead of crashing the caller (reference enforce.h turns failures into
+    actionable errors; transient device init is retried by the driver
+    stack there too).
+    """
+    import time
+    import warnings
+
+    import os
+    # jax.config wins over the env var (a forced-cpu process sets it even
+    # when the ambient env still names the accelerator platform)
+    platforms = getattr(jax.config, "jax_platforms", None) or os.environ.get(
+        "JAX_PLATFORMS", "")
+    want_accel = any(
+        p and p != "cpu" for p in str(platforms).split(","))
+    last_err = None
+    for attempt in range(retries):
+        try:
+            backend = jax.default_backend()
+            if backend == "cpu" and want_accel and attempt < retries - 1:
+                # a soft plugin failure can leave a cpu-only backend set
+                # cached; treat it as a failed attempt and re-init
+                last_err = RuntimeError(
+                    "accelerator requested via JAX_PLATFORMS but only cpu "
+                    "initialized")
+                raise last_err
+            return backend
+        except RuntimeError as e:  # "Unable to initialize backend ..."
+            last_err = e
+            # xla_bridge caches partially-built backends (cpu lands before
+            # the TPU plugin raises), so without clearing, the next call
+            # short-circuits to cpu and the TPU is never re-attempted.
+            try:
+                from jax.extend.backend import clear_backends
+                clear_backends()
+            except Exception:
+                pass
+            if attempt < retries - 1:
+                time.sleep(backoff_s * (2 ** attempt))
+    warnings.warn(
+        "accelerator backend init failed after %d attempts (%s); "
+        "falling back to CPU. Set JAX_PLATFORMS=cpu to silence." %
+        (retries, last_err))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    jax.config.update("jax_platforms", "cpu")
+    return jax.default_backend()
+
+
 def default_place() -> Place:
-    backend = jax.default_backend()
+    backend = init_backend()
     if backend == "cpu":
         return CPUPlace()
     return TPUPlace(0)

@@ -59,7 +59,7 @@ def _ln_pallas(x, scale, bias, eps, block_rows, interpret):
         ],
         interpret=interpret,
     )(x, scale.reshape(1, f), bias.reshape(1, f))
-    return y, mean, rstd
+    return y[:n_real], mean[:n_real], rstd[:n_real]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
