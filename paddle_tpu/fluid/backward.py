@@ -185,6 +185,14 @@ def append_backward(
                 }
             },
         )
+        if od.type == "lookup_table" and od.attrs.get("is_sparse"):
+            # grad W is a SelectedRows: mark the var desc for IR-level
+            # parity with the reference's VarTypeInference
+            # (lookup_table_op.cc) — serialization/inspection surface only;
+            # runtime dispatch is by value type (isinstance(SelectedRows))
+            for n in grad_out.get("GRAD@W", []):
+                if n:
+                    block.var(n).desc.type = core.VarType.SELECTED_ROWS.value
         for n, g in new_contribs:
             contributions.setdefault(n, []).append(g)
 
@@ -209,6 +217,9 @@ def append_backward(
                     name=canonical, shape=p.shape, dtype=p.dtype,
                     stop_gradient=True,
                 )
+            # propagate var type (a sparse lookup grad stays SELECTED_ROWS
+            # through the canonicalizing assign)
+            block.var(canonical).desc.type = block.var(g_name).desc.type
             block.append_op(
                 type="assign", inputs={"X": [g_name]}, outputs={"Out": [canonical]},
             )

@@ -224,12 +224,16 @@ class Executor:
             scope.set_var(n, v)
         if FLAGS["check_nan_inf"]:
             # reference FLAGS_check_nan_inf sweep (executor.cc:352-360)
+            from .selected_rows import is_selected_rows
+
             for name, v in list(new_state.items()) + list(zip(fetch_names, fetches)):
-                arr = np.asarray(v)
+                arr = np.asarray(v.value if is_selected_rows(v) else v)
                 if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
                     raise FloatingPointError(f"var '{name}' contains NaN/Inf")
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            from .selected_rows import is_selected_rows
+
+            return [f if is_selected_rows(f) else np.asarray(f) for f in fetches]
         return list(fetches)
 
     def close(self):

@@ -15,7 +15,16 @@ from .common import bcast_y, one
 def _binary(name, fn):
     @register_op(name, ref="paddle/fluid/operators/elementwise_op_function.h")
     def _op(ctx, ins, attrs, _fn=fn):
+        from ..selected_rows import SelectedRows, is_selected_rows
+
         x, y = one(ins, "X"), one(ins, "Y")
+        if (is_selected_rows(x) and jnp.ndim(y) <= 1 and jnp.size(y) == 1
+                and _fn in (jnp.multiply, jnp.divide)):
+            # sparse grad * scalar (global-norm clip's grad*scale): rowwise
+            # is only dense-equivalent for homogeneous ops (f(0)=0, and
+            # duplicate-row sums distribute) — mul/div only
+            return {"Out": SelectedRows(
+                x.rows, _fn(x.value, jnp.reshape(y, ())), x.height)}
         return {"Out": _fn(x, bcast_y(x, y, int(attrs.get("axis", -1))))}
 
     return _op

@@ -50,10 +50,15 @@ def matmul(ctx, ins, attrs):
 
 @register_op("sum", ref="paddle/fluid/operators/sum_op.cc")
 def sum_op(ctx, ins, attrs):
+    """Handles dense + SelectedRows mixing like the reference sum_op
+    (math/selected_rows_functor.cc): all-sparse stays sparse (row concat),
+    mixed densifies via scatter-add."""
+    from ..selected_rows import add_any
+
     xs = many(ins, "X")
     out = xs[0]
     for x in xs[1:]:
-        out = out + x
+        out = add_any(out, x)
     return {"Out": out}
 
 
@@ -75,21 +80,45 @@ def scale(ctx, ins, attrs):
 
 @register_op("clip", ref="paddle/fluid/operators/clip_op.cc")
 def clip(ctx, ins, attrs):
+    from ..selected_rows import SelectedRows, is_selected_rows
+
     x = one(ins, "X")
-    return {"Out": jnp.clip(x, float(attrs["min"]), float(attrs["max"]))}
+    lo, hi = float(attrs["min"]), float(attrs["max"])
+    if is_selected_rows(x):
+        # rowwise clip on the value tensor (reference clip kernel on a
+        # SelectedRows grad). Merge first so duplicate rows clip their SUM;
+        # re-mask after clipping so zero-filled duplicate slots stay zero
+        # even when the clip range excludes 0.
+        rows, merged, mask = x.merged()
+        maskb = mask.reshape((-1,) + (1,) * (merged.ndim - 1))
+        return {"Out": SelectedRows(
+            rows, maskb * jnp.clip(merged, lo, hi), x.height)}
+    return {"Out": jnp.clip(x, lo, hi)}
 
 
 @register_op("clip_by_norm", ref="paddle/fluid/operators/clip_by_norm_op.cc")
 def clip_by_norm(ctx, ins, attrs):
+    from ..selected_rows import SelectedRows, is_selected_rows
+
     x = one(ins, "X")
     max_norm = float(attrs["max_norm"])
+    if is_selected_rows(x):
+        rows, merged, _ = x.merged()  # norm over merged == dense norm
+        norm = jnp.sqrt(jnp.sum(merged * merged))
+        val = jnp.where(norm > max_norm, merged * (max_norm / norm), merged)
+        return {"Out": SelectedRows(rows, val, x.height)}
     norm = jnp.sqrt(jnp.sum(x * x))
     return {"Out": jnp.where(norm > max_norm, x * (max_norm / norm), x)}
 
 
 @register_op("squared_l2_norm", ref="paddle/fluid/operators/squared_l2_norm_op.cc")
 def squared_l2_norm(ctx, ins, attrs):
+    from ..selected_rows import is_selected_rows
+
     x = one(ins, "X")
+    if is_selected_rows(x):
+        _, merged, _ = x.merged()
+        return {"Out": jnp.sum(merged * merged).reshape((1,))}
     return {"Out": jnp.sum(x * x).reshape((1,))}
 
 

@@ -8,13 +8,41 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..registry import register_op
+from ..selected_rows import SelectedRows, is_selected_rows
 from .common import one
+
+
+def _sparse_grad(ins):
+    """Return the SelectedRows grad (merged, duplicate-safe) or None.
+
+    Nonlinear optimizers must merge duplicate rows BEFORE the update
+    (reference MergeAdd precedes every sparse optimizer kernel, e.g.
+    adam_op.h SparseAdamFunctor); the returned mask makes scatter applies of
+    per-row deltas exact when duplicates are present.
+    """
+    g = one(ins, "Grad")
+    if not is_selected_rows(g):
+        return None
+    return g.merged()
+
+
+def _dense_grad(ins):
+    """Optimizers without a dedicated sparse branch densify (correct, loses
+    the memory win — reference falls back the same way for optimizers with no
+    SelectedRows kernel)."""
+    g = one(ins, "Grad")
+    return g.to_dense() if is_selected_rows(g) else g
 
 
 @register_op("sgd", ref="paddle/fluid/operators/sgd_op.cc")
 def sgd(ctx, ins, attrs):
     p, g, lr = one(ins, "Param"), one(ins, "Grad"), one(ins, "LearningRate")
-    return {"ParamOut": p - lr.reshape(()) * g}
+    lr = lr.reshape(())
+    if is_selected_rows(g):
+        # linear in g — scatter-add handles duplicate rows directly
+        # (reference sgd_op.h SelectedRows branch)
+        return {"ParamOut": p.at[g.rows].add(-lr * g.value.astype(p.dtype))}
+    return {"ParamOut": p - lr * g}
 
 
 @register_op("momentum", ref="paddle/fluid/operators/momentum_op.cc")
@@ -23,6 +51,20 @@ def momentum(ctx, ins, attrs):
     lr = one(ins, "LearningRate").reshape(())
     mu = float(attrs.get("mu", 0.9))
     nesterov = bool(attrs.get("use_nesterov", False))
+    sparse = _sparse_grad(ins)
+    if sparse is not None:
+        rows, gm, mask = sparse
+        maskb = mask.reshape((-1,) + (1,) * (gm.ndim - 1))
+        v_rows, p_rows = v[rows], p[rows]
+        v_new_rows = mu * v_rows + gm
+        if nesterov:
+            p_new_rows = p_rows - (gm + mu * v_new_rows) * lr
+        else:
+            p_new_rows = p_rows - lr * v_new_rows
+        return {
+            "ParamOut": p.at[rows].add(maskb * (p_new_rows - p_rows)),
+            "VelocityOut": v.at[rows].add(maskb * (v_new_rows - v_rows)),
+        }
     v_new = mu * v + g
     if nesterov:
         p_new = p - (g + mu * v_new) * lr
@@ -40,9 +82,25 @@ def adam(ctx, ins, attrs):
     b1 = float(attrs.get("beta1", 0.9))
     b2 = float(attrs.get("beta2", 0.999))
     eps = float(attrs.get("epsilon", 1e-8))
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    sparse = _sparse_grad(ins)
+    if sparse is not None:
+        # lazy-mode sparse adam (reference SparseAdamFunctor, adam_op.h):
+        # moments/param move only on the batch's rows
+        rows, gm, mask = sparse
+        maskb = mask.reshape((-1,) + (1,) * (gm.ndim - 1))
+        m1r, m2r, pr = m1[rows], m2[rows], p[rows]
+        m1n = b1 * m1r + (1 - b1) * gm
+        m2n = b2 * m2r + (1 - b2) * gm * gm
+        pn = pr - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+        return {
+            "ParamOut": p.at[rows].add(maskb * (pn - pr)),
+            "Moment1Out": m1.at[rows].add(maskb * (m1n - m1r)),
+            "Moment2Out": m2.at[rows].add(maskb * (m2n - m2r)),
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2,
+        }
     m1n = b1 * m1 + (1 - b1) * g
     m2n = b2 * m2 + (1 - b2) * g * g
-    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
     pn = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
     return {
         "ParamOut": pn, "Moment1Out": m1n, "Moment2Out": m2n,
@@ -55,13 +113,24 @@ def adagrad(ctx, ins, attrs):
     p, g, m = one(ins, "Param"), one(ins, "Grad"), one(ins, "Moment")
     lr = one(ins, "LearningRate").reshape(())
     eps = float(attrs.get("epsilon", 1e-6))
+    sparse = _sparse_grad(ins)
+    if sparse is not None:
+        rows, gm, mask = sparse
+        maskb = mask.reshape((-1,) + (1,) * (gm.ndim - 1))
+        mr, pr = m[rows], p[rows]
+        mn = mr + gm * gm
+        pn = pr - lr * gm / (jnp.sqrt(mn) + eps)
+        return {
+            "ParamOut": p.at[rows].add(maskb * (pn - pr)),
+            "MomentOut": m.at[rows].add(maskb * (mn - mr)),
+        }
     mn = m + g * g
     return {"ParamOut": p - lr * g / (jnp.sqrt(mn) + eps), "MomentOut": mn}
 
 
 @register_op("decayed_adagrad", ref="paddle/fluid/operators/decayed_adagrad_op.cc")
 def decayed_adagrad(ctx, ins, attrs):
-    p, g, m = one(ins, "Param"), one(ins, "Grad"), one(ins, "Moment")
+    p, g, m = one(ins, "Param"), _dense_grad(ins), one(ins, "Moment")
     lr = one(ins, "LearningRate").reshape(())
     decay = float(attrs.get("decay", 0.95))
     eps = float(attrs.get("epsilon", 1e-6))
@@ -71,7 +140,7 @@ def decayed_adagrad(ctx, ins, attrs):
 
 @register_op("adadelta", ref="paddle/fluid/operators/adadelta_op.cc")
 def adadelta(ctx, ins, attrs):
-    p, g = one(ins, "Param"), one(ins, "Grad")
+    p, g = one(ins, "Param"), _dense_grad(ins)
     avg_sq_g = one(ins, "AvgSquaredGrad")
     avg_sq_u = one(ins, "AvgSquaredUpdate")
     rho = float(attrs.get("rho", 0.95))
@@ -88,7 +157,7 @@ def adadelta(ctx, ins, attrs):
 
 @register_op("adamax", ref="paddle/fluid/operators/adamax_op.cc")
 def adamax(ctx, ins, attrs):
-    p, g = one(ins, "Param"), one(ins, "Grad")
+    p, g = one(ins, "Param"), _dense_grad(ins)
     m, inf = one(ins, "Moment"), one(ins, "InfNorm")
     b1p = one(ins, "Beta1Pow").reshape(())
     lr = one(ins, "LearningRate").reshape(())
@@ -103,7 +172,7 @@ def adamax(ctx, ins, attrs):
 
 @register_op("rmsprop", ref="paddle/fluid/operators/rmsprop_op.cc")
 def rmsprop(ctx, ins, attrs):
-    p, g = one(ins, "Param"), one(ins, "Grad")
+    p, g = one(ins, "Param"), _dense_grad(ins)
     ms, mom = one(ins, "MeanSquare"), one(ins, "Moment")
     lr = one(ins, "LearningRate").reshape(())
     decay = float(attrs.get("decay", 0.9))
@@ -116,7 +185,7 @@ def rmsprop(ctx, ins, attrs):
 
 @register_op("ftrl", ref="paddle/fluid/operators/ftrl_op.cc")
 def ftrl(ctx, ins, attrs):
-    p, g = one(ins, "Param"), one(ins, "Grad")
+    p, g = one(ins, "Param"), _dense_grad(ins)
     sq, lin = one(ins, "SquaredAccumulator"), one(ins, "LinearAccumulator")
     lr = one(ins, "LearningRate").reshape(())
     l1 = float(attrs.get("l1", 0.0))
@@ -139,7 +208,7 @@ def ftrl(ctx, ins, attrs):
 
 @register_op("proximal_gd", ref="paddle/fluid/operators/proximal_gd_op.cc")
 def proximal_gd(ctx, ins, attrs):
-    p, g = one(ins, "Param"), one(ins, "Grad")
+    p, g = one(ins, "Param"), _dense_grad(ins)
     lr = one(ins, "LearningRate").reshape(())
     l1 = float(attrs.get("l1", 0.0))
     l2 = float(attrs.get("l2", 0.0))
@@ -150,7 +219,7 @@ def proximal_gd(ctx, ins, attrs):
 
 @register_op("proximal_adagrad", ref="paddle/fluid/operators/proximal_adagrad_op.cc")
 def proximal_adagrad(ctx, ins, attrs):
-    p, g, m = one(ins, "Param"), one(ins, "Grad"), one(ins, "Moment")
+    p, g, m = one(ins, "Param"), _dense_grad(ins), one(ins, "Moment")
     lr = one(ins, "LearningRate").reshape(())
     l1 = float(attrs.get("l1", 0.0))
     l2 = float(attrs.get("l2", 0.0))

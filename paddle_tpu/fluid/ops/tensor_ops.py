@@ -96,7 +96,33 @@ def increment(ctx, ins, attrs):
     return {"Out": x + jnp.asarray(attrs.get("step", 1.0)).astype(x.dtype)}
 
 
-@register_op("lookup_table", no_grad=("Ids",),
+def _lookup_table_grad(ctx, fwd_ins, fwd_outs, out_grads, attrs):
+    """Sparse path (attrs is_sparse): grad W is a SelectedRows of the batch's
+    rows — never materializes the dense [V, D] gradient (reference
+    lookup_table_grad SelectedRows kernel, lookup_table_op.cc; sparse apply
+    happens in the optimizer ops). Dense path mirrors jnp.take's vjp."""
+    from ..selected_rows import SelectedRows
+
+    w, ids = fwd_ins["W"][0], fwd_ins["Ids"][0]
+    dy = out_grads["Out"][0]
+    if dy is None:
+        return {}
+    padding_idx = int(attrs.get("padding_idx", -1))
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, -1)
+    flat_ids = ids.reshape(-1)
+    flat_dy = dy.reshape((flat_ids.shape[0],) + w.shape[1:]).astype(w.dtype)
+    if padding_idx != -1:
+        flat_dy = jnp.where((flat_ids == padding_idx)[..., None], 0, flat_dy)
+        # scatter target row for masked entries is irrelevant (value 0)
+    if bool(attrs.get("is_sparse", False)):
+        dw = SelectedRows(flat_ids.astype(jnp.int32), flat_dy, w.shape[0])
+    else:
+        dw = jnp.zeros_like(w).at[flat_ids].add(flat_dy)
+    return {"GRAD@W": dw, "GRAD@Ids": None}
+
+
+@register_op("lookup_table", no_grad=("Ids",), grad=_lookup_table_grad,
              ref="paddle/fluid/operators/lookup_table_op.cc")
 def lookup_table(ctx, ins, attrs):
     w, ids = one(ins, "W"), one(ins, "Ids")

@@ -173,7 +173,10 @@ class ParallelExecutor:
         for n, v in new_state.items():
             self._scope.set_var(n, v)
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            from .selected_rows import is_selected_rows
+
+            return [f if is_selected_rows(f) else np.asarray(f)
+                    for f in fetches]
         return list(fetches)
 
     def bcast_params(self):

@@ -1,0 +1,105 @@
+"""SelectedRows — sparse row-gradient representation, TPU-native.
+
+Capability-parity with the reference's `paddle/fluid/framework/selected_rows.h`
+(row-index list + value tensor) and the sparse functor library
+(`paddle/fluid/operators/math/selected_rows_functor.cc`), re-expressed as a
+JAX pytree with STATIC shapes so it can flow through jit/vjp/SPMD:
+
+  - `rows` is int32 [N] (N = number of lookups in the batch, duplicates
+    allowed — the reference's un-merged SelectedRows), `value` is [N, ...].
+  - `height` (the dense dim-0 extent, i.e. vocab size) is static aux data.
+  - Optimizers apply updates row-wise without ever materializing the dense
+    [height, ...] gradient (reference sparse sgd/adam kernels,
+    `operators/sgd_op.h`, `operators/adam_op.h` SparseAdamFunctor).
+  - Duplicate-row merging (reference `MergeAdd`) keeps static shape: rows are
+    sorted, each unique row's sum lands at its first occurrence, and a 0/1
+    mask marks the merged entries; scatter applies of masked deltas are then
+    duplicate-safe.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """rows: int [N]; value: [N, d1, ...]; height: static int (dense dim 0)."""
+
+    def __init__(self, rows, value, height: int):
+        self.rows = rows
+        self.value = value
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.rows, self.value), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, value = children
+        return cls(rows, value, height)
+
+    @property
+    def dense_shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.value.astype(dtype), self.height)
+
+    def to_dense(self):
+        """Scatter-add into the dense shape (reference
+        SelectedRows::Get / sum_op's selected-rows branch). O(height) memory —
+        only for fallback paths and tests."""
+        z = jnp.zeros(self.dense_shape, self.value.dtype)
+        return z.at[self.rows].add(self.value)
+
+    def merged(self):
+        """Duplicate-row merge with static shapes (reference MergeAdd,
+        selected_rows_functor.cc).
+
+        Returns (rows_sorted, merged_value, first_mask):
+          - rows_sorted: rows ascending, [N]
+          - merged_value[i] = sum of value over all duplicates of
+            rows_sorted[i] if i is the first occurrence, else 0
+          - first_mask: float 0/1 [N], 1 at first occurrences
+
+        A scatter of `first_mask * delta` at `rows_sorted` is then exact and
+        duplicate-safe (the 0-masked entries contribute nothing).
+        """
+        rows = self.rows.reshape(-1)
+        n = rows.shape[0]
+        order = jnp.argsort(rows)
+        r_s = rows[order]
+        v_s = self.value[order]
+        first = jnp.concatenate(
+            [jnp.ones((1,), bool), r_s[1:] != r_s[:-1]])
+        seg = jnp.cumsum(first) - 1  # unique-row segment id per element
+        summed = jax.ops.segment_sum(v_s, seg, num_segments=n)
+        bshape = (n,) + (1,) * (self.value.ndim - 1)
+        merged = jnp.where(first.reshape(bshape), summed[seg], 0)
+        return r_s, merged, first.astype(self.value.dtype)
+
+
+def is_selected_rows(x) -> bool:
+    return isinstance(x, SelectedRows)
+
+
+def add_any(a, b):
+    """dense+dense, sparse+sparse (concat — stays sparse, reference sum_op
+    keeps SelectedRows when all inputs are), or mixed (densifies)."""
+    if is_selected_rows(a) and is_selected_rows(b):
+        assert a.height == b.height, (a.height, b.height)
+        return SelectedRows(
+            jnp.concatenate([a.rows.reshape(-1), b.rows.reshape(-1)]),
+            jnp.concatenate([a.value, b.value]),
+            a.height,
+        )
+    if is_selected_rows(a):
+        return b.at[a.rows].add(a.value)
+    if is_selected_rows(b):
+        return a.at[b.rows].add(b.value)
+    return a + b
