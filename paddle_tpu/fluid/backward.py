@@ -20,14 +20,15 @@ from . import core, unique_name
 from .framework import Parameter, Program, Variable, grad_var_name
 from .registry import FWD_META_ATTR, OPS
 
-# op types that never participate in differentiation. `while` is forward-only
-# under XLA (no reverse-mode through lax.while_loop); `recurrent` (StaticRNN)
-# IS differentiable and is absent from this set.
+# op types that never participate in differentiation. Control flow IS
+# differentiable here: `recurrent`/`dynamic_recurrent` (scan), `ifelse`/
+# `conditional_block` (lax.cond), and `while` WITH max_steps (bounded scan);
+# a while without max_steps on the loss path is a hard error (see below) —
+# never a silently-missing gradient term.
 _NON_DIFF_OPS = {
     "feed", "fetch", "fill_constant", "gaussian_random", "uniform_random",
     "sgd", "momentum", "adam", "adamax", "adagrad", "adadelta", "rmsprop",
     "decayed_adagrad", "ftrl", "increment", "assign_value",
-    "while", "conditional_block",
 }
 
 _FLOAT_DTYPES = {"float16", "bfloat16", "float32", "float64"}
@@ -102,6 +103,43 @@ def append_backward(
     program: Program = block.program
     no_grad = set(no_grad_set or ())
 
+    # --- snapshot inputs of in-place ops --------------------------------
+    # An op that writes one of its own inputs (While's loop carry, assign /
+    # increment chains) leaves only the POST-op value under that name at
+    # runtime, but its grad op must replay the forward from the PRE-op
+    # value (the reference keeps per-step scopes for this, while_op.cc
+    # StepScopes). Insert `assign` snapshots before such ops and point the
+    # grad op's forward-input references at the snapshots.
+    snap_by_op: Dict[int, Dict[str, str]] = {}
+    idx = 0
+    while idx < len(block.ops):
+        od = block.ops[idx].desc
+        if od.type.endswith("_grad") or od.type in _NON_DIFF_OPS:
+            idx += 1
+            continue
+        colliding = sorted(
+            set(n for n in od.input_names() if n)
+            & set(n for n in od.output_names() if n)
+        )
+        snaps: Dict[str, str] = {}
+        for n in colliding:
+            src = block._var_recursive(n)
+            sv = block.create_var(
+                name=unique_name.generate(n + "@PRE"),
+                shape=src.shape if src is not None else None,
+                dtype=src.dtype if src is not None else "float32",
+                stop_gradient=True,
+            )
+            block.insert_op(
+                idx, type="assign", inputs={"X": [n]},
+                outputs={"Out": [sv.name]},
+            )
+            idx += 1
+            snaps[n] = sv.name
+        if snaps:
+            snap_by_op[id(od)] = snaps
+        idx += 1
+
     fwd_ops = list(block.ops)
     need_grad = _forward_need_grad_vars(block, fwd_ops, no_grad)
 
@@ -134,6 +172,14 @@ def append_backward(
         ]
         if not out_has_grad or not diff_inputs:
             continue
+        if od.type == "while" and not od.attrs.get("max_steps"):
+            raise RuntimeError(
+                "gradient requested through a While loop built without "
+                "max_steps — an unbounded lax.while_loop has no reverse-mode. "
+                "Construct it as While(cond, max_steps=K) (K = trip-count "
+                "bound) to lower it as a differentiable bounded scan "
+                "(the reference's while grad, while_op.cc:96)."
+            )
 
         # materialize output grads
         grad_in: Dict[str, List[str]] = {}
@@ -167,7 +213,12 @@ def append_backward(
         if not any(n for lst in grad_out.values() for n in lst):
             continue
 
-        grad_ins: Dict[str, List[str]] = {s: list(ns) for s, ns in od.inputs.items()}
+        # forward-input references go through the pre-op snapshots for
+        # in-place ops; grad contributions still flow to the ORIGINAL names
+        snaps = snap_by_op.get(id(od), {})
+        grad_ins: Dict[str, List[str]] = {
+            s: [snaps.get(n, n) for n in ns] for s, ns in od.inputs.items()
+        }
         for slot, names in od.outputs.items():
             grad_ins["Out@" + slot] = list(names)
         grad_ins.update(grad_in)
@@ -185,6 +236,13 @@ def append_backward(
                 }
             },
         )
+        # this op is the producer of its outputs: their gradients are now
+        # consumed — clear them so ops earlier in the program don't
+        # double-count (matters when a name is rewritten in place)
+        for names in od.outputs.values():
+            for n in names:
+                if n:
+                    contributions.pop(n, None)
         if od.type == "lookup_table" and od.attrs.get("is_sparse"):
             # grad W is a SelectedRows: mark the var desc for IR-level
             # parity with the reference's VarTypeInference

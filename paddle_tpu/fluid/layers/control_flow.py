@@ -15,9 +15,9 @@ from ..framework import Variable
 from ..layer_helper import LayerHelper
 
 __all__ = [
-    "While", "StaticRNN", "ConditionalBlock", "Switch", "increment",
-    "array_write", "array_read", "array_length", "create_array",
-    "less_than", "equal", "zeros_like_array",
+    "While", "StaticRNN", "DynamicRNN", "IfElse", "ConditionalBlock",
+    "Switch", "increment", "array_write", "array_read", "array_length",
+    "create_array", "less_than", "equal", "zeros_like_array",
 ]
 
 
@@ -121,16 +121,22 @@ def _scan_block_io(sub, parent_block):
 class While:
     """reference control_flow.py:608. Usage:
         cond = layers.less_than(i, n)
-        w = While(cond)
+        w = While(cond)                    # forward-only (lax.while_loop)
+        w = While(cond, max_steps=K)       # differentiable (bounded scan)
         with w.block():
             ...ops...  (must update `cond` for termination)
-    Forward-only under XLA (see ops/control_flow.py docstring)."""
 
-    def __init__(self, cond, name=None):
+    With `max_steps` the loop lowers to a K-step scan with freeze-after-exit
+    masking and supports append_backward (the reference's while grad,
+    while_op.cc:96); without it, requesting a gradient through the loop is a
+    hard error."""
+
+    def __init__(self, cond, name=None, max_steps=None):
         self.helper = LayerHelper("while", name=name)
         if cond.dtype != "bool":
             raise TypeError("condition should be a bool variable")
         self.cond_var = cond
+        self.max_steps = int(max_steps) if max_steps else 0
 
     @contextlib.contextmanager
     def block(self):
@@ -155,6 +161,7 @@ class While:
                     "x_var_names": touched,
                     "cond_var_name": self.cond_var.name,
                     "out_var_names": carried,
+                    "max_steps": self.max_steps,
                 },
             )
 
@@ -243,6 +250,251 @@ class Switch:
 
     def __exit__(self, *exc):
         return False
+
+
+class IfElse:
+    """Per-example branch (reference control_flow.py:1252).
+
+        ie = IfElse(cond)            # cond: bool [N, 1]
+        with ie.true_block():
+            d = ie.input(x)
+            ie.output(layers.fc(d, ...))
+        with ie.false_block():
+            d = ie.input(x)
+            ie.output(layers.scale(d, scale=2.0))
+        out, = ie()                  # rows merged by cond
+
+    The reference splits rows into subsets per branch (split_lod_tensor /
+    merge_lod_tensor); here both branches compute on the full batch and rows
+    are merged with where(cond) — see ops/control_flow.py:ifelse. Both
+    branches must output() the same number of (shape-compatible) vars.
+    """
+
+    OUT_IF_ELSE_BLOCKS = True
+
+    def __init__(self, cond, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self._blocks = {}     # 'true'/'false' -> (sub_block, out_names)
+        self._cur = None
+        self._outputs = {"true": [], "false": []}
+
+    @contextlib.contextmanager
+    def _branch(self, which):
+        main = self.helper.main_program
+        self._parent = main.current_block()
+        sub = main.create_block()
+        self._cur = which
+        try:
+            yield
+        finally:
+            main.rollback()
+            self._blocks[which] = sub
+            self._cur = None
+
+    def true_block(self):
+        return self._branch("true")
+
+    def false_block(self):
+        return self._branch("false")
+
+    def input(self, x):
+        """The reference returns the branch's row subset; here the branch
+        computes on all rows and the merge masks — so input() is identity."""
+        if self._cur is None:
+            raise RuntimeError("IfElse.input() must be called inside a block")
+        return x
+
+    def output(self, *outs):
+        if self._cur is None:
+            raise RuntimeError("IfElse.output() must be called inside a block")
+        self._outputs[self._cur].extend(outs)
+
+    def __call__(self):
+        t, f = self._outputs["true"], self._outputs["false"]
+        if "true" not in self._blocks or "false" not in self._blocks:
+            raise RuntimeError("IfElse needs both true_block and false_block")
+        if len(t) != len(f):
+            raise ValueError(
+                f"IfElse branches output different counts: {len(t)} vs {len(f)}")
+        parent = self._parent
+        touched = set()
+        for sub in self._blocks.values():
+            tr, _ = _scan_block_io(sub, parent)
+            touched.update(tr)
+        touched.discard(self.cond.name)
+        touched = sorted(touched)
+        out_vars = [
+            parent.create_var(
+                name=self.helper.name + f".out{i}", dtype=tv.dtype,
+                shape=list(tv.shape) if tv.shape else None,
+            )
+            for i, tv in enumerate(t)
+        ]
+        parent.append_op(
+            type="ifelse",
+            inputs={"Cond": [self.cond], "X": touched},
+            outputs={"Out": out_vars},
+            attrs={
+                "true_block": self._blocks["true"].idx,
+                "false_block": self._blocks["false"].idx,
+                "x_var_names": touched,
+                "true_out_names": [v.name for v in t],
+                "false_out_names": [v.name for v in f],
+            },
+        )
+        return out_vars
+
+
+class DynamicRNN:
+    """Variable-length RNN over padded sequences (reference
+    control_flow.py:1354), trainable.
+
+        drnn = DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x)       # x: [N, T, D] (+@LEN lengths)
+            h_prev = drnn.memory(shape=[H], value=0.0)
+            ctx_s = drnn.static_input(enc)  # per-example non-sequence input
+            h = layers.fc(input=[x_t, h_prev], size=H, act='tanh')
+            drnn.update_memory(h_prev, h)
+            drnn.output(h)
+        out = drnn()                        # [N, T, H], lengths propagated
+
+    The reference shrinks the running batch as short sequences finish
+    (lod_rank_table/shrink_rnn_memory); the TPU lowering scans the static
+    [N, T] extent with per-example masking (ops/control_flow.py:
+    dynamic_recurrent) — memories freeze and outputs are zero past each
+    sequence's length, so sequence_last_step() picks the true final state.
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self._sub = None
+        self._parent = None
+        self.step_inputs = []    # (full_var, step_var)
+        self.static_inputs = []  # (outer_var, step_var)
+        self.memories = []       # [pre_var, updated_var|None, init_var]
+        self.outputs = []
+        self._lengths = None
+        self._out_vars = None
+
+    @contextlib.contextmanager
+    def block(self):
+        main = self.helper.main_program
+        self._parent = main.current_block()
+        self._sub = main.create_block()
+        try:
+            yield
+        finally:
+            main.rollback()
+            self._complete()
+
+    def step_input(self, x):
+        from .sequence import seq_lengths_of
+
+        if self._lengths is None:
+            self._lengths = seq_lengths_of(x)
+        sv = self._sub.create_var(
+            name=x.name + "@dstep", dtype=x.dtype,
+            shape=[x.shape[0]] + list(x.shape[2:]) if x.shape else None,
+        )
+        self.step_inputs.append((x, sv))
+        return sv
+
+    def static_input(self, x):
+        sv = self._sub.create_var(
+            name=x.name + "@dstatic", dtype=x.dtype,
+            shape=list(x.shape) if x.shape else None,
+        )
+        self.static_inputs.append((x, sv))
+        return sv
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32"):
+        if init is None:
+            if not self.step_inputs:
+                raise ValueError(
+                    "DynamicRNN.memory(shape=...) needs a prior step_input "
+                    "(batch reference)")
+            from .tensor import fill_constant_batch_size_like
+
+            # created in the parent block, batch-matched to the sequence input
+            main = self.helper.main_program
+            main.current_block_idx = self._parent.idx
+            try:
+                init = fill_constant_batch_size_like(
+                    input=self.step_inputs[0][0], shape=[-1] + list(shape),
+                    dtype=dtype, value=value)
+            finally:
+                main.current_block_idx = self._sub.idx
+        pre = self._sub.create_var(
+            name=init.name + "@dpre_mem", dtype=init.dtype,
+            shape=list(init.shape) if init.shape else None,
+        )
+        self.memories.append([pre, None, init])
+        return pre
+
+    def update_memory(self, mem, var):
+        for m in self.memories:
+            if m[0] is mem:
+                m[1] = var
+                return
+        raise ValueError("update_memory: unknown memory var")
+
+    def output(self, *outputs):
+        self.outputs.extend(outputs)
+
+    def _complete(self):
+        if not self.step_inputs:
+            raise ValueError("DynamicRNN needs at least one step_input")
+        assert all(m[1] is not None for m in self.memories), (
+            "every memory needs update_memory()")
+        step_locals = {sv.name for _, sv in self.step_inputs}
+        step_locals.update(sv.name for _, sv in self.static_inputs)
+        step_locals.update(m[0].name for m in self.memories)
+        read = set()
+        for op in self._sub.ops:
+            read.update(n for n in op.desc.input_names() if n)
+        params = sorted(
+            n for n in read
+            if n not in step_locals
+            and n not in self._sub.vars
+            and self._parent._var_recursive(n) is not None
+        )
+        self._out_vars = []
+        for o in self.outputs:
+            ov = self._parent.create_var(
+                name=o.name + "@dseq", dtype=o.dtype,
+                shape=[o.shape[0], -1] + list(o.shape[1:]) if o.shape else None,
+            )
+            if self._lengths is not None:
+                ov._seq_lengths = self._lengths
+            self._out_vars.append(ov)
+        inputs = {
+            "StepInputs": [x for x, _ in self.step_inputs],
+            "MemInit": [m[2] for m in self.memories],
+            "StaticInputs": [x for x, _ in self.static_inputs],
+            "Params": params,
+        }
+        if self._lengths is not None:
+            inputs["Lengths"] = [self._lengths]
+        self._parent.append_op(
+            type="dynamic_recurrent",
+            inputs=inputs,
+            outputs={"Out": self._out_vars},
+            attrs={
+                "sub_block": self._sub.idx,
+                "step_input_vars": [sv.name for _, sv in self.step_inputs],
+                "static_input_vars": [sv.name for _, sv in self.static_inputs],
+                "memory_links": [[m[0].name, m[1].name] for m in self.memories],
+                "step_output_vars": [o.name for o in self.outputs],
+                "param_var_names": params,
+            },
+        )
+
+    def __call__(self):
+        if len(self._out_vars) == 1:
+            return self._out_vars[0]
+        return self._out_vars
 
 
 class StaticRNN:

@@ -79,6 +79,11 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     padding_idx = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx
     )
+    if input.shape is not None:
+        s = list(input.shape)
+        if s and s[-1] == 1:
+            s = s[:-1]  # the op squeezes the trailing ids dim
+        tmp.desc.shape = s + [size[1]]
     helper.append_op(
         type="lookup_table",
         inputs={"W": [w], "Ids": [input]},
@@ -444,6 +449,14 @@ def reshape(x, shape, actual_shape=None, act=None, inplace=True, name=None):
     """reference layers/nn.py:3354."""
     helper = LayerHelper("reshape", act=act, name=name)
     out = helper.create_variable_for_type_inference(x.dtype)
+    out_shape = list(shape)
+    if x.shape is not None:
+        # 0 = copy this dim from input (reference reshape semantics)
+        out_shape = [
+            x.shape[i] if s == 0 and i < len(x.shape) else s
+            for i, s in enumerate(out_shape)
+        ]
+    out.desc.shape = out_shape
     helper.append_op(
         type="reshape", inputs={"X": [x]}, outputs={"Out": [out]},
         attrs={"shape": list(shape)},
@@ -564,6 +577,11 @@ def _elementwise_layer(op_type):
     def layer(x, y, axis=-1, act=None, name=None):
         helper = LayerHelper(op_type, act=act, name=name)
         out = helper.create_variable_for_type_inference(x.dtype)
+        if x.shape is not None:
+            # broadcast keeps x's shape (y broadcasts onto x in the
+            # reference's axis semantics) — lets downstream layers (fc)
+            # see dims at build time
+            out.desc.shape = list(x.shape)
         helper.append_op(
             type=op_type, inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
             attrs={"axis": axis},

@@ -9,7 +9,7 @@ __act_ops__ = [
     "sqrt", "abs", "ceil", "floor", "round", "reciprocal", "log", "square",
     "softplus", "softsign", "brelu", "leaky_relu", "soft_relu", "elu", "relu6",
     "pow", "stanh", "hard_sigmoid", "swish", "thresholded_relu", "hard_shrink",
-    "gelu", "cumsum", "sign",
+    "gelu", "cumsum", "sign", "log_softmax",
 ]
 
 __all__ = list(__act_ops__)

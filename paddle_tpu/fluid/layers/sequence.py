@@ -20,6 +20,8 @@ __all__ = [
     "dynamic_lstm", "dynamic_gru", "sequence_pool", "sequence_conv",
     "sequence_expand", "sequence_first_step", "sequence_last_step",
     "sequence_softmax", "sequence_reshape", "sequence_concat", "seq_lengths_of",
+    "gru_unit", "sequence_mask", "batch_gather", "beam_search",
+    "beam_search_decode",
 ]
 
 LEN_SUFFIX = "@LEN"
@@ -228,3 +230,103 @@ def sequence_concat(input, name=None):
         type="sequence_concat", inputs=inputs, outputs={"Out": [out]},
     )
     return out
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid", dtype="float32"):
+    """One GRU cell step (reference gru_unit_op.cc): input [N, 3H] (the
+    x-projection), hidden [N, H] -> new hidden [N, H]. Returns
+    (hidden, reset_hidden_prev, gate)."""
+    acts = {"identity": 0, "sigmoid": 1, "tanh": 2, "relu": 3}
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    H = size // 3
+    weight = helper.create_parameter(helper.param_attr, shape=[H, 3 * H],
+                                     dtype=dtype)
+    bias = helper.create_parameter(helper.bias_attr, shape=[1, 3 * H],
+                                   dtype=dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset = helper.create_variable_for_type_inference(dtype)
+    if hidden.shape is not None:
+        out.desc.shape = list(hidden.shape)
+    helper.append_op(
+        type="gru_unit",
+        inputs={"Input": [input], "HiddenPrev": [hidden], "Weight": [weight],
+                "Bias": [bias]},
+        outputs={"Hidden": [out], "Gate": [gate], "ResetHiddenPrev": [reset]},
+        attrs={"activation": acts[activation],
+               "gate_activation": acts[gate_activation]},
+    )
+    return out, reset, gate
+
+
+def sequence_mask(x, maxlen=None, maxlen_ref=None, dtype="int64"):
+    """mask[i, t] = t < x[i] (reference-era sequence padding mask). Provide
+    `maxlen` (static) or `maxlen_ref` (a padded [N, T, ...] var whose traced
+    time extent supplies it)."""
+    helper = LayerHelper("sequence_mask")
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [x]}
+    if maxlen_ref is not None:
+        inputs["MaxLenRef"] = [maxlen_ref]
+    helper.append_op(
+        type="sequence_mask", inputs=inputs, outputs={"Y": [out]},
+        attrs={"maxlen": -1 if maxlen is None else int(maxlen),
+               "out_dtype": dtype},
+    )
+    return out
+
+
+def batch_gather(x, index):
+    """x [B, K, ...], index [B, K'] -> [B, K', ...]: per-batch gather on
+    axis 1 (beam-search parent selection)."""
+    helper = LayerHelper("batch_gather")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if x.shape is not None and index.shape is not None:
+        out.desc.shape = list(index.shape[:2]) + list(x.shape[2:])
+    helper.append_op(
+        type="batch_gather", inputs={"X": [x], "Index": [index]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, ids=None,
+                level=0):
+    """One beam expansion step over fixed [B, beam] state (reference
+    beam_search_op.cc keeps beams as LoD levels and shrinks finished ones;
+    here finished beams are frozen — see ops/beam_search_ops.py). `scores`
+    are this step's log-probs [B, beam, V]."""
+    helper = LayerHelper("beam_search")
+    sel_ids = helper.create_variable_for_type_inference("int64")
+    sel_scores = helper.create_variable_for_type_inference(scores.dtype)
+    parent = helper.create_variable_for_type_inference("int32")
+    inputs = {"PreIds": [pre_ids], "PreScores": [pre_scores],
+              "Scores": [scores]}
+    if ids is not None:
+        inputs["Ids"] = [ids]
+    helper.append_op(
+        type="beam_search", inputs=inputs,
+        outputs={"SelectedIds": [sel_ids], "SelectedScores": [sel_scores],
+                 "ParentIdx": [parent]},
+        attrs={"beam_size": int(beam_size), "end_id": int(end_id),
+               "level": int(level)},
+    )
+    return sel_ids, sel_scores, parent
+
+
+def beam_search_decode(ids, scores, parents, beam_size=None, end_id=0):
+    """Backtrack stacked per-step beam selections ([T, B, beam] each) into
+    sentences [B, beam, T] + final scores [B, beam] (reference
+    beam_search_decode_op.cc)."""
+    helper = LayerHelper("beam_search_decode")
+    sent_ids = helper.create_variable_for_type_inference("int64")
+    sent_scores = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(
+        type="beam_search_decode",
+        inputs={"Ids": [ids], "Scores": [scores], "Parents": [parents]},
+        outputs={"SentenceIds": [sent_ids], "SentenceScores": [sent_scores]},
+        attrs={"end_id": int(end_id)},
+    )
+    return sent_ids, sent_scores

@@ -30,6 +30,8 @@ _unary("floor", lambda x, a: jnp.floor(x))
 _unary("round", lambda x, a: jnp.round(x))
 _unary("reciprocal", lambda x, a: 1.0 / x)
 _unary("log", lambda x, a: jnp.log(x))
+_unary("log_softmax",
+       lambda x, a: jax.nn.log_softmax(x, axis=int(a.get("axis", -1))))
 _unary("square", lambda x, a: jnp.square(x))
 _unary("softplus", lambda x, a: jax.nn.softplus(x))
 _unary("softsign", lambda x, a: jax.nn.soft_sign(x))

@@ -43,13 +43,26 @@ def _written(op_descs):
     return out
 
 
-@register_op("while", no_grad=("Condition", "X"),
+@register_op("while", no_grad=("Condition",),
              ref="paddle/fluid/operators/while_op.cc:35")
 def while_op(ctx, ins, attrs):
+    """Two lowerings:
+
+    - no `max_steps`: lax.while_loop — unbounded trip count, forward-only
+      (XLA while has no reverse-mode; backward.py hard-errors if a gradient
+      is requested through it).
+    - `max_steps=K`: lax.scan over K steps with freeze-after-exit masking —
+      DIFFERENTIABLE (the TPU answer to the reference's while grad,
+      while_op.cc:96, which re-runs the block per step with saved scopes;
+      here scan's reverse-mode provides exactly that). Iterations past the
+      loop's natural exit are no-ops; a loop still live after K steps is
+      truncated (caller picks K as the known trip bound).
+    """
     ops = _sub_op_descs(ctx, attrs)
     x_names = list(attrs["x_var_names"])
     cond_name = str(attrs["cond_var_name"])
     out_names = list(attrs["out_var_names"])
+    max_steps = int(attrs.get("max_steps", 0) or 0)
 
     env = dict(zip(x_names, ins.get("X", [])))
     env[cond_name] = one(ins, "Condition")
@@ -59,9 +72,6 @@ def while_op(ctx, ins, attrs):
         carry_names.append(cond_name)
     base_env = {k: v for k, v in env.items() if k not in carry_names}
 
-    def cond_fn(carry):
-        return jnp.reshape(carry[cond_name], ()).astype(bool)
-
     def body_fn(carry):
         local = dict(base_env)
         local.update(carry)
@@ -69,7 +79,22 @@ def while_op(ctx, ins, attrs):
         return {n: local[n] for n in carry_names}
 
     init = {n: env[n] for n in carry_names}
-    final = jax.lax.while_loop(cond_fn, body_fn, init)
+
+    if max_steps:
+        def scan_step(carry, _):
+            live = jnp.reshape(carry[cond_name], ()).astype(bool)
+            new = body_fn(carry)
+            merged = {
+                n: jnp.where(live, new[n], carry[n]) for n in carry_names
+            }
+            return merged, None
+
+        final, _ = jax.lax.scan(scan_step, init, None, length=max_steps)
+    else:
+        def cond_fn(carry):
+            return jnp.reshape(carry[cond_name], ()).astype(bool)
+
+        final = jax.lax.while_loop(cond_fn, body_fn, init)
     return {"Out": [final.get(n) for n in out_names]}
 
 
@@ -130,6 +155,108 @@ def recurrent(ctx, ins, attrs):
         for n in step_out_vars:
             collected[n].append(local[n])
     return {"Out": [jnp.stack(collected[n], axis=1) for n in step_out_vars]}
+
+
+@register_op("ifelse", no_grad=("Cond",),
+             ref="python/paddle/fluid/layers/control_flow.py:1252 (IfElse)")
+def ifelse(ctx, ins, attrs):
+    """Per-example two-way branch.
+
+    The reference scatters rows into true/false subsets (split_lod_tensor),
+    runs each branch on its subset, and gathers back (merge_lod_tensor) —
+    dynamic shapes. TPU lowering: run BOTH branches on the full batch and
+    merge rows with where(cond) — static shapes, identical results for the
+    row-wise computations IfElse expresses, and differentiable (the select
+    zeroes the untaken branch's cotangent per row).
+    """
+    cond = one(ins, "Cond")
+    x_names = list(attrs["x_var_names"])
+    true_outs = list(attrs["true_out_names"])
+    false_outs = list(attrs["false_out_names"])
+    env = dict(zip(x_names, ins.get("X", [])))
+
+    def run_block(block_attr, out_names):
+        sub = ctx.program.blocks[int(attrs[block_attr])]
+        local = dict(env)
+        exec_op_descs(ctx, [op.desc for op in sub.ops], local)
+        return [local[n] for n in out_names]
+
+    t_vals = run_block("true_block", true_outs)
+    f_vals = run_block("false_block", false_outs)
+    mask = jnp.reshape(cond, (-1,)).astype(bool)  # [N]
+    merged = []
+    for t, f in zip(t_vals, f_vals):
+        m = mask.reshape((mask.shape[0],) + (1,) * (t.ndim - 1))
+        merged.append(jnp.where(m, t, f))
+    return {"Out": merged}
+
+
+@register_op("dynamic_recurrent", no_grad=("Lengths",),
+             ref="python/paddle/fluid/layers/control_flow.py:1354 (DynamicRNN)")
+def dynamic_recurrent(ctx, ins, attrs):
+    """DynamicRNN: scan over the time axis of padded sequences with
+    early-exit masking.
+
+    The reference shrinks the batch as short sequences finish
+    (lod_rank_table + shrink_rnn_memory ops, operators/shrink_rnn_memory_op.cc)
+    — dynamic shapes. TPU lowering: static [N, T] scan where step t freezes
+    memories and zeroes outputs for examples with t >= length. lax.scan gives
+    reverse-mode for free, so DynamicRNN trains (the reference re-runs
+    step scopes in reverse, recurrent_op.cc grad).
+    """
+    ops = _sub_op_descs(ctx, attrs)
+    step_in_vars = list(attrs["step_input_vars"])
+    static_vars = list(attrs["static_input_vars"])
+    mem_links = [tuple(l) for l in attrs["memory_links"]]
+    step_out_vars = list(attrs["step_output_vars"])
+    param_names = list(attrs["param_var_names"])
+
+    step_inputs = ins.get("StepInputs", [])
+    lengths = ins.get("Lengths", [None])[0]
+    mem_init = ins.get("MemInit", [])
+    statics = ins.get("StaticInputs", [])
+    params = ins.get("Params", [])
+
+    if not step_inputs:
+        raise ValueError("dynamic_recurrent requires StepInputs")
+    N, T = step_inputs[0].shape[0], step_inputs[0].shape[1]
+    if lengths is None:
+        lengths = jnp.full((N,), T, jnp.int32)
+    lengths = jnp.reshape(lengths, (-1,)).astype(jnp.int32)
+
+    base_env = dict(zip(param_names, params))
+    base_env.update(zip(static_vars, statics))
+    init_mems = {pre: init for (pre, _), init in zip(mem_links, mem_init)}
+
+    # time-major step inputs for scan: [T, N, ...]
+    xs = [jnp.swapaxes(x, 0, 1) for x in step_inputs]
+
+    def step(carry, xt):
+        mems, t = carry
+        local = dict(base_env)
+        local.update(mems)
+        for name, x_t in zip(step_in_vars, xt):
+            local[name] = x_t
+        exec_op_descs(ctx, ops, local)
+        active = t < lengths  # [N]
+
+        def sel(new, old):
+            m = active.reshape((N,) + (1,) * (new.ndim - 1))
+            return jnp.where(m, new, old)
+
+        new_mems = {pre: sel(local[upd], mems[pre])
+                    for (pre, upd) in mem_links}
+        outs_t = []
+        for n in step_out_vars:
+            v = local[n]
+            m = active.reshape((N,) + (1,) * (v.ndim - 1))
+            outs_t.append(jnp.where(m, v, jnp.zeros_like(v)))
+        return (new_mems, t + 1), outs_t
+
+    (_, _), stacked = jax.lax.scan(
+        step, (init_mems, jnp.asarray(0, jnp.int32)), xs)
+    # back to batch-major [N, T, ...]
+    return {"Out": [jnp.swapaxes(s, 0, 1) for s in stacked]}
 
 
 # --- tensor-array ops (reference tensor_array_read_write_op.cc) ----------

@@ -229,15 +229,34 @@ def arg_min(ctx, ins, attrs):
     return {"Out": jnp.argmin(one(ins, "X"), axis=int(attrs.get("axis", 0))).astype(jnp.int64)}
 
 
-@register_op("sequence_mask", no_grad=("X",),
+@register_op("sequence_mask", no_grad=("X", "MaxLenRef"),
              ref="paddle/fluid/operators/sequence_ops (era: created for padding)")
 def sequence_mask(ctx, ins, attrs):
     x = one(ins, "X")
     maxlen = int(attrs.get("maxlen", -1))
+    if maxlen < 0 and ins.get("MaxLenRef"):
+        # trace-time shapes are concrete: take the time extent from a
+        # padded [N, T, ...] reference tensor (lets maxlen track the batch's
+        # padding without a static attr)
+        maxlen = ins["MaxLenRef"][0].shape[1]
     if maxlen < 0:
         # XLA needs static shapes; the reference derives maxlen = max(lengths)
         # at runtime, which has no static-shape equivalent
-        raise ValueError("sequence_mask requires a static `maxlen` attr on TPU")
+        raise ValueError(
+            "sequence_mask requires a static `maxlen` attr (or a MaxLenRef "
+            "input) on TPU")
     dtype = dtype_of(attrs, "out_dtype", "int64")
     rng = jnp.arange(maxlen)
     return {"Y": (rng[None, :] < x[:, None]).astype(dtype)}
+
+
+@register_op("batch_gather", no_grad=("Index",),
+             ref="paddle/fluid/operators (beam parent gather; take_along_axis)")
+def batch_gather(ctx, ins, attrs):
+    """X [B, K, ...], Index [B, K'] -> out [B, K', ...]: per-batch gather
+    along axis 1 (beam-search parent-state selection)."""
+    x, idx = one(ins, "X"), one(ins, "Index")
+    idx = idx.astype(jnp.int32)
+    expanded = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    return {"Out": jnp.take_along_axis(
+        x, jnp.broadcast_to(expanded, idx.shape + x.shape[2:]), axis=1)}
