@@ -224,8 +224,39 @@ class Operator:
                 return normalize_outs(info.forward(ctx, ins, attrs))
 
             outs = jax.eval_shape(absfn, structs)
+        except (TypeError, ValueError) as e:
+            # Only a rejection of FULLY-KNOWN shapes is a genuine build-time
+            # error (the reference's InferShape enforce, shape_inference.h).
+            # A -1 (unknown) dim is stand-in-marked for abstract eval, so
+            # two different unknowns can spuriously mismatch — stay silent
+            # and let trace time decide those.
+            dims = [
+                d
+                for names in self.desc.inputs.values()
+                for n in names if n
+                for v in [self.block._var_recursive(n)]
+                if v is not None and v.shape is not None
+                for d in v.shape
+            ]
+            if any(d == -1 for d in dims):
+                return
+            in_desc = {
+                slot: [
+                    (n, tuple(self.block._var_recursive(n).shape or ()))
+                    for n in names if n
+                ]
+                for slot, names in self.desc.inputs.items()
+            }
+            msg = str(e).replace(str(_DIM_MARKER), "-1(batch)")
+            raise ValueError(
+                f"op '{self.desc.type}' rejects its inputs at program build "
+                f"time: {msg}\n  inputs: {in_desc}\n  attrs: "
+                f"{ {k: v for k, v in attrs.items() if not k.startswith('__')} }"
+            ) from e
         except Exception:
-            return  # inference is best-effort; runtime lowering re-traces anyway
+            # abstract eval needed concrete values / a sub-block / a mesh:
+            # inference is best-effort; runtime lowering re-traces anyway
+            return
         for slot, names in self.desc.outputs.items():
             shapes = outs.get(slot, [])
             for i, n in enumerate(names):
@@ -366,6 +397,17 @@ class Program:
 
     def _bump_version(self):
         self._version += 1
+
+    # --- inspection -----------------------------------------------------
+    def to_string(self, throw_on_error: bool = False,
+                  with_details: bool = False) -> str:
+        """Readable dump of all blocks (reference Program.to_string)."""
+        from .debugger import to_code
+
+        return to_code(self)
+
+    def __str__(self):
+        return self.to_string()
 
     # --- serialization --------------------------------------------------
     @property

@@ -17,7 +17,7 @@ from ..layer_helper import LayerHelper
 __all__ = [
     "While", "StaticRNN", "DynamicRNN", "IfElse", "ConditionalBlock",
     "Switch", "increment", "array_write", "array_read", "array_length",
-    "create_array", "less_than", "equal", "zeros_like_array",
+    "create_array", "less_than", "equal", "zeros_like_array", "Print",
 ]
 
 
@@ -62,14 +62,16 @@ def create_array(dtype, size, item_shape):
 
 
 def array_write(x, i, array):
+    """Writes x at position i. As in the reference (the op's output IS the
+    array variable), the write is in-place on `array`'s name — which is also
+    what lets an enclosing While carry the array across iterations."""
     helper = LayerHelper("array_write")
-    out = helper.create_variable_for_type_inference(x.dtype)
     helper.append_op(
         type="write_to_array",
         inputs={"Array": [array], "X": [x], "I": [i]},
-        outputs={"Out": [out]},
+        outputs={"Out": [array]},
     )
-    return out
+    return array
 
 
 def array_read(array, i):
@@ -88,6 +90,33 @@ def array_length(array):
     helper.append_op(
         type="array_length", inputs={"X": [array]}, outputs={"Out": [out]},
     )
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Tensor tap (reference layers/control_flow.py Print, print_op.cc):
+    returns `input` unchanged and prints stats + first `summarize` values
+    whenever the op executes. `print_phase`: 'forward', 'backward', 'both'
+    — backward taps the gradient flowing through. (`first_n` and the
+    print_tensor_* switches are accepted for API parity; the XLA-side
+    printer always shows name/shape/dtype and prints every step.)"""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="print", inputs={"In": [input]}, outputs={"Out": [out]},
+        attrs={
+            "first_n": int(first_n),
+            "message": message or input.name,
+            "summarize": int(summarize),
+            "print_phase": print_phase,
+        },
+    )
+    from .sequence import _propagate_lengths
+
+    _propagate_lengths(input, out)
     return out
 
 
@@ -116,6 +145,21 @@ def _scan_block_io(sub, parent_block):
         if n not in sub.vars and parent_block._var_recursive(n) is not None
     )
     return touched, written
+
+
+def _outer_reads(sub, parent_block, exclude=()):
+    """Outer-var names a finished sub-block READS (params + captured
+    tensors), minus `exclude` (block-local placeholders like step inputs).
+    Shared by DynamicRNN and Pipeline region capture."""
+    read = set()
+    for op in sub.ops:
+        read.update(n for n in op.desc.input_names() if n)
+    return sorted(
+        n for n in read
+        if n not in exclude
+        and n not in sub.vars
+        and parent_block._var_recursive(n) is not None
+    )
 
 
 class While:
@@ -270,8 +314,6 @@ class IfElse:
     branches must output() the same number of (shape-compatible) vars.
     """
 
-    OUT_IF_ELSE_BLOCKS = True
-
     def __init__(self, cond, name=None):
         self.helper = LayerHelper("ifelse", name=name)
         self.cond = cond
@@ -339,6 +381,7 @@ class IfElse:
                 "true_block": self._blocks["true"].idx,
                 "false_block": self._blocks["false"].idx,
                 "x_var_names": touched,
+                "cond_var_name": self.cond.name,
                 "true_out_names": [v.name for v in t],
                 "false_out_names": [v.name for v in f],
             },
@@ -451,15 +494,7 @@ class DynamicRNN:
         step_locals = {sv.name for _, sv in self.step_inputs}
         step_locals.update(sv.name for _, sv in self.static_inputs)
         step_locals.update(m[0].name for m in self.memories)
-        read = set()
-        for op in self._sub.ops:
-            read.update(n for n in op.desc.input_names() if n)
-        params = sorted(
-            n for n in read
-            if n not in step_locals
-            and n not in self._sub.vars
-            and self._parent._var_recursive(n) is not None
-        )
+        params = _outer_reads(self._sub, self._parent, exclude=step_locals)
         self._out_vars = []
         for o in self.outputs:
             ov = self._parent.create_var(

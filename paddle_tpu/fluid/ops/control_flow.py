@@ -174,6 +174,11 @@ def ifelse(ctx, ins, attrs):
     true_outs = list(attrs["true_out_names"])
     false_outs = list(attrs["false_out_names"])
     env = dict(zip(x_names, ins.get("X", [])))
+    # a branch may read the cond tensor as data (e.g. cast it); it arrives
+    # through the Cond slot, not X, so bind it under its var name too
+    cond_name = attrs.get("cond_var_name")
+    if cond_name:
+        env[cond_name] = cond
 
     def run_block(block_attr, out_names):
         sub = ctx.program.blocks[int(attrs[block_attr])]

@@ -260,3 +260,52 @@ def batch_gather(ctx, ins, attrs):
     expanded = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
     return {"Out": jnp.take_along_axis(
         x, jnp.broadcast_to(expanded, idx.shape + x.shape[2:]), axis=1)}
+
+
+def _emit_print(x, attrs, phase):
+    message = attrs.get("message") or ""
+    summarize = int(attrs.get("summarize", 20))
+    parts = [f"{message}" if message else "", f"[{phase}]"]
+    size = int(np.prod(x.shape)) if x.shape else 1
+    # reference print_op semantics: summarize < 0 means print everything
+    flat_n = size if summarize < 0 else min(summarize, size)
+    # static metadata goes straight into the format string; only tensor
+    # values are runtime-formatted
+    fmt = (" ".join(p for p in parts if p)
+           + f" shape={tuple(x.shape)} dtype={x.dtype}")
+    if jnp.issubdtype(x.dtype, jnp.inexact):
+        jax.debug.print(
+            fmt + " min={mn} max={mx} mean={me} nan={nans}"
+            f" data[:{flat_n}]={{head}}",
+            mn=jnp.min(x), mx=jnp.max(x), me=jnp.mean(x),
+            nans=jnp.sum(jnp.isnan(x)), head=jnp.ravel(x)[:flat_n],
+            ordered=True,
+        )
+    else:
+        jax.debug.print(
+            fmt + f" data[:{flat_n}]={{head}}",
+            head=jnp.ravel(x)[:flat_n], ordered=True,
+        )
+
+
+def _print_grad(ctx, fwd_ins, fwd_outs, out_grads, attrs):
+    g = out_grads["Out"][0]
+    if g is not None and attrs.get("print_phase", "both") in ("backward",
+                                                             "both"):
+        _emit_print(g, attrs, "backward")
+    return {"GRAD@In": g}
+
+
+@register_op("print", grad=_print_grad, no_grad=(),
+             ref="paddle/fluid/operators/print_op.cc")
+def print_op(ctx, ins, attrs):
+    """Tensor tap (reference print_op.cc): passes In through unchanged and
+    host-prints stats + the first `summarize` values via jax.debug.print
+    (runs per executed step, inside the compiled computation). The custom
+    grad keeps the backward a pure pass-through (and taps the gradient when
+    print_phase is 'backward'/'both'), so the vjp replay does not re-print
+    the forward."""
+    x = one(ins, "In")
+    if attrs.get("print_phase", "both") in ("forward", "both"):
+        _emit_print(x, attrs, "forward")
+    return {"Out": x}

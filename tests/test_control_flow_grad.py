@@ -212,3 +212,30 @@ def test_conditional_block_now_differentiable():
     x_np = np.ones((1, 2), np.float32)
     (gx,), _ = _run(prog, startup, {"x": x_np}, ["x@GRAD"])
     np.testing.assert_allclose(gx, 4.0 * np.ones((1, 2), np.float32))
+
+
+def test_ifelse_branch_reads_cond_as_data():
+    """A branch may consume the cond tensor itself (e.g. cast it) — it
+    arrives through the Cond slot but must be bound in the branch env."""
+    import paddle_tpu.fluid as fluid
+
+    main, startup, scope = (Program(), Program(), fluid.Scope())
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[1], dtype="float32")
+            half = layers.fill_constant(shape=[1], dtype="float32", value=0.5)
+            cond = layers.less_than(half, x)  # [N,1] bool
+            ie = layers.IfElse(cond)
+            with ie.true_block():
+                d = ie.input(x)
+                ie.output(layers.elementwise_add(
+                    d, layers.cast(cond, "float32")))
+            with ie.false_block():
+                d = ie.input(x)
+                ie.output(layers.scale(d, scale=10.0))
+            (out,) = ie()
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.array([[0.9], [0.1]], np.float32)
+        (o,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(o), [[1.9], [1.0]], rtol=1e-6)

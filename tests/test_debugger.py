@@ -1,0 +1,63 @@
+"""Observability surface: print op (tensor tap), Program.to_string,
+graphviz dump (reference print_op.cc, debuger.py, net_drawer.py)."""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def _build_tapped():
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 3
+    with program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        h = layers.fc(input=x, size=3, act="tanh")
+        tapped = layers.Print(h, message="h-tap", summarize=3)
+        loss = layers.mean(tapped)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, tapped, loss
+
+
+def test_print_op_taps_forward_and_backward(capfd):
+    main, startup, tapped, loss = _build_tapped()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (tv, lv) = exe.run(
+            main, feed={"x": np.ones((2, 4), np.float32)},
+            fetch_list=[tapped, loss])
+        # pass-through: the tap does not change the value
+        assert np.asarray(tv).shape == (2, 3)
+        assert np.isfinite(np.asarray(lv)).all()
+    out = capfd.readouterr().out
+    assert "h-tap [forward]" in out
+    assert "h-tap [backward]" in out
+    assert "mean=" in out and "shape=(2, 3)" in out
+
+
+def test_program_to_string_lists_ops_and_vars():
+    main, startup, tapped, loss = _build_tapped()
+    text = main.to_string()
+    assert "block 0 {" in text
+    for op_type in ("mul", "tanh", "print", "mean", "sgd"):
+        assert op_type + "(" in text, f"missing op {op_type} in:\n{text}"
+    assert "param fc_" in text or "param " in text
+    # str(program) is the same dump
+    assert str(main) == text
+
+
+def test_graphviz_dump_writes_dot():
+    main, startup, tapped, loss = _build_tapped()
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "block.dot")
+        dot = fluid.debugger.draw_block_graphviz(main.global_block(),
+                                                 path=path)
+        assert dot.startswith("digraph G {") and dot.rstrip().endswith("}")
+        assert 'label="print"' in dot
+        with open(path) as f:
+            assert f.read() == dot
