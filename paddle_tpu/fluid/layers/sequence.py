@@ -20,6 +20,7 @@ __all__ = [
     "dynamic_lstm", "dynamic_gru", "sequence_pool", "sequence_conv",
     "sequence_expand", "sequence_first_step", "sequence_last_step",
     "sequence_softmax", "sequence_reshape", "sequence_concat", "seq_lengths_of",
+    "linear_chain_crf", "crf_decoding",
     "gru_unit", "sequence_mask", "batch_gather", "beam_search",
     "beam_search_decode",
 ]
@@ -314,6 +315,58 @@ def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, ids=None,
                "level": int(level)},
     )
     return sel_ids, sel_scores, parent
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """Linear-chain CRF NLL (reference layers/nn.py linear_chain_crf, op
+    linear_chain_crf_op.cc). `input` is the padded emission [N, T, D] with
+    lengths companion; `label` [N, T] (+lengths). The transition parameter
+    is [D+2, D]: rows 0/1 are start/end weights, rows 2: the tag-to-tag
+    matrix. Returns per-sequence NLL [N, 1]."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        helper.param_attr, [size + 2, size], input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    e_exps = helper.create_variable_for_type_inference(input.dtype)
+    t_exps = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Emission": [input], "Transition": [transition],
+              "Label": [label]}
+    lengths = seq_lengths_of(input) or seq_lengths_of(label)
+    if lengths is not None:
+        inputs["Lengths"] = [lengths]
+    helper.append_op(
+        type="linear_chain_crf", inputs=inputs,
+        outputs={"LogLikelihood": [ll], "Alpha": [alpha],
+                 "EmissionExps": [e_exps], "TransitionExps": [t_exps]},
+    )
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None):
+    """Viterbi decode against the CRF transition parameter created by
+    linear_chain_crf (reference layers/nn.py crf_decoding). With `label`,
+    returns per-position agreement 0/1 instead of the path (reference
+    crf_decoding_op.cc semantics)."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    # the transition parameter already exists (created by linear_chain_crf
+    # under the shared ParamAttr name, e.g. 'crfw') — look it up, don't re-init
+    transition = helper.main_program.global_block().var(helper.param_attr.name)
+    path = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    lengths = seq_lengths_of(input) or (
+        seq_lengths_of(label) if label is not None else None)
+    if lengths is not None:
+        inputs["Lengths"] = [lengths]
+    helper.append_op(
+        type="crf_decoding", inputs=inputs,
+        outputs={"ViterbiPath": [path]},
+    )
+    _propagate_lengths(input, path)
+    return path
 
 
 def beam_search_decode(ids, scores, parents, beam_size=None, end_id=0):

@@ -70,6 +70,11 @@ def sums(input, out=None):
     if out is None:
         out = helper.create_variable_for_type_inference(dtype=input[0].dtype)
     helper.append_op(type="sum", inputs={"X": input}, outputs={"Out": [out]})
+    # elementwise over the time axis: sequence lengths survive
+    from .sequence import _propagate_lengths
+
+    for x in input:
+        _propagate_lengths(x, out)
     return out
 
 

@@ -1,0 +1,132 @@
+"""Book chapter: rnn_encoder_decoder (reference
+python/paddle/fluid/tests/book/notest_rnn_encoder_decoer.py).
+
+Seq2seq without attention: bidirectional dynamic_lstm encoder, a hand-built
+LSTM cell (fc + gates) stepped by DynamicRNN with TWO memories (hidden and
+cell) plus a static_input context — the chapter exists to exercise exactly
+that control-flow surface."""
+import numpy as np
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+from paddle_tpu.fluid.layers.sequence import seq_lengths_of
+
+DICT_SIZE = 64
+WORD_DIM = 16
+HIDDEN = 32
+DECODER_SIZE = HIDDEN
+BATCH = 16
+START_ID = paddle_tpu.dataset.wmt14.START_ID
+END_ID = paddle_tpu.dataset.wmt14.END_ID
+
+
+def _short_seq_reader():
+    def reader():
+        g = np.random.default_rng(409)
+        for _ in range(512):
+            length = int(g.integers(3, 7))
+            src = g.integers(3, DICT_SIZE, size=length).tolist()
+            trg = src[::-1]
+            yield src, [START_ID] + trg, trg + [END_ID]
+    return reader
+
+
+def bi_lstm_encoder(input_seq, hidden_size):
+    fwd_proj = layers.fc(input=input_seq, size=hidden_size * 4,
+                         num_flatten_dims=2)
+    forward, _ = layers.dynamic_lstm(input=fwd_proj, size=hidden_size * 4)
+    bwd_proj = layers.fc(input=input_seq, size=hidden_size * 4,
+                         num_flatten_dims=2)
+    backward, _ = layers.dynamic_lstm(input=bwd_proj, size=hidden_size * 4,
+                                      is_reverse=True)
+    return (layers.sequence_last_step(input=forward),
+            layers.sequence_first_step(input=backward))
+
+
+def lstm_step(x_t, hidden_t_prev, cell_t_prev, size):
+    def linear(inputs):
+        return layers.fc(input=inputs, size=size, bias_attr=True)
+
+    forget_gate = layers.sigmoid(x=linear([hidden_t_prev, x_t]))
+    input_gate = layers.sigmoid(x=linear([hidden_t_prev, x_t]))
+    output_gate = layers.sigmoid(x=linear([hidden_t_prev, x_t]))
+    cell_tilde = layers.tanh(x=linear([hidden_t_prev, x_t]))
+
+    cell_t = layers.sums(input=[
+        layers.elementwise_mul(x=forget_gate, y=cell_t_prev),
+        layers.elementwise_mul(x=input_gate, y=cell_tilde),
+    ])
+    hidden_t = layers.elementwise_mul(x=output_gate, y=layers.tanh(x=cell_t))
+    return hidden_t, cell_t
+
+
+def seq_to_seq_net():
+    src = layers.data(name="source_sequence", shape=[1], dtype="int64",
+                      lod_level=1)
+    src_emb = layers.embedding(input=src, size=[DICT_SIZE, WORD_DIM])
+    src_fwd_last, src_bwd_first = bi_lstm_encoder(src_emb, HIDDEN)
+    encoded = layers.concat(input=[src_fwd_last, src_bwd_first], axis=1)
+
+    decoder_boot = layers.fc(input=src_bwd_first, size=DECODER_SIZE,
+                             act="tanh")
+    cell_init = layers.fill_constant_batch_size_like(
+        input=decoder_boot, shape=[-1, DECODER_SIZE], dtype="float32",
+        value=0.0)
+    cell_init.stop_gradient = False
+
+    trg = layers.data(name="target_sequence", shape=[1], dtype="int64",
+                      lod_level=1)
+    trg_emb = layers.embedding(input=trg, size=[DICT_SIZE, WORD_DIM])
+
+    rnn = layers.DynamicRNN()
+    with rnn.block():
+        current_word = rnn.step_input(trg_emb)
+        context = rnn.static_input(encoded)
+        hidden_mem = rnn.memory(init=decoder_boot)
+        cell_mem = rnn.memory(init=cell_init)
+        decoder_inputs = layers.concat(input=[context, current_word], axis=1)
+        h, c = lstm_step(decoder_inputs, hidden_mem, cell_mem, DECODER_SIZE)
+        rnn.update_memory(hidden_mem, h)
+        rnn.update_memory(cell_mem, c)
+        out = layers.fc(input=h, size=DICT_SIZE, bias_attr=True)
+        rnn.output(out)
+    logits = rnn()  # [N, T, V]
+
+    label = layers.data(name="label_sequence", shape=[1], dtype="int64",
+                        lod_level=1)
+    ce = layers.softmax_with_cross_entropy(logits=logits, label=label)
+    ce = layers.reshape(ce, [BATCH, -1])
+    mask = layers.sequence_mask(seq_lengths_of(label), maxlen_ref=ce,
+                                dtype="float32")
+    masked = layers.elementwise_mul(ce, mask)
+    avg_cost = layers.elementwise_div(
+        layers.reduce_sum(masked), layers.reduce_sum(mask))
+    return avg_cost
+
+
+def test_rnn_encoder_decoder_train():
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    main.random_seed = startup.random_seed = 59
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            avg_cost = seq_to_seq_net()
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(avg_cost)
+
+        reader = paddle_tpu.batch(_short_seq_reader(), batch_size=BATCH)
+        feeder = fluid.DataFeeder(
+            feed_list=["source_sequence", "target_sequence",
+                       "label_sequence"], program=main)
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for epoch in range(4):
+            for i, data in enumerate(reader()):
+                if i >= 24 or len(data) < BATCH:
+                    break
+                (loss,) = exe.run(main, feed=feeder.feed(data),
+                                  fetch_list=[avg_cost])
+                losses.append(float(np.asarray(loss).reshape(-1)[0]))
+        assert np.isfinite(losses[-1])
+        assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
