@@ -18,6 +18,7 @@ from . import (  # noqa: F401
     metric_ops,
     nce_op,
     nn_ops,
+    pipeline_op,
     optimizer_ops,
     random_ops,
     reduce_ops,
