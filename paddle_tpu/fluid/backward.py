@@ -33,6 +33,11 @@ _NON_DIFF_OPS = {
 
 _FLOAT_DTYPES = {"float16", "bfloat16", "float32", "float64"}
 
+# non-diff ops whose output is input + constant IN PLACE: leaving the
+# output's gradient contributions un-popped lets them flow through to the
+# previous producer of the same name, which is exactly d(x+c)/dx = 1
+_FLOW_THROUGH_OPS = {"increment"}
+
 
 def _is_float_var(block, name: str) -> bool:
     var = block._var_recursive(name)
@@ -103,26 +108,34 @@ def append_backward(
     program: Program = block.program
     no_grad = set(no_grad_set or ())
 
-    # --- snapshot inputs of in-place ops --------------------------------
-    # An op that writes one of its own inputs (While's loop carry, assign /
-    # increment chains) leaves only the POST-op value under that name at
-    # runtime, but its grad op must replay the forward from the PRE-op
-    # value (the reference keeps per-step scopes for this, while_op.cc
-    # StepScopes). Insert `assign` snapshots before such ops and point the
-    # grad op's forward-input references at the snapshots.
+    # --- snapshot values that in-place writes destroy -------------------
+    # An op that overwrites a name (While's loop carry, assign / increment
+    # chains) leaves only the POST-write value under that name at runtime,
+    # but grad ops replay their forward from the values their op actually
+    # consumed (the reference keeps per-step scopes for this, while_op.cc
+    # StepScopes). So before every op that overwrites a name some earlier
+    # (or the same) op has read, insert an `assign` snapshot and point
+    # those readers' grad-op forward-input references at it. Readers are
+    # tracked per value VERSION: a reader between two writes binds to the
+    # snapshot taken at the next write; readers after the last write use
+    # the live name.
     snap_by_op: Dict[int, Dict[str, str]] = {}
+    readers_since_write: Dict[str, List[int]] = {}
     idx = 0
     while idx < len(block.ops):
         od = block.ops[idx].desc
-        if od.type.endswith("_grad") or od.type in _NON_DIFF_OPS:
+        if od.type.endswith("_grad"):
             idx += 1
             continue
-        colliding = sorted(
-            set(n for n in od.input_names() if n)
-            & set(n for n in od.output_names() if n)
-        )
-        snaps: Dict[str, str] = {}
-        for n in colliding:
+        if od.type not in _NON_DIFF_OPS:
+            # only differentiable ops replay their forward in the grad pass
+            for n in od.input_names():
+                if n:
+                    readers_since_write.setdefault(n, []).append(id(od))
+        out_names = [n for n in od.output_names() if n]
+        overwrites = sorted(n for n in set(out_names)
+                            if readers_since_write.get(n))
+        for n in overwrites:
             src = block._var_recursive(n)
             sv = block.create_var(
                 name=unique_name.generate(n + "@PRE"),
@@ -135,9 +148,11 @@ def append_backward(
                 outputs={"Out": [sv.name]},
             )
             idx += 1
-            snaps[n] = sv.name
-        if snaps:
-            snap_by_op[id(od)] = snaps
+            for rid in readers_since_write.pop(n):
+                snap_by_op.setdefault(rid, {})[n] = sv.name
+        # this op produced fresh versions of its outputs
+        for n in out_names:
+            readers_since_write.pop(n, None)
         idx += 1
 
     fwd_ops = list(block.ops)
@@ -157,9 +172,25 @@ def append_backward(
 
     contributions: Dict[str, List[str]] = {loss.name: [loss_grad.name]}
 
+    def _consume_output_grads(od):
+        """An op is the producer of its outputs: once visited, any gradient
+        contributions to those names are spent — clear them so they can't
+        leak to an EARLIER writer of the same (overwritten) name."""
+        for names in od.outputs.values():
+            for n in names:
+                if n:
+                    contributions.pop(n, None)
+
     for op in reversed(fwd_ops):
         od = op.desc
-        if od.type in _NON_DIFF_OPS or od.type.endswith("_grad"):
+        if od.type.endswith("_grad"):
+            continue
+        if od.type in _NON_DIFF_OPS:
+            # terminal writes (fill/random/optimizer updates) end the
+            # gradient of the name they produced; increment-style in-place
+            # adds deliberately pass it through (identity jacobian)
+            if od.type not in _FLOW_THROUGH_OPS:
+                _consume_output_grads(od)
             continue
         info = OPS.get(od.type)
         if info is None:
@@ -171,6 +202,9 @@ def append_backward(
             n for n in od.input_names() if n in need_grad and n not in no_grad
         ]
         if not out_has_grad or not diff_inputs:
+            # even when no gradient can pass through, this op still
+            # produced its outputs — their contributions die here
+            _consume_output_grads(od)
             continue
         if od.type == "while" and not od.attrs.get("max_steps"):
             raise RuntimeError(
@@ -192,6 +226,7 @@ def append_backward(
                 any_out_grad = any_out_grad or bool(g)
             grad_in["GRAD@" + slot] = grads
         if not any_out_grad:
+            _consume_output_grads(od)
             continue
 
         # grad op outputs: a fresh grad var per differentiable input
@@ -211,6 +246,7 @@ def append_backward(
                     outs.append("")
             grad_out["GRAD@" + slot] = outs
         if not any(n for lst in grad_out.values() for n in lst):
+            _consume_output_grads(od)
             continue
 
         # forward-input references go through the pre-op snapshots for
@@ -236,13 +272,7 @@ def append_backward(
                 }
             },
         )
-        # this op is the producer of its outputs: their gradients are now
-        # consumed — clear them so ops earlier in the program don't
-        # double-count (matters when a name is rewritten in place)
-        for names in od.outputs.values():
-            for n in names:
-                if n:
-                    contributions.pop(n, None)
+        _consume_output_grads(od)
         if od.type == "lookup_table" and od.attrs.get("is_sparse"):
             # grad W is a SelectedRows: mark the var desc for IR-level
             # parity with the reference's VarTypeInference

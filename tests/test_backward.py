@@ -78,3 +78,48 @@ def test_stop_gradient_blocks_path():
             loss = layers.mean(z)
             params_grads = append_backward(loss)
         assert params_grads == []
+
+
+def test_overwrite_earlier_reader_uses_pre_value():
+    """An op that consumed a value later overwritten in place must replay
+    its vjp from the PRE-write snapshot, not the live (post-write) name."""
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            w = layers.create_parameter(shape=[4], dtype="float32",
+                                        name="wpre")
+            y = layers.scale(w, scale=2.0)                  # y = 2w
+            z = layers.elementwise_mul(y, y)                # z = y^2 (reads y)
+            c = layers.fill_constant(shape=[4], dtype="float32", value=7.0)
+            layers.assign(c, output=y)                      # y overwritten
+            loss = layers.mean(layers.elementwise_add(z, y))
+            params_grads = append_backward(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        (g,) = exe.run(main, fetch_list=[params_grads[0][1]])
+        # loss = mean(4w^2 + 7); dloss/dw = 8w/4 = 2w. A stale replay from
+        # the post-write y (=7) would give d(y^2)/dw via y=7: 2*7*2/4 = 7.
+        w0 = np.asarray(scope.find_var("wpre"))
+        np.testing.assert_allclose(g, 2.0 * w0, rtol=1e-5, atol=1e-6)
+
+
+def test_overwrite_kills_stale_gradient():
+    """Gradient of an overwritten name must NOT leak past its (non-pass-
+    through) producer to the earlier writer of the same name."""
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            w = layers.create_parameter(shape=[4], dtype="float32",
+                                        name="wleak")
+            y = layers.scale(w, scale=2.0)                  # y = 2w
+            c = layers.fill_constant(shape=[4], dtype="float32", value=7.0)
+            layers.assign(c, output=y)                      # y := const
+            loss = layers.mean(y)                           # dloss/dw == 0
+            params_grads = append_backward(loss)
+        # no gradient path reaches w: either it's absent from params_grads,
+        # or (if materialized) it must evaluate to zero
+        if params_grads:
+            exe = fluid.Executor()
+            exe.run(startup)
+            (g,) = exe.run(main, fetch_list=[params_grads[0][1]])
+            np.testing.assert_allclose(g, np.zeros(4), atol=1e-7)
