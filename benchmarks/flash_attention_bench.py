@@ -1,0 +1,79 @@
+"""Flash-attention kernel vs plain-XLA attention on TPU at long sequence
+lengths (VERDICT r1 item 7: perf assertion vs the jnp path at S >= 2k).
+
+Run on a TPU host: python benchmarks/flash_attention_bench.py
+Prints one JSON line per config with times and the speedup; exits non-zero
+if the Pallas path is slower than XLA at S >= 2048 or the grads diverge.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_attention_loss(q, k, v, causal):
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        m = (jnp.arange(s.shape[2])[:, None] >= jnp.arange(s.shape[3])[None])
+        s = jnp.where(m[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, v))
+
+
+def bench(fn, args, iters=20):
+    fn(*args)  # compile
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    from paddle_tpu.fluid.ops.pallas_kernels.flash_attention import (
+        flash_attention,
+    )
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skipped": "not on tpu"}))
+        return 0
+
+    rc = 0
+    for seq in (2048, 4096):
+        b, h, d = 1, 8, 64
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(b, seq, h, d).astype(np.float32))
+
+        def flash_loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True))
+
+        flash_g = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))
+        dense_g = jax.jit(jax.grad(
+            lambda q, k, v: dense_attention_loss(q, k, v, True),
+            argnums=(0, 1, 2)))
+
+        t_flash = bench(flash_g, (q, q, q))
+        t_dense = bench(dense_g, (q, q, q))
+        gf = flash_g(q, q, q)
+        gd = dense_g(q, q, q)
+        max_err = max(float(jnp.max(jnp.abs(a - b_))) for a, b_ in zip(gf, gd))
+        speedup = t_dense / t_flash
+        print(json.dumps({
+            "seq": seq, "flash_ms": round(t_flash * 1e3, 3),
+            "xla_ms": round(t_dense * 1e3, 3),
+            "speedup": round(speedup, 3), "grad_max_err": max_err,
+        }))
+        if seq >= 2048 and speedup < 1.0:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    sys.exit(main())

@@ -39,14 +39,40 @@ def ring_attention(ctx, ins, attrs):
     if mesh is None or seq_axis not in mesh.axis_names:
         from ..flags import pallas_enabled, pallas_interpret
 
-        # pallas_call has no SPMD partitioning rule — kernel path only when
-        # lowering truly single-device (mesh present but without the seq
-        # axis still means GSPMD shards batch/heads)
-        if pallas_enabled() and mesh is None:
+        if pallas_enabled():
             from .pallas_kernels import flash_attention
 
-            return flash_attention(q, k, v, causal=causal, scale=scale,
-                                   interpret=pallas_interpret())
+            if mesh is None:
+                return flash_attention(q, k, v, causal=causal, scale=scale,
+                                       interpret=pallas_interpret())
+            # mesh without a seq axis (dp / dp×tp runs): pallas_call has no
+            # GSPMD partitioning rule, so enter manual mode explicitly —
+            # shard batch (and heads) over the mesh with shard_map and run
+            # the kernel per shard. Attention is embarrassingly parallel in
+            # batch/heads, so no collectives are needed.
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            b_ax = attrs.get("batch_axis", "") or None
+            if b_ax is not None and (b_ax not in sizes
+                                     or q.shape[0] % sizes[b_ax]):
+                b_ax = None
+            h_ax = attrs.get("head_axis", "") or None
+            if h_ax is not None and (h_ax not in sizes
+                                     or q.shape[2] % sizes[h_ax]):
+                h_ax = None
+            if b_ax is not None or h_ax is not None:
+                spec = P(b_ax, None, h_ax, None)
+                fn = jax.shard_map(
+                    lambda qs, ks, vs: flash_attention(
+                        qs, ks, vs, causal=causal, scale=scale,
+                        interpret=pallas_interpret()),
+                    mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                    check_vma=False,
+                )
+                return fn(q, k, v)
+            # no dividable batch/head axis: stay on the XLA path
         return ring_attention_shard(q, k, v, None, causal, scale)
     batch_axis = attrs.get("batch_axis", "") or None
     if batch_axis is not None and batch_axis not in mesh.axis_names:
