@@ -13,6 +13,7 @@ exactly like the reference partitions chunks.
 """
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import socket
@@ -31,6 +32,23 @@ class Task:
     paths: List[str]
     num_failures: int = 0
     epoch: int = 0  # lease generation; stale finish/fail calls are rejected
+
+
+def _to_wire(v):
+    """RPC result -> JSON-safe value (Task gets a type tag)."""
+    if isinstance(v, Task):
+        return {"__task__": {"id": v.id, "paths": list(v.paths),
+                             "num_failures": v.num_failures,
+                             "epoch": v.epoch}}
+    return v
+
+
+def _from_wire(v):
+    if isinstance(v, dict) and "__task__" in v:
+        t = v["__task__"]
+        return Task(id=t["id"], paths=list(t["paths"]),
+                    num_failures=t["num_failures"], epoch=t["epoch"])
+    return v
 
 
 @dataclass
@@ -207,9 +225,10 @@ class MasterService:
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
         """Start serving in a daemon thread; returns (host, port).
 
-        Trust boundary: frames are pickle (like the reference's in-cluster
-        protobuf RPC, trusted network only) — bind beyond 127.0.0.1 only
-        inside the job's private network."""
+        Frames are length-prefixed JSON — every RPC argument/result is
+        paths/ints/bools/Task, so nothing needs pickle, and a hostile peer
+        can at worst get a JSON parse error (the reference's in-cluster RPC
+        is protobuf for the same reason)."""
         service = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -220,12 +239,20 @@ class MasterService:
                         if len(head) != 4:
                             return
                         (n,) = struct.unpack("<I", head)
-                        method, args = pickle.loads(self.rfile.read(n))
-                        if method not in MasterService._RPC_METHODS:
-                            raise ValueError(f"unknown RPC method {method!r}")
-                        result = getattr(service, method)(*args)
-                        out = pickle.dumps(result,
-                                           protocol=pickle.HIGHEST_PROTOCOL)
+                        body = self.rfile.read(n)
+                        if len(body) != n:
+                            return
+                        try:
+                            req = json.loads(body.decode("utf-8"))
+                            method = req["method"]
+                            if method not in MasterService._RPC_METHODS:
+                                raise ValueError(
+                                    f"unknown RPC method {method!r}")
+                            result = getattr(service, method)(*req["args"])
+                            resp = {"ok": True, "result": _to_wire(result)}
+                        except Exception as e:  # report, keep serving
+                            resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                        out = json.dumps(resp).encode("utf-8")
                         self.wfile.write(struct.pack("<I", len(out)) + out)
                         self.wfile.flush()
                 except (ConnectionError, EOFError):
@@ -262,17 +289,36 @@ class MasterClient:
         if self._service is not None:
             return getattr(self._service, method)(*args)
         with self._lock:
-            if self._sock is None:
-                self._sock = socket.create_connection(self._addr)
-            payload = pickle.dumps((method, args),
-                                   protocol=pickle.HIGHEST_PROTOCOL)
-            self._sock.sendall(struct.pack("<I", len(payload)) + payload)
-            head = self._sock.recv(4, socket.MSG_WAITALL)
-            (n,) = struct.unpack("<I", head)
-            buf = b""
-            while len(buf) < n:
-                buf += self._sock.recv(n - len(buf))
-            return pickle.loads(buf)
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(self._addr)
+                payload = json.dumps(
+                    {"method": method, "args": list(args)}).encode("utf-8")
+                self._sock.sendall(struct.pack("<I", len(payload)) + payload)
+                head = self._sock.recv(4, socket.MSG_WAITALL)
+                if len(head) != 4:
+                    raise ConnectionError(
+                        "master closed the connection mid-call")
+                (n,) = struct.unpack("<I", head)
+                buf = b""
+                while len(buf) < n:
+                    chunk = self._sock.recv(n - len(buf))
+                    if not chunk:
+                        raise ConnectionError(
+                            "master closed the connection mid-frame")
+                    buf += chunk
+            except (ConnectionError, OSError):
+                # drop the broken socket so the next call reconnects
+                try:
+                    if self._sock is not None:
+                        self._sock.close()
+                finally:
+                    self._sock = None
+                raise
+            resp = json.loads(buf.decode("utf-8"))
+            if not resp.get("ok"):
+                raise RuntimeError(f"master RPC failed: {resp.get('error')}")
+            return _from_wire(resp.get("result"))
 
     def set_dataset(self, shard_paths: Sequence[str]):
         return self._call("set_dataset", list(shard_paths))

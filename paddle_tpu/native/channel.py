@@ -4,6 +4,7 @@ are pickled; capacity 0 = rendezvous like the reference's unbuffered
 channel. Pure-Python fallback uses queue.Queue semantics."""
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import pickle
 import threading
@@ -106,10 +107,20 @@ class Channel:
 
     send(obj) -> bool (False if closed); recv() -> obj or raises
     ChannelClosed when closed and drained.
+
+    Lifecycle: the native ByteChannel is freed by destroy() (also via the
+    context-manager exit). Destruction is deferred while any thread is
+    inside a native call on the handle — close() only wakes blocked
+    waiters, it does not wait for them to leave the object, so freeing
+    immediately would be a use-after-free under their feet. The last
+    in-flight call performs the deferred free.
     """
 
     def __init__(self, capacity: int = 0):
         self._lib = load_native()
+        self._mu = threading.Lock()
+        self._inflight = 0
+        self._destroy_pending = False
         if self._lib is not None:
             self._h: Optional[int] = self._lib.pt_chan_create(capacity)
             self._py = None
@@ -117,11 +128,36 @@ class Channel:
             self._h = None
             self._py = _PyChannel(capacity)
 
+    class _Destroyed(Exception):
+        """Internal: the handle is already freed (or being freed)."""
+
+    @contextlib.contextmanager
+    def _native_call(self):
+        """Guards a native call: holds the handle alive until it returns."""
+        with self._mu:
+            if self._h is None or self._destroy_pending:
+                raise Channel._Destroyed()
+            self._inflight += 1
+            h = self._h
+        try:
+            yield h
+        finally:
+            with self._mu:
+                self._inflight -= 1
+                if (self._destroy_pending and self._inflight == 0
+                        and self._h is not None):
+                    self._lib.pt_chan_destroy(self._h)
+                    self._h = None
+
     def send(self, obj) -> bool:
         if self._py is not None:
             return self._py.send(obj)
         data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        return self._lib.pt_chan_send(self._h, data, len(data)) == 0
+        try:
+            with self._native_call() as h:
+                return self._lib.pt_chan_send(h, data, len(data)) == 0
+        except Channel._Destroyed:
+            return False  # destroyed == closed for the send contract
 
     def recv(self):
         if self._py is not None:
@@ -130,20 +166,28 @@ class Channel:
                 raise ChannelClosed()
             return obj
         out = ctypes.POINTER(ctypes.c_char)()
-        n = self._lib.pt_chan_recv(self._h, ctypes.byref(out))
-        if n < 0:
-            raise ChannelClosed()
         try:
-            return pickle.loads(ctypes.string_at(out, n))
-        finally:
-            self._lib.pt_buf_free(out)
+            with self._native_call() as h:
+                n = self._lib.pt_chan_recv(h, ctypes.byref(out))
+                if n < 0:
+                    raise ChannelClosed()
+                try:
+                    return pickle.loads(ctypes.string_at(out, n))
+                finally:
+                    self._lib.pt_buf_free(out)
+        except Channel._Destroyed:
+            raise ChannelClosed() from None
 
     def try_send(self, obj) -> str:
         """'sent' | 'full' | 'closed' — non-blocking (Select cases)."""
         if self._py is not None:
             return self._py.try_send(obj)
         data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        rc = self._lib.pt_chan_try_send(self._h, data, len(data))
+        try:
+            with self._native_call() as h:
+                rc = self._lib.pt_chan_try_send(h, data, len(data))
+        except Channel._Destroyed:
+            return "closed"
         return "sent" if rc == 1 else ("full" if rc == 0 else "closed")
 
     def try_recv(self):
@@ -151,26 +195,64 @@ class Channel:
         if self._py is not None:
             return self._py.try_recv()
         out = ctypes.POINTER(ctypes.c_char)()
-        n = self._lib.pt_chan_try_recv(self._h, ctypes.byref(out))
-        if n == -2:
-            return "empty", None
-        if n == -1:
-            return "closed", None
         try:
-            return "ok", pickle.loads(ctypes.string_at(out, n))
-        finally:
-            self._lib.pt_buf_free(out)
+            with self._native_call() as h:
+                n = self._lib.pt_chan_try_recv(h, ctypes.byref(out))
+                if n == -2:
+                    return "empty", None
+                if n == -1:
+                    return "closed", None
+                try:
+                    return "ok", pickle.loads(ctypes.string_at(out, n))
+                finally:
+                    self._lib.pt_buf_free(out)
+        except Channel._Destroyed:
+            return "closed", None
 
     def close(self):
         if self._py is not None:
             self._py.close()
-        elif self._h:
-            self._lib.pt_chan_close(self._h)
+            return
+        # go through the in-flight guard: close must not race a concurrent
+        # destroy() freeing the handle under us
+        try:
+            with self._native_call() as h:
+                self._lib.pt_chan_close(h)
+        except Channel._Destroyed:
+            pass  # already freed (or being freed) -> closed by definition
+
+    def destroy(self):
+        """Close and free the native channel. Safe while other threads are
+        blocked in send/recv: they are woken by the close and the last one
+        out frees the handle."""
+        if self._py is not None:
+            self._py.destroy()
+            return
+        self.close()
+        with self._mu:
+            if self._h is None:
+                return
+            if self._inflight == 0:
+                self._lib.pt_chan_destroy(self._h)
+                self._h = None
+            else:
+                self._destroy_pending = True
 
     def size(self) -> int:
         if self._py is not None:
             return self._py.size()
-        return int(self._lib.pt_chan_size(self._h))
+        try:
+            with self._native_call() as h:
+                return int(self._lib.pt_chan_size(h))
+        except Channel._Destroyed:
+            return 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.destroy()
+        return False
 
     def __iter__(self):
         while True:
@@ -181,9 +263,6 @@ class Channel:
 
     def __del__(self):
         try:
-            if self._h and self._lib is not None:
-                self._lib.pt_chan_close(self._h)
-                self._lib.pt_chan_destroy(self._h)
-                self._h = None
+            self.destroy()
         except Exception:
             pass
