@@ -10,8 +10,17 @@ TPU-native replacement for the reference's distributed stack (SURVEY.md
   - `MasterService`/`MasterClient` — go/master-parity elastic task queue
     over recordio shards with lease timeouts, failure counts and snapshot
     recovery (file-based instead of etcd),
+  - `ElectedMaster`/`FileLease`/`endpoint_resolver` — leader election with
+    standby takeover from the shared snapshot and client endpoint
+    re-resolution (role of go/master/etcd_client.go's campaign +
+    go/pserver/etcd_client.go's TTL-lease registration),
   - `fluid.DistributeTranspiler` — API-parity facade mapping the pserver
     program-rewrite world onto mesh+sharding-plan SPMD.
 """
+from .election import (  # noqa: F401
+    ElectedMaster,
+    FileLease,
+    endpoint_resolver,
+)
 from .env import get_world_info, global_mesh, init_distributed  # noqa: F401
 from .master import MasterClient, MasterService  # noqa: F401

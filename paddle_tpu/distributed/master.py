@@ -26,6 +26,11 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
+class MasterDeposed(Exception):
+    """This master no longer holds the leadership lease: mutating RPCs and
+    snapshot writes must not proceed (fencing)."""
+
+
 @dataclass
 class Task:
     id: int
@@ -62,11 +67,16 @@ class MasterService:
     """Task queue with leases. Thread-safe; optionally snapshot-backed."""
 
     def __init__(self, chunks_per_task: int = 1, lease_timeout: float = 60.0,
-                 failure_max: int = 3, snapshot_path: Optional[str] = None):
+                 failure_max: int = 3, snapshot_path: Optional[str] = None,
+                 snapshot_fence=None):
         self._chunks_per_task = chunks_per_task
         self._timeout = lease_timeout
         self._failure_max = failure_max
         self._snapshot_path = snapshot_path
+        # fence(commit): run `commit` only while leadership is still held,
+        # else raise MasterDeposed — prevents a stale leader overwriting
+        # the new leader's snapshot (election.FileLease.fenced)
+        self._snapshot_fence = snapshot_fence
         self._mu = threading.Lock()
         self._todo: List[Task] = []
         self._pending: Dict[int, _Pending] = {}
@@ -199,7 +209,14 @@ class MasterService:
         tmp = self._snapshot_path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
-        os.replace(tmp, self._snapshot_path)
+
+        def _commit():
+            os.replace(tmp, self._snapshot_path)
+
+        if self._snapshot_fence is not None:
+            self._snapshot_fence(_commit)  # raises MasterDeposed when stale
+        else:
+            _commit()
 
     def _recover(self):
         with open(self._snapshot_path, "rb") as f:
@@ -222,23 +239,35 @@ class MasterService:
         "all_done", "stats",
     })
 
+    # frames larger than this are a protocol violation (a real set_dataset
+    # of ~100k shard paths is well under 8 MiB); caps the per-connection
+    # allocation a hostile peer can force
+    _MAX_FRAME = 8 << 20
+
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
         """Start serving in a daemon thread; returns (host, port).
 
-        Frames are length-prefixed JSON — every RPC argument/result is
-        paths/ints/bools/Task, so nothing needs pickle, and a hostile peer
-        can at worst get a JSON parse error (the reference's in-cluster RPC
-        is protobuf for the same reason)."""
+        Frames are length-prefixed JSON (size-capped) — every RPC
+        argument/result is paths/ints/bools/Task, so nothing needs pickle,
+        and a hostile peer can at worst get a parse error or a dropped
+        connection (the reference's in-cluster RPC is protobuf for the same
+        reason)."""
         service = self
+        self._conns = set()
+        self._conns_mu = threading.Lock()
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
+                with service._conns_mu:
+                    service._conns.add(self.connection)
                 try:
                     while True:
                         head = self.rfile.read(4)
                         if len(head) != 4:
                             return
                         (n,) = struct.unpack("<I", head)
+                        if n > MasterService._MAX_FRAME:
+                            return  # protocol violation: drop the peer
                         body = self.rfile.read(n)
                         if len(body) != n:
                             return
@@ -250,6 +279,11 @@ class MasterService:
                                     f"unknown RPC method {method!r}")
                             result = getattr(service, method)(*req["args"])
                             resp = {"ok": True, "result": _to_wire(result)}
+                        except MasterDeposed:
+                            # this master lost its lease mid-call: sever the
+                            # connection so the client re-resolves to the
+                            # new leader instead of getting app errors
+                            return
                         except Exception as e:  # report, keep serving
                             resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
                         out = json.dumps(resp).encode("utf-8")
@@ -257,6 +291,9 @@ class MasterService:
                         self.wfile.flush()
                 except (ConnectionError, EOFError):
                     return
+                finally:
+                    with service._conns_mu:
+                        service._conns.discard(self.connection)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -268,10 +305,25 @@ class MasterService:
         return self._server.server_address
 
     def shutdown(self):
+        """Stop the listener AND sever established connections — a deposed
+        leader must not keep serving clients that still hold open sockets
+        (they would never re-resolve to the new leader: split-brain)."""
         srv = getattr(self, "_server", None)
         if srv is not None:
             srv.shutdown()
             srv.server_close()
+        with getattr(self, "_conns_mu", threading.Lock()):
+            for conn in list(getattr(self, "_conns", ())):
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            if hasattr(self, "_conns"):
+                self._conns.clear()
 
 
 class MasterClient:
@@ -279,19 +331,46 @@ class MasterClient:
     python/paddle/v2/master/client.py). Also usable in-process by passing
     the service itself."""
 
-    def __init__(self, addr=None, service: Optional[MasterService] = None):
+    def __init__(self, addr=None, service: Optional[MasterService] = None,
+                 addr_resolver=None, reconnect_retries: int = 8,
+                 reconnect_backoff: float = 0.2):
+        """`addr_resolver`: zero-arg callable returning (host, port) of the
+        CURRENT master (see election.endpoint_resolver) — consulted on every
+        (re)connect, so a standby takeover is followed automatically.
+        Retries with backoff span the election gap after a master crash."""
         self._service = service
         self._addr = addr
+        self._resolver = addr_resolver
+        self._retries = int(reconnect_retries)
+        self._backoff = float(reconnect_backoff)
         self._sock = None
         self._lock = threading.Lock()
 
     def _call(self, method: str, *args):
         if self._service is not None:
             return getattr(self._service, method)(*args)
+        last_err: Optional[Exception] = None
+        for attempt in range(self._retries + 1):
+            try:
+                return self._call_once(method, *args)
+            except (ConnectionError, OSError) as e:
+                # master died or a standby is taking over: back off, then
+                # re-resolve the endpoint and retry (get_task/task_finished/
+                # task_failed are safe to retry — the lease epoch guard
+                # rejects stale duplicates)
+                last_err = e
+                if attempt < self._retries:
+                    time.sleep(self._backoff * (attempt + 1))
+        raise ConnectionError(
+            f"master unreachable after {self._retries + 1} attempts: "
+            f"{last_err}") from last_err
+
+    def _call_once(self, method: str, *args):
         with self._lock:
             try:
                 if self._sock is None:
-                    self._sock = socket.create_connection(self._addr)
+                    addr = self._resolver() if self._resolver else self._addr
+                    self._sock = socket.create_connection(addr)
                 payload = json.dumps(
                     {"method": method, "args": list(args)}).encode("utf-8")
                 self._sock.sendall(struct.pack("<I", len(payload)) + payload)
