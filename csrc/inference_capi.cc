@@ -1,0 +1,257 @@
+// C inference API implementation — see inference_capi.h for the contract.
+// Hosts the paddle_tpu runtime through the embedded CPython interpreter;
+// every entry point takes the GIL, so the API is thread-safe by
+// serialization (reference: paddle/capi wraps GradientMachine the same way
+// around its C++ core).
+#include "inference_capi.h"
+
+#include <Python.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Python-side glue: kept as source here so the .so is self-contained.
+// The predictor object holds (executor, program, feed names, fetch vars)
+// and staged inputs; run() feeds numpy arrays and returns numpy outputs.
+const char* kGlue = R"PY(
+import numpy as _np
+
+
+class _CPredictor:
+    def __init__(self, model_dir):
+        import paddle_tpu.fluid as fluid
+
+        self._scope = fluid.Scope()
+        with fluid.scope_guard(self._scope):
+            self._exe = fluid.Executor()
+            prog, feeds, fetches = fluid.load_inference_model(
+                model_dir, self._exe)
+        self.program, self.feed_names, self.fetch_vars = prog, feeds, fetches
+        self._inputs = {}
+        self._outputs = []
+
+    def set_input(self, idx, raw, dims):
+        # raw is the C buffer as bytes: one copy, no per-element boxing
+        arr = _np.frombuffer(raw, dtype=_np.float32).reshape(dims).copy()
+        self._inputs[self.feed_names[idx]] = arr
+
+    def run(self):
+        import paddle_tpu.fluid as fluid
+
+        missing = [n for n in self.feed_names if n not in self._inputs]
+        if missing:
+            raise ValueError(f"inputs not set for feeds: {missing}")
+        with fluid.scope_guard(self._scope):
+            outs = self._exe.run(self.program, feed=self._inputs,
+                                 fetch_list=self.fetch_vars)
+        self._outputs = [_np.ascontiguousarray(o, dtype=_np.float32)
+                         for o in outs]
+
+    def output(self, idx):
+        o = self._outputs[idx]
+        return o.tobytes(), list(o.shape)
+)PY";
+
+struct Predictor {
+  PyObject* obj;             // _CPredictor instance
+  std::vector<std::string> feed_names;
+  int num_fetches;
+};
+
+std::once_flag g_init_once;
+PyObject* g_glue_ns = nullptr;  // module namespace holding _CPredictor
+
+void interpreter_init() {
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized = true;
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* mod = PyModule_New("paddle_tpu_capi_glue");
+  PyObject* ns = PyModule_GetDict(mod);
+  PyDict_SetItemString(ns, "__builtins__", PyEval_GetBuiltins());
+  PyObject* r = PyRun_String(kGlue, Py_file_input, ns, ns);
+  if (r == nullptr) {
+    set_error_from_python();
+  } else {
+    Py_DECREF(r);
+    g_glue_ns = ns;
+    Py_INCREF(g_glue_ns);
+  }
+  PyGILState_Release(st);
+  if (we_initialized) {
+    // Py_InitializeEx left this thread owning the GIL: detach so other
+    // threads can enter. If the HOST initialized Python, its GIL state
+    // is none of our business — Ensure/Release above restored it.
+    PyEval_SaveThread();
+  }
+}
+
+class Gil {
+ public:
+  Gil() : st_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st_); }
+
+ private:
+  PyGILState_STATE st_;
+};
+
+}  // namespace
+
+extern "C" {
+
+pt_predictor_t pt_predictor_create(const char* model_dir) {
+  std::call_once(g_init_once, interpreter_init);
+  if (g_glue_ns == nullptr) {
+    return nullptr;
+  }
+  Gil gil;
+  PyObject* cls = PyDict_GetItemString(g_glue_ns, "_CPredictor");
+  if (cls == nullptr) {
+    g_last_error = "glue class missing";
+    return nullptr;
+  }
+  PyObject* obj = PyObject_CallFunction(cls, "s", model_dir);
+  if (obj == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  auto* p = new Predictor();
+  p->obj = obj;
+  PyObject* feeds = PyObject_GetAttrString(obj, "feed_names");
+  for (Py_ssize_t i = 0; i < PyList_Size(feeds); ++i) {
+    p->feed_names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(feeds, i)));
+  }
+  Py_DECREF(feeds);
+  PyObject* fetches = PyObject_GetAttrString(obj, "fetch_vars");
+  p->num_fetches = static_cast<int>(PyList_Size(fetches));
+  Py_DECREF(fetches);
+  return p;
+}
+
+int pt_predictor_num_feeds(pt_predictor_t h) {
+  return static_cast<int>(static_cast<Predictor*>(h)->feed_names.size());
+}
+
+int pt_predictor_num_fetches(pt_predictor_t h) {
+  return static_cast<Predictor*>(h)->num_fetches;
+}
+
+const char* pt_predictor_feed_name(pt_predictor_t h, int i) {
+  auto* p = static_cast<Predictor*>(h);
+  if (i < 0 || i >= static_cast<int>(p->feed_names.size())) return nullptr;
+  return p->feed_names[i].c_str();
+}
+
+int pt_predictor_set_input(pt_predictor_t h, int feed_idx, const float* data,
+                           const int64_t* dims, int ndim) {
+  auto* p = static_cast<Predictor*>(h);
+  Gil gil;
+  int64_t n = 1;
+  PyObject* pydims = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= dims[i];
+    PyList_SetItem(pydims, i, PyLong_FromLongLong(dims[i]));
+  }
+  // one bytes copy of the buffer; the glue reads it with np.frombuffer —
+  // no per-element boxing on the deploy hot path
+  PyObject* raw = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(n * sizeof(float)));
+  PyObject* r = PyObject_CallMethod(p->obj, "set_input", "iOO", feed_idx,
+                                    raw, pydims);
+  Py_DECREF(raw);
+  Py_DECREF(pydims);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int pt_predictor_run(pt_predictor_t h) {
+  auto* p = static_cast<Predictor*>(h);
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(p->obj, "run", nullptr);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int pt_predictor_get_output(pt_predictor_t h, int fetch_idx, float** out_data,
+                            int64_t** out_dims, int* out_ndim) {
+  auto* p = static_cast<Predictor*>(h);
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(p->obj, "output", "i", fetch_idx);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* raw = PyTuple_GetItem(r, 0);
+  PyObject* dims = PyTuple_GetItem(r, 1);
+  char* buf = nullptr;
+  Py_ssize_t nbytes = 0;
+  if (PyBytes_AsStringAndSize(raw, &buf, &nbytes) != 0) {
+    set_error_from_python();
+    Py_DECREF(r);
+    return -1;
+  }
+  Py_ssize_t nd = PyList_Size(dims);
+  auto* data = static_cast<float*>(std::malloc(nbytes));
+  std::memcpy(data, buf, static_cast<size_t>(nbytes));
+  auto* dd = static_cast<int64_t*>(std::malloc(sizeof(int64_t) * nd));
+  for (Py_ssize_t i = 0; i < nd; ++i) {
+    dd[i] = PyLong_AsLongLong(PyList_GetItem(dims, i));
+  }
+  Py_DECREF(r);
+  *out_data = data;
+  *out_dims = dd;
+  *out_ndim = static_cast<int>(nd);
+  return 0;
+}
+
+void pt_buffer_free(void* ptr) { std::free(ptr); }
+
+void pt_predictor_destroy(pt_predictor_t h) {
+  auto* p = static_cast<Predictor*>(h);
+  if (p == nullptr) return;
+  {
+    Gil gil;
+    Py_XDECREF(p->obj);
+  }
+  delete p;
+}
+
+const char* pt_last_error(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

@@ -1,0 +1,63 @@
+/* C inference API (role of the reference's deploy surfaces:
+ * paddle/fluid/inference/io.h:32 Load() + paddle/capi/gradient_machine.h).
+ *
+ * A C/C++ application links libpaddle_tpu_capi.so and runs a model saved
+ * with fluid.save_inference_model WITHOUT writing any Python. The library
+ * hosts the runtime in-process via the embedded CPython interpreter (the
+ * reference's capi hosts its C++ core the same way: the deploy contract is
+ * the C ABI, not the implementation language underneath). The XLA compute
+ * path is identical to the Python API's.
+ *
+ * Requirements: paddle_tpu importable by the embedded interpreter — set
+ * PYTHONPATH in the host process environment before the first
+ * pt_predictor_create call.
+ *
+ * Thread-safety: calls serialize on the interpreter's GIL; one predictor
+ * may be shared by threads (role of inference/tests/book/
+ * test_multi_thread_helper.h).
+ */
+#ifndef PADDLE_TPU_INFERENCE_CAPI_H_
+#define PADDLE_TPU_INFERENCE_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* pt_predictor_t;
+
+/* Load a save_inference_model directory. NULL on failure (see
+ * pt_last_error). */
+pt_predictor_t pt_predictor_create(const char* model_dir);
+
+/* Number of feed / fetch slots of the loaded program. */
+int pt_predictor_num_feeds(pt_predictor_t p);
+int pt_predictor_num_fetches(pt_predictor_t p);
+/* Name of feed slot i (pointer owned by the predictor). */
+const char* pt_predictor_feed_name(pt_predictor_t p, int i);
+
+/* Set float32 input for feed slot `feed_idx` (values copied). */
+int pt_predictor_set_input(pt_predictor_t p, int feed_idx,
+                           const float* data, const int64_t* dims, int ndim);
+
+/* Run the program over the staged inputs. */
+int pt_predictor_run(pt_predictor_t p);
+
+/* Fetch float32 output `fetch_idx` produced by the last run. The buffers
+ * are malloc'd; release both with pt_buffer_free. */
+int pt_predictor_get_output(pt_predictor_t p, int fetch_idx,
+                            float** out_data, int64_t** out_dims,
+                            int* out_ndim);
+
+void pt_buffer_free(void* ptr);
+void pt_predictor_destroy(pt_predictor_t p);
+
+/* Last error message of the calling thread's most recent failed call. */
+const char* pt_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_INFERENCE_CAPI_H_ */

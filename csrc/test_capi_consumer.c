@@ -1,0 +1,54 @@
+/* Minimal C consumer of the inference C API (role of the reference's
+ * inference/tests/book C++ tests): loads a save_inference_model dir given
+ * as argv[1], feeds a fixed input, prints the output values. */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "inference_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_dir>\n", argv[0]);
+    return 2;
+  }
+  pt_predictor_t p = pt_predictor_create(argv[1]);
+  if (p == NULL) {
+    fprintf(stderr, "create failed: %s\n", pt_last_error());
+    return 1;
+  }
+  printf("feeds=%d fetches=%d feed0=%s\n", pt_predictor_num_feeds(p),
+         pt_predictor_num_fetches(p), pt_predictor_feed_name(p, 0));
+
+  /* 2 rows of the 13-feature housing input: 0.0 .. 2.5 step 0.1 */
+  float in[26];
+  for (int i = 0; i < 26; ++i) in[i] = 0.1f * (float)i;
+  int64_t dims[2] = {2, 13};
+  if (pt_predictor_set_input(p, 0, in, dims, 2) != 0) {
+    fprintf(stderr, "set_input failed: %s\n", pt_last_error());
+    return 1;
+  }
+  if (pt_predictor_run(p) != 0) {
+    fprintf(stderr, "run failed: %s\n", pt_last_error());
+    return 1;
+  }
+  float* out = NULL;
+  int64_t* odims = NULL;
+  int ondim = 0;
+  if (pt_predictor_get_output(p, 0, &out, &odims, &ondim) != 0) {
+    fprintf(stderr, "get_output failed: %s\n", pt_last_error());
+    return 1;
+  }
+  printf("out ndim=%d dims=[", ondim);
+  long long total = 1;
+  for (int i = 0; i < ondim; ++i) {
+    printf("%lld%s", (long long)odims[i], i + 1 < ondim ? "," : "");
+    total *= odims[i];
+  }
+  printf("]\nvalues:");
+  for (long long i = 0; i < total; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  pt_buffer_free(out);
+  pt_buffer_free(odims);
+  pt_predictor_destroy(p);
+  return 0;
+}

@@ -1,0 +1,80 @@
+"""C inference consumer (csrc/inference_capi.{h,cc}; reference
+paddle/fluid/inference/io.h:32 + paddle/capi): train + save a model from
+Python, then compile and run a pure-C program against
+libpaddle_tpu_capi.so and check its outputs match Python inference."""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CSRC = os.path.join(REPO, "csrc")
+
+
+def _save_model(tmp):
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    main.random_seed = startup.random_seed = 71
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[13], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="float32")
+            pred = layers.fc(input=x, size=1)
+            cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+        reader = paddle_tpu.batch(
+            paddle_tpu.dataset.uci_housing.train(), batch_size=20)
+        feeder = fluid.DataFeeder(feed_list=[x, y], program=main)
+        for i, data in enumerate(reader()):
+            if i >= 20:
+                break
+            exe.run(main, feed=feeder.feed(data), fetch_list=[cost])
+        model_dir = os.path.join(tmp, "model")
+        fluid.save_inference_model(model_dir, ["x"], [pred], exe, main)
+
+        xin = (0.1 * np.arange(26, dtype=np.float32)).reshape(2, 13)
+        prog2, feeds2, fetches2 = fluid.load_inference_model(
+            model_dir, exe)
+        (expect,) = exe.run(prog2, feed={feeds2[0]: xin},
+                            fetch_list=fetches2)
+    return model_dir, np.asarray(expect)
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_c_consumer_matches_python(tmp_path):
+    lib = os.path.join(CSRC, "libpaddle_tpu_capi.so")
+    r = subprocess.run(["make", "-C", CSRC, "capi"], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(lib)
+
+    model_dir, expect = _save_model(str(tmp_path))
+
+    exe_path = str(tmp_path / "consumer")
+    r = subprocess.run(
+        ["gcc", os.path.join(CSRC, "test_capi_consumer.c"),
+         "-I", CSRC, "-L", CSRC, "-lpaddle_tpu_capi",
+         f"-Wl,-rpath,{CSRC}", "-o", exe_path],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([exe_path, model_dir], capture_output=True, text=True,
+                       env=env, timeout=240)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr[-2000:]}"
+    assert "feeds=1 fetches=1 feed0=x" in r.stdout
+    values_line = [ln for ln in r.stdout.splitlines()
+                   if ln.startswith("values:")][0]
+    got = np.array([float(v) for v in values_line.split()[1:]])
+    np.testing.assert_allclose(got, expect.ravel(), rtol=1e-4, atol=1e-5)
