@@ -207,7 +207,11 @@ class ElectedMaster:
             snapshot_path=self._snapshot_path,
             snapshot_fence=self.lease.fenced, **self._service_kwargs)
         self.addr = self.service.serve(host=self._host, port=0)
-        self.lease.renew(self.addr)
+        if not self.lease.renew(self.addr):
+            # startup (snapshot recovery / bind) outlasted the TTL and a
+            # standby took the lease — we are NOT the leader; raising here
+            # routes through _run's failure path (shutdown + retry)
+            raise RuntimeError("lease lost during leader startup")
         self.is_leader.set()
 
     def _step_down(self, release: bool):

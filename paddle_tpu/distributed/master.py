@@ -206,17 +206,27 @@ class MasterService:
         }
         payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
         blob = struct.pack("<I", zlib.crc32(payload)) + payload
-        tmp = self._snapshot_path + ".tmp"
+        # per-process unique tmp: on shared storage a deposed leader writing
+        # a FIXED tmp path could corrupt the new leader's in-flight commit
+        # (the fence only guards the rename)
+        tmp = f"{self._snapshot_path}.tmp.{os.getpid()}.{id(self):x}"
         with open(tmp, "wb") as f:
             f.write(blob)
 
         def _commit():
             os.replace(tmp, self._snapshot_path)
 
-        if self._snapshot_fence is not None:
-            self._snapshot_fence(_commit)  # raises MasterDeposed when stale
-        else:
-            _commit()
+        try:
+            if self._snapshot_fence is not None:
+                self._snapshot_fence(_commit)  # raises MasterDeposed if stale
+            else:
+                _commit()
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
 
     def _recover(self):
         with open(self._snapshot_path, "rb") as f:

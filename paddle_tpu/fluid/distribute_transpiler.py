@@ -122,20 +122,57 @@ class DistributeTranspiler:
         return [n for n, ep in self.param_assignment.items() if ep == endpoint]
 
     def get_pserver_program(self, endpoint: str) -> Program:
-        """The slice of work a pserver at `endpoint` would own: its params
-        and the optimize ops updating them (reference builds these as
-        sub-blocks behind listen_and_serv, :263)."""
+        """The slice of work a pserver at `endpoint` would own: its params'
+        optimize ops PLUS their transitive dependency chain on the optimize
+        side — learning-rate decay schedules, step counters, accumulator
+        setup (the reference builds exactly these as per-param sub-blocks
+        behind listen_and_serv, :263, and moves the LR-decay ops to the
+        pserver). Gradients are the boundary: ops consuming @GRAD values
+        stay trainer-side (in the reference the trainer sends them; here
+        the psum the SPMD partitioner inserts plays that role), so the
+        closure stops at gradient inputs."""
+        from .framework import grad_var_name  # noqa: F401  (doc anchor)
+
         owned = set(self._owned_params(endpoint))
         pruned = self._program.clone()
         block = pruned.global_block()
-        keep_ops = []
-        used = set(owned)
-        for op in block.ops:
+
+        def is_grad_name(n):
+            return "@GRAD" in n
+
+        # seed: ops updating an owned param in place
+        keep = set()
+        needed = set()
+        for i, op in enumerate(block.ops):
             outs = set(op.desc.output_names())
-            # optimize ops update a param in place
             if outs & owned:
-                keep_ops.append(op)
-                used.update(n for n in op.desc.input_names() if n)
+                keep.add(i)
+                needed.update(
+                    n for n in op.desc.input_names()
+                    if n and not is_grad_name(n) and n not in owned
+                )
+        # backward closure over producers of needed values: pulls in the
+        # LR-schedule chain (counters, decay arithmetic) but not the
+        # forward/backward graph — any op touching a gradient stays on the
+        # trainer side of the send boundary
+        for i in range(len(block.ops) - 1, -1, -1):
+            if i in keep:
+                continue
+            op = block.ops[i]
+            outs = set(n for n in op.desc.output_names() if n)
+            if not (outs & needed):
+                continue
+            ins = [n for n in op.desc.input_names() if n]
+            if any(is_grad_name(n) for n in ins + list(outs)):
+                continue
+            keep.add(i)
+            needed.update(n for n in ins if n not in owned)
+
+        keep_ops = [op for i, op in enumerate(block.ops) if i in keep]
+        used = set(owned)
+        for op in keep_ops:
+            used.update(n for n in op.desc.input_names() if n)
+            used.update(n for n in op.desc.output_names() if n)
         block.ops = keep_ops
         block.vars = {n: v for n, v in block.vars.items() if n in used}
         return pruned

@@ -449,3 +449,54 @@ def test_deposed_master_severs_client_connections(tmp_path):
     with pytest.raises(ConnectionError):
         client.stats()
     client.close()
+
+
+def test_pserver_program_includes_lr_decay_chain():
+    """The pserver slice must contain the optimize ops AND their LR-decay
+    dependency chain (reference moves decay ops to the pserver,
+    distribute_transpiler.py:263); forward/backward ops and anything
+    consuming gradients stay trainer-side."""
+    from paddle_tpu.fluid import unique_name
+    from paddle_tpu.fluid.framework import program_guard
+
+    with unique_name.guard():
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[4], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="float32")
+            h = layers.fc(input=x, size=8, act="relu",
+                          param_attr=fluid.ParamAttr(name="pw1"),
+                          bias_attr=fluid.ParamAttr(name="pb1"))
+            p = layers.fc(input=h, size=1,
+                          param_attr=fluid.ParamAttr(name="pw2"),
+                          bias_attr=fluid.ParamAttr(name="pb2"))
+            cost = layers.mean(layers.square_error_cost(input=p, label=y))
+            lr = layers.exponential_decay(learning_rate=0.1, decay_steps=10,
+                                          decay_rate=0.9, staircase=True)
+            fluid.optimizer.Momentum(learning_rate=lr,
+                                     momentum=0.9).minimize(cost)
+
+        t = fluid.DistributeTranspiler()
+        eps = ["ps0:6174", "ps1:6174"]
+        t.transpile(trainer_id=0, program=main, startup_program=startup,
+                    pservers=",".join(eps), trainers=2)
+
+        all_owned = []
+        for ep in eps:
+            prog = t.get_pserver_program(ep)
+            ops = [op.desc.type for op in prog.global_block().ops]
+            owned = {n for n, e in t.param_assignment.items() if e == ep}
+            all_owned.extend(owned)
+            assert owned, ep
+            # optimizer ops for every owned param
+            assert ops.count("momentum") == len(owned), (ep, ops)
+            # the LR-decay chain came along (counter + decay arithmetic)
+            assert "increment" in ops or "autoincreased_step_counter" in ops \
+                or any("decay" in o or o in ("elementwise_div", "floor",
+                                             "elementwise_pow", "scale")
+                       for o in ops), ops
+            # no forward / backward ops leak in
+            assert "mul" not in ops and "square_error_cost" not in ops
+            assert not any(o.endswith("_grad") for o in ops)
+        assert sorted(all_owned) == sorted(
+            ["pw1", "pb1", "pw2", "pb2"])

@@ -1,0 +1,93 @@
+"""REAL multi-process cluster test for distributed/env.py: two OS processes
+form a jax.distributed CPU cluster (coordinator + worker, the role of the
+reference's localhost send/recv tests, test_recv_op.py:26), build a global
+mesh spanning both processes, and run an all-reduce across them.
+
+Each worker process trains one data-parallel shard of a step and psums the
+gradient over the cluster — the DCN-spanning path of SURVEY.md §5.8."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    # each process gets 2 local CPU devices -> 4 global over 2 processes
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    sys.path.insert(0, os.environ["REPO_ROOT"])
+    from paddle_tpu.distributed import init_distributed, global_mesh
+
+    info = init_distributed(
+        coordinator_address=os.environ["COORDINATOR_ADDRESS"],
+        num_processes=2,
+        process_id=int(os.environ["PROCESS_ID"]),
+    )
+    assert info["num_processes"] == 2, info
+    assert info["global_device_count"] == 4, info
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = global_mesh({"dp": 4})
+    # per-process shard of a global batch: 4 rows, one per device
+    pid = info["process_id"]
+
+    @jax.jit
+    def global_sum(x):
+        # sharded over dp -> jnp.sum is a cross-process all-reduce
+        return jnp.sum(x, axis=0)
+
+    rows = jnp.arange(4 * 3, dtype=jnp.float32).reshape(4, 3)
+    sharding = NamedSharding(mesh, P("dp", None))
+    local = jax.device_put(rows, sharding)  # local shard via process-local rows
+    out = global_sum(local)
+    expect = rows.sum(axis=0)
+    got = jax.device_get(out)
+    assert abs(got - expect).max() < 1e-6, (got, expect)
+    print(f"WORKER_{pid}_OK", flush=True)
+""")
+
+
+def test_two_process_cpu_cluster(tmp_path):
+    # pick a free port for the coordinator
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    coord = f"127.0.0.1:{port}"
+
+    env_base = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = []
+    for pid in range(2):
+        env = dict(env_base)
+        env["COORDINATOR_ADDRESS"] = coord
+        env["PROCESS_ID"] = str(pid)
+        env["REPO_ROOT"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=150)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for pid, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"worker {pid} rc={rc}\nstdout:{out}\nstderr:{err[-3000:]}"
+        assert f"WORKER_{pid}_OK" in out
