@@ -48,7 +48,7 @@ def data(name, type: _DataType, **kwargs):
 
 
 def fc_layer(input, size, act=None, **kwargs):
-    return _fl.fc(input=input, size=size, act=act, **kwargs)
+    return _fl.fc(input=input, size=size, act=_act_name(act), **kwargs)
 
 
 def embedding_layer(input, size, vocab_size=None, **kwargs):
@@ -67,7 +67,7 @@ def embedding_layer(input, size, vocab_size=None, **kwargs):
 
 def mixed_layer(input, size, act=None, **kwargs):
     ins = input if isinstance(input, (list, tuple)) else [input]
-    return _fl.fc(input=list(ins), size=size, act=act)
+    return _fl.fc(input=list(ins), size=size, act=_act_name(act))
 
 
 def classification_cost(input, label):
@@ -82,14 +82,6 @@ def cross_entropy_cost(input, label):
     return classification_cost(input, label)
 
 
-# direct fluid passthroughs under their v2 names
-conv_layer = _fl.conv2d
-pooling_layer = _fl.pool2d
-batch_norm_layer = _fl.batch_norm
-dropout_layer = _fl.dropout
-concat_layer = None  # set below (needs list signature)
-
-
 def _concat(input, **kwargs):
     from ..fluid.layers import tensor as _t
 
@@ -97,3 +89,205 @@ def _concat(input, **kwargs):
 
 
 concat_layer = _concat
+
+
+# --- activation / pooling namespaces (reference trainer_config_helpers
+# activations.py / poolings.py: layer args take ReluActivation() /
+# MaxPooling() instances) ---------------------------------------------------
+
+
+class _Act:
+    def __init__(self, name):
+        self.name = name
+
+
+class activation:
+    """reference paddle.v2.activation.*"""
+
+    Relu = staticmethod(lambda: _Act("relu"))
+    Sigmoid = staticmethod(lambda: _Act("sigmoid"))
+    Tanh = staticmethod(lambda: _Act("tanh"))
+    Softmax = staticmethod(lambda: _Act("softmax"))
+    Linear = staticmethod(lambda: _Act(None))
+    Identity = staticmethod(lambda: _Act(None))
+
+
+class _Pool:
+    def __init__(self, kind):
+        self.kind = kind
+
+
+class pooling:
+    """reference paddle.v2.pooling.* (sequence poolings)."""
+
+    Max = staticmethod(lambda: _Pool("max"))
+    Avg = staticmethod(lambda: _Pool("average"))
+    Sum = staticmethod(lambda: _Pool("sum"))
+    SquareRootN = staticmethod(lambda: _Pool("sqrt"))
+
+
+def _act_name(act):
+    return act.name if isinstance(act, _Act) else act
+
+
+# --- sequence layers (reference trainer_config_helpers/layers.py:
+# last_seq, first_seq, pooling_layer, lstmemory, grumemory, simple_lstm,
+# simple_gru, expand_layer) -------------------------------------------------
+
+
+def last_seq(input, **kwargs):
+    return _fl.sequence_last_step(input)
+
+
+def first_seq(input, **kwargs):
+    return _fl.sequence_first_step(input)
+
+
+def pooling_layer(input, pooling_type=None, **kwargs):
+    """Sequence pooling (reference pooling_layer) — NOT image pooling
+    (that's img_pool_layer)."""
+    kind = pooling_type.kind if isinstance(pooling_type, _Pool) else (
+        pooling_type or "max")
+    return _fl.sequence_pool(input=input, pool_type=kind)
+
+
+def lstmemory(input, size=None, reverse=False, act=None, **kwargs):
+    """reference lstmemory: `size` is the HIDDEN width; the input must
+    carry 4*size projected features (pair with fc_layer, as
+    trainer_config_helpers documents). Default size = input_width // 4."""
+    width = int(input.shape[-1])
+    if size is None:
+        size = width // 4
+    if width != size * 4:
+        raise ValueError(
+            f"lstmemory(size={size}) needs an input of width {size * 4} "
+            f"(4*size projected features), got {width}")
+    h, _ = _fl.dynamic_lstm(input=input, size=size * 4, is_reverse=reverse)
+    return h
+
+
+def simple_lstm(input, size, reverse=False, **kwargs):
+    """reference networks.simple_lstm: fc projection + lstmemory."""
+    proj = _fl.fc(input=input, size=size * 4, num_flatten_dims=2)
+    h, _ = _fl.dynamic_lstm(input=proj, size=size * 4, is_reverse=reverse)
+    return h
+
+
+def grumemory(input, size=None, reverse=False, **kwargs):
+    """`size` is the hidden width; input carries 3*size projected gates."""
+    width = int(input.shape[-1])
+    if size is None:
+        size = width // 3
+    if width != size * 3:
+        raise ValueError(
+            f"grumemory(size={size}) needs an input of width {size * 3} "
+            f"(3*size projected gates), got {width}")
+    return _fl.dynamic_gru(input=input, size=size, is_reverse=reverse)
+
+
+def simple_gru(input, size, reverse=False, **kwargs):
+    proj = _fl.fc(input=input, size=size * 3, num_flatten_dims=2)
+    return _fl.dynamic_gru(input=proj, size=size, is_reverse=reverse)
+
+
+def expand_layer(input, expand_as, **kwargs):
+    return _fl.sequence_expand(input, expand_as)
+
+
+# --- image layers (reference img_conv_layer / img_pool_layer /
+# simple_img_conv_pool) -----------------------------------------------------
+
+
+def img_conv_layer(input, filter_size, num_filters, stride=1, padding=0,
+                   act=None, **kwargs):
+    return _fl.conv2d(input=input, num_filters=num_filters,
+                      filter_size=filter_size, stride=stride,
+                      padding=padding, act=_act_name(act))
+
+
+def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
+                   **kwargs):
+    kind = pool_type.kind if isinstance(pool_type, _Pool) else (
+        pool_type or "max")
+    if kind not in ("max", "avg", "average"):
+        kind = "max"
+    return _fl.pool2d(input=input, pool_size=pool_size, pool_stride=stride,
+                      pool_padding=padding,
+                      pool_type="avg" if kind != "max" else "max")
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, **kwargs):
+    from ..fluid import nets as _nets
+
+    return _nets.simple_img_conv_pool(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        pool_size=pool_size, pool_stride=pool_stride, act=_act_name(act))
+
+
+# --- elementwise / misc layers --------------------------------------------
+
+
+def addto_layer(input, act=None, **kwargs):
+    from ..fluid.layers import tensor as _t
+
+    out = _t.sums(list(input))
+    name = _act_name(act)
+    if name:
+        out = getattr(_fl, name)(out)
+    return out
+
+
+def cos_sim(a, b, **kwargs):
+    return _fl.cos_sim(X=a, Y=b)
+
+
+def scaling_layer(input, weight, **kwargs):
+    return _fl.elementwise_mul(input, weight)
+
+
+def slope_intercept_layer(input, slope=1.0, intercept=0.0, **kwargs):
+    return _fl.scale(input, scale=float(slope), bias=float(intercept))
+
+
+def trans_layer(input, **kwargs):
+    return _fl.transpose(input, perm=[1, 0])
+
+
+def maxid_layer(input, **kwargs):
+    from ..fluid.layers import tensor as _t
+
+    return _t.argmax(input, axis=-1)
+
+
+def dropout_layer(input, dropout_rate, **kwargs):
+    return _fl.dropout(input, dropout_prob=dropout_rate)
+
+
+batch_norm_layer = _fl.batch_norm
+conv_layer = img_conv_layer
+
+
+# --- cost layers (reference classification_cost / regression_cost /
+# crf_layer / ctc_layer / rank_cost) ---------------------------------------
+
+
+def regression_cost(input, label, **kwargs):
+    return square_error_cost(input, label)
+
+
+def mse_cost(input, label, **kwargs):
+    return square_error_cost(input, label)
+
+
+def crf_layer(input, label, param_attr=None, **kwargs):
+    return _fl.linear_chain_crf(input=input, label=label,
+                                param_attr=param_attr)
+
+
+def crf_decoding_layer(input, param_attr, label=None, **kwargs):
+    return _fl.crf_decoding(input=input, param_attr=param_attr, label=label)
+
+
+def softmax_layer(input, **kwargs):
+    return _fl.softmax(input)

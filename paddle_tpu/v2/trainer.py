@@ -22,6 +22,9 @@ def _data_var_names(block):
     return [
         n for n, v in block.vars.items()
         if n in used and n not in produced and not v.persistable
+        # @LEN lengths companions ride along with their padded var — the
+        # DataFeeder emits both from the one ragged sample slot
+        and not n.endswith("@LEN")
     ]
 
 

@@ -81,3 +81,113 @@ def test_v2_parameters_tar_roundtrip(tmp_path):
             for n in names:
                 params.set(n, data[n])
                 np.testing.assert_array_equal(params.get(n), old[n])
+
+
+def test_v2_layer_dsl_surface():
+    """trainer_config_helpers-style DSL: sequence memories, image conv,
+    poolings, activations, costs — all composing into one trainable
+    topology (reference trainer_config_helpers/layers.py)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import unique_name
+    from paddle_tpu.fluid.framework import Program, program_guard
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup), unique_name.guard():
+            words = paddle.layer.data(
+                name="words",
+                type=paddle.layer.data_type.integer_value_sequence(100),
+                lod_level=1)
+            label = paddle.layer.data(
+                name="label", type=paddle.layer.data_type.integer_value(2))
+            emb = paddle.layer.embedding_layer(input=words, size=16)
+            lstm = paddle.layer.simple_lstm(input=emb, size=8)
+            gru = paddle.layer.simple_gru(input=emb, size=8)
+            lstm_last = paddle.layer.last_seq(input=lstm)
+            gru_pool = paddle.layer.pooling_layer(
+                input=gru, pooling_type=paddle.pooling.Max())
+            merged = paddle.layer.concat_layer([lstm_last, gru_pool], axis=1)
+            hid = paddle.layer.fc_layer(
+                input=merged, size=16, act=paddle.activation.Relu())
+            prob = paddle.layer.fc_layer(
+                input=hid, size=2, act=paddle.activation.Softmax())
+            cost = paddle.layer.classification_cost(input=prob, label=label)
+
+            parameters = paddle.create(cost)
+            trainer = paddle.SGD(
+                cost=cost, parameters=parameters,
+                update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
+
+        rng = np.random.RandomState(0)
+
+        def reader():
+            for _ in range(6):
+                batch = []
+                for _ in range(16):
+                    n = int(rng.randint(3, 9))
+                    w = rng.randint(3, 100, size=n).tolist()
+                    batch.append((w, [int(w[0] % 2)]))
+                yield batch
+
+        costs = []
+        trainer.train(
+            reader=reader, num_passes=3,
+            event_handler=lambda e: costs.append(e.cost)
+            if isinstance(e, paddle.event.EndIteration) else None)
+        assert np.isfinite(costs[-1])
+        assert min(costs[1:]) < costs[0], (costs[0], costs[-1])
+
+
+def test_v2_topology_serialize_roundtrip(tmp_path):
+    """Topology round trip (reference topology.Topology +
+    serialize_for_inference): DSL -> serialize -> deserialize -> infer in a
+    fresh scope with transplanted parameters."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import unique_name
+    from paddle_tpu.fluid.framework import Program, program_guard
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup), unique_name.guard():
+            x = paddle.layer.data(
+                name="x", type=paddle.layer.data_type.dense_vector(4))
+            label = paddle.layer.data(
+                name="label", type=paddle.layer.data_type.integer_value(2))
+            h = paddle.layer.fc_layer(input=x, size=8,
+                                      act=paddle.activation.Tanh())
+            out = paddle.layer.fc_layer(input=h, size=2,
+                                        act=paddle.activation.Softmax())
+            cost = paddle.layer.classification_cost(input=out, label=label)
+            parameters = paddle.create(cost)
+            import paddle_tpu.fluid as _fluid
+            _fluid.optimizer.Adam(learning_rate=1e-3).minimize(cost)
+
+            # topology prunes to the OUTPUT layers: cost/backward/optimizer
+            # ops must not ship (reference serialize_for_inference)
+            topo = paddle.Topology(out)
+            assert topo.data_names() == ["x"]          # no label feed
+            assert topo.output_names() == [out.name]
+            ship_ops = [op.desc.type
+                        for op in topo.main_program.global_block().ops]
+            assert "cross_entropy" not in ship_ops
+            assert "adam" not in ship_ops
+            assert not any(o.endswith("_grad") for o in ship_ops)
+            blob = topo.serialize()
+
+        xin = np.random.RandomState(1).rand(3, 4).astype(np.float32)
+        exe = fluid.Executor()
+        (expect,) = exe.run(topo.main_program, feed={"x": xin},
+                            fetch_list=topo.layers)
+
+    # fresh world: rebuild from bytes, transplant parameter values
+    topo2 = paddle.Topology.deserialize(blob)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor()
+        exe2.run(topo2.startup_program)
+        for name in parameters.names():
+            scope2.set_var(name, parameters.get(name))
+        (got,) = exe2.run(topo2.main_program, feed={"x": xin},
+                          fetch_list=topo2.layers)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=1e-5, atol=1e-6)
