@@ -151,8 +151,12 @@ def child_main():
     from paddle_tpu.models import resnet
 
     # bf16 matmul/conv on the MXU (f32 params/master weights), the standard
-    # TPU training configuration; numerics-sensitive paths keep f32 via dtypes
-    set_flags({"matmul_precision": "default"})
+    # TPU training configuration; numerics-sensitive paths keep f32 via
+    # dtypes. FLAGS['amp'] casts conv/matmul operands to bf16 (one MXU pass
+    # instead of the f32 3-pass decomposition; f32 accumulate inside the
+    # MXU). Override with BENCH_AMP=0 for the pure-f32 configuration.
+    set_flags({"matmul_precision": "default",
+               "amp": os.environ.get("BENCH_AMP", "1") == "1"})
 
     main_prog, startup, scope = Program(), Program(), fluid.Scope()
     with fluid.scope_guard(scope):

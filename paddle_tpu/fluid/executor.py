@@ -187,7 +187,9 @@ class Executor:
         feed_sig = tuple(
             sorted((k, tuple(v.shape), str(v.dtype)) for k, v in feed_arrays.items())
         )
-        cache_key = (program._version, feed_sig, fetch_names)
+        from .flags import trace_flags
+
+        cache_key = (program._version, feed_sig, fetch_names, trace_flags())
 
         prog_cache = self._cache.setdefault(program, {})
         entry = prog_cache.get(cache_key) if use_program_cache else None

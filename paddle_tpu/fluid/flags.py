@@ -23,6 +23,10 @@ FLAGS: Dict[str, Any] = {
     # layer norm): 'auto' = on when running on TPU; True forces them on
     # (interpret-mode off-TPU, slow — tests only); False = plain XLA
     "use_pallas_kernels": "auto",
+    # mixed precision: bf16 MXU operands with f32 accumulation for
+    # conv/matmul (master weights and the rest of the graph stay f32) —
+    # the standard TPU training configuration
+    "amp": False,
 }
 
 
@@ -64,3 +68,11 @@ def init_gflags(args=None):
             elif v in ("false", "False"):
                 v = False
             set_flags({k: v})
+
+
+def trace_flags() -> tuple:
+    """Flags that change what gets TRACED (and therefore compiled): any
+    executor jit-cache key must include them, or toggling a flag after the
+    first run of a program would be silently ignored."""
+    return (FLAGS["matmul_precision"], FLAGS["use_pallas_kernels"],
+            FLAGS["amp"])

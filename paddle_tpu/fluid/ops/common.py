@@ -33,3 +33,20 @@ def bcast_y(x, y, axis: int):
 
 def dtype_of(attrs, key="dtype", default="float32"):
     return as_jnp_dtype(attrs.get(key, default))
+
+
+def amp_operands(x, w):
+    """Mixed-precision MXU path (FLAGS['amp']): cast float32 matmul/conv
+    operands to bfloat16 — one MXU pass instead of the 3-pass f32
+    decomposition. The op output comes back bf16 and the caller casts it
+    to the returned `restore` dtype (the MXU still accumulates in f32
+    internally; master weights are untouched — standard TPU AMP). The
+    round trip keeps the whole vjp in one dtype, which JAX's conv
+    transpose rule requires. No-op (restore None) when amp is off or
+    operands aren't f32."""
+    from ..flags import FLAGS
+
+    if (FLAGS.get("amp") and x.dtype == jnp.float32
+            and w.dtype == jnp.float32):
+        return x.astype(jnp.bfloat16), w.astype(jnp.bfloat16), jnp.float32
+    return x, w, None

@@ -9,7 +9,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..registry import register_op
-from .common import many, one
+from .common import amp_operands, many, one
 
 
 def _flatten2(x, num_col_dims: int):
@@ -24,7 +24,10 @@ def mul(ctx, ins, attrs):
     yn = int(attrs.get("y_num_col_dims", 1))
     x2 = _flatten2(x, xn)
     y2 = jnp.reshape(y, (int(np.prod(y.shape[:yn])), -1))
+    x2, y2, restore = amp_operands(x2, y2)
     out = jnp.matmul(x2, y2)
+    if restore is not None:
+        out = out.astype(restore)
     out_shape = tuple(x.shape[:xn]) + tuple(y.shape[yn:])
     return {"Out": jnp.reshape(out, out_shape)}
 
@@ -42,7 +45,10 @@ def matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
+    x, y, restore = amp_operands(x, y)
     out = jnp.matmul(x, y)
+    if restore is not None:
+        out = out.astype(restore)
     if alpha != 1.0:
         out = out * alpha
     return {"Out": out}

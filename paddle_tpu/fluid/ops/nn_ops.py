@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ..registry import register_op
-from .common import one
+from .common import amp_operands, one
 
 
 def _pair(v, n=2):
@@ -29,6 +29,7 @@ def conv2d(ctx, ins, attrs):
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = int(attrs.get("groups", 1) or 1)
+    x, w, restore = amp_operands(x, w)
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=strides,
@@ -37,6 +38,8 @@ def conv2d(ctx, ins, attrs):
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
     )
+    if restore is not None:
+        out = out.astype(restore)
     return {"Output": out}
 
 
@@ -55,6 +58,7 @@ def conv3d(ctx, ins, attrs):
     paddings = _pair(attrs.get("paddings", [0, 0, 0]), 3)
     dilations = _pair(attrs.get("dilations", [1, 1, 1]), 3)
     groups = int(attrs.get("groups", 1) or 1)
+    x, w, restore = amp_operands(x, w)
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides,
         padding=[(p, p) for p in paddings],
@@ -62,12 +66,15 @@ def conv3d(ctx, ins, attrs):
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
         feature_group_count=groups,
     )
+    if restore is not None:
+        out = out.astype(restore)
     return {"Output": out}
 
 
 @register_op("conv2d_transpose", ref="paddle/fluid/operators/conv_transpose_op.cc")
 def conv2d_transpose(ctx, ins, attrs):
     x, w = one(ins, "Input"), one(ins, "Filter")
+    x, w, restore = amp_operands(x, w)
     strides = _pair(attrs.get("strides", [1, 1]))
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
@@ -86,6 +93,8 @@ def conv2d_transpose(ctx, ins, attrs):
         dimension_numbers=("NCHW", "IOHW", "NCHW"),
         transpose_kernel=True,
     )
+    if restore is not None:
+        out = out.astype(restore)
     return {"Output": out}
 
 

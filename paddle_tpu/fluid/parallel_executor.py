@@ -125,7 +125,10 @@ class ParallelExecutor:
         feed_sig = tuple(
             sorted((k, tuple(v.shape), str(v.dtype)) for k, v in feed_arrays.items())
         )
-        cache_key = (id(program), program._version, feed_sig, fetch_names)
+        from .flags import trace_flags
+
+        cache_key = (id(program), program._version, feed_sig, fetch_names,
+                     trace_flags())
         entry = self._cache.get(cache_key)
         if entry is None:
             state_in, state_out = _block_io(block, set(feed_arrays), self._scope)

@@ -85,3 +85,40 @@ def test_executor_program_cache():
         exe.run(main, feed={"x": np.ones((3, 4), dtype=np.float32)},
                 fetch_list=[y])
         assert len(exe._cache[main]) == n_cached + 1  # new shape, new entry
+
+
+def test_trace_flags_in_jit_cache_key():
+    """Toggling a trace-affecting flag (amp) after a program has run must
+    recompile, not silently reuse the stale executable."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.flags import set_flags
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[8], dtype="float32")
+            w = layers.create_parameter(shape=[8, 8], dtype="float32",
+                                        name="cache_w")
+            out = layers.mul(x, w)
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+        (o32,) = exe.run(main, feed={"x": xv}, fetch_list=[out],
+                         return_numpy=False)
+        set_flags({"amp": True})
+        try:
+            (oamp,) = exe.run(main, feed={"x": xv}, fetch_list=[out],
+                              return_numpy=False)
+        finally:
+            set_flags({"amp": False})
+        # amp result is the bf16-rounded product — different bits than f32
+        # (if the cache ignored the flag these would be identical arrays)
+        a, b = np.asarray(o32), np.asarray(oamp)
+        ref32 = xv @ np.asarray(scope.find_var("cache_w"))
+        refbf = (xv.astype(jnp.bfloat16) @ np.asarray(
+            scope.find_var("cache_w")).astype(jnp.bfloat16)).astype(
+                np.float32)
+        np.testing.assert_allclose(a, ref32, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(b, refbf, rtol=1e-5, atol=1e-6)
+        assert not np.array_equal(a, b)

@@ -99,3 +99,41 @@ def test_transformer_copy_task_converges():
             )[0][0]))
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_amp_flag_trains_lenet():
+    """FLAGS['amp']: bf16 MXU operands / f32 accumulation. The model must
+    still converge and master weights must stay float32."""
+    import paddle_tpu
+    from paddle_tpu.fluid.flags import set_flags
+    from paddle_tpu.models import lenet
+
+    set_flags({"amp": True})
+    try:
+        main, startup, scope = Program(), Program(), fluid.Scope()
+        main.random_seed = startup.random_seed = 9
+        with fluid.scope_guard(scope):
+            with program_guard(main, startup):
+                img = layers.data(name="img", shape=[1, 28, 28],
+                                  dtype="float32")
+                label = layers.data(name="label", shape=[1], dtype="int64")
+                avg_cost, acc, _ = lenet.build(img, label)
+                fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+            exe = fluid.Executor()
+            exe.run(startup)
+            reader = paddle_tpu.batch(paddle_tpu.dataset.mnist.train(),
+                                      batch_size=64)
+            feeder = fluid.DataFeeder(feed_list=[img, label], program=main)
+            losses = []
+            for i, data in enumerate(reader()):
+                if i >= 12:
+                    break
+                (l,) = exe.run(main, feed=feeder.feed(data),
+                               fetch_list=[avg_cost])
+                losses.append(float(np.asarray(l).reshape(-1)[0]))
+            assert np.isfinite(losses[-1])
+            assert min(losses[1:]) < losses[0], losses
+            w = scope.find_var(main.global_block().all_parameters()[0].name)
+            assert str(np.asarray(w).dtype) == "float32"
+    finally:
+        set_flags({"amp": False})
