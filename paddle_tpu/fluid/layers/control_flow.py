@@ -18,6 +18,7 @@ __all__ = [
     "While", "StaticRNN", "DynamicRNN", "IfElse", "ConditionalBlock",
     "Switch", "increment", "array_write", "array_read", "array_length",
     "create_array", "less_than", "equal", "zeros_like_array", "Print",
+    "lod_rank_table", "reorder_lod_tensor_by_rank",
 ]
 
 
@@ -90,6 +91,56 @@ def array_length(array):
     helper.append_op(
         type="array_length", inputs={"X": [array]}, outputs={"Out": [out]},
     )
+    return out
+
+
+def lod_rank_table(x, level=0):
+    """Index permutation sorting the batch by descending sequence length
+    (reference layers/control_flow.py lod_rank_table; the LoDRankTable's
+    role on the padded stack — see ops/sequence_ops.py)."""
+    from .sequence import seq_lengths_of
+
+    if level != 0:
+        raise ValueError(
+            "the padded stack has a single ragged level; lod_rank_table "
+            f"(level={level}) has no nested-LoD equivalent")
+    lens = seq_lengths_of(x)
+    if lens is None:
+        raise ValueError(
+            "lod_rank_table needs a sequence input (padded var with a "
+            "lengths companion, e.g. from layers.data(lod_level=1))")
+    helper = LayerHelper("lod_rank_table")
+    out = helper.create_variable_for_type_inference("int32")
+    out.stop_gradient = True
+    helper.append_op(
+        type="lod_rank_table", inputs={"X": [x], "Lengths": [lens]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Gather the batch rows into rank order; the lengths companion is
+    reordered alongside (reference reorder_lod_tensor_by_rank_op.cc)."""
+    from .sequence import seq_lengths_of
+
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="reorder_lod_tensor_by_rank",
+        inputs={"X": [x], "RankTable": [rank_table]},
+        outputs={"Out": [out]},
+    )
+    lens = seq_lengths_of(x)
+    if lens is not None:
+        new_lens = helper.create_variable_for_type_inference(lens.dtype)
+        new_lens.stop_gradient = True
+        helper.append_op(
+            type="reorder_lod_tensor_by_rank",
+            inputs={"X": [lens], "RankTable": [rank_table]},
+            outputs={"Out": [new_lens]},
+        )
+        out._seq_lengths = new_lens
     return out
 
 

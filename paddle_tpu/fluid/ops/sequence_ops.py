@@ -320,3 +320,25 @@ def gru_unit(ctx, ins, attrs):
     c = c_act(xc + (r * h_prev) @ w[:, 2 * H:])
     h = (1.0 - u) * h_prev + u * c
     return {"Hidden": h, "Gate": x, "ResetHiddenPrev": r * h_prev}
+
+
+@register_op("lod_rank_table", no_grad=("X", "Lengths"),
+             ref="paddle/fluid/operators/lod_rank_table_op.cc")
+def lod_rank_table(ctx, ins, attrs):
+    """Rank of each sequence by DESCENDING length, ties kept stable
+    (reference LoDRankTable). On the padded stack this is the index
+    permutation that sorts the batch longest-first — the reference uses it
+    to shrink the running batch inside dynamic RNNs; here
+    dynamic_recurrent masks instead, and the table powers explicit
+    reorder_lod_tensor_by_rank (plus length-bucketing data pipelines)."""
+    lengths = one(ins, "Lengths")
+    idx = jnp.argsort(-jnp.asarray(lengths).astype(jnp.int32), stable=True)
+    return {"Out": idx.astype(jnp.int32)}
+
+
+@register_op("reorder_lod_tensor_by_rank", no_grad=("RankTable",),
+             ref="paddle/fluid/operators/reorder_lod_tensor_by_rank_op.cc")
+def reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    x = one(ins, "X")
+    rank = one(ins, "RankTable").astype(jnp.int32)
+    return {"Out": jnp.take(x, rank, axis=0)}

@@ -102,3 +102,80 @@ def test_pe_transformer_tensor_parallel():
         w = scope.find_var("enc0.self.q.w")
         assert isinstance(w, jax.Array)
         assert w.sharding.spec == jax.sharding.PartitionSpec(None, "tp")
+
+
+def test_pe_resnet_cifar_data_parallel():
+    """reference test_parallel_executor.py ResNet (:279): conv+batch_norm
+    model trains under data parallelism on the 8-device mesh."""
+    from paddle_tpu.fluid import unique_name
+    from paddle_tpu.models import resnet
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    main.random_seed = startup.random_seed = 61
+    with fluid.scope_guard(scope):
+        with unique_name.guard(), program_guard(main, startup):
+            img = layers.data(name="img", shape=[3, 32, 32],
+                              dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            net = resnet.resnet_cifar10(img, class_dim=10, depth=20)
+            logits = layers.fc(input=net, size=10)
+            cost = layers.softmax_with_cross_entropy(logits=logits,
+                                                     label=label)
+            avg_cost = layers.mean(cost)
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(loss_name=avg_cost.name,
+                                    main_program=main,
+                                    mesh=make_mesh({"dp": 8}))
+        rng = np.random.RandomState(0)
+        losses = []
+        for _ in range(6):
+            feed = {
+                "img": rng.rand(32, 3, 32, 32).astype(np.float32),
+                "label": rng.randint(0, 10, size=(32, 1)).astype(np.int64),
+            }
+            (l,) = pe.run(feed=feed, fetch_list=[avg_cost])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        assert np.isfinite(losses[-1])
+        assert min(losses[1:]) < losses[0], losses
+
+
+def test_pe_train_then_test_exe_consistency():
+    """reference test_parallel_executor.py (:468): a test-mode clone run
+    through a second (share_vars_from) executor computes the same loss and
+    does not perturb training state."""
+    from paddle_tpu.fluid import unique_name
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    main.random_seed = startup.random_seed = 67
+    with fluid.scope_guard(scope):
+        with unique_name.guard(), program_guard(main, startup):
+            x = layers.data(name="x", shape=[16], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="float32")
+            h = layers.fc(input=x, size=32, act="relu")
+            p = layers.fc(input=h, size=1)
+            avg_cost = layers.mean(
+                layers.square_error_cost(input=p, label=y))
+        test_prog = main.clone(for_test=True)
+        with unique_name.guard(), program_guard(main, startup):
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(avg_cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+        mesh = make_mesh({"dp": 8})
+        train_pe = fluid.ParallelExecutor(loss_name=avg_cost.name,
+                                          main_program=main, mesh=mesh)
+        test_pe = fluid.ParallelExecutor(main_program=test_prog, mesh=mesh,
+                                         share_vars_from=train_pe)
+        rng = np.random.RandomState(1)
+        feed = {"x": rng.rand(32, 16).astype(np.float32),
+                "y": rng.rand(32, 1).astype(np.float32)}
+        # test exe must not update params: two evals identical
+        (t1,) = test_pe.run(feed=feed, fetch_list=[avg_cost.name])
+        (t2,) = test_pe.run(feed=feed, fetch_list=[avg_cost.name])
+        np.testing.assert_allclose(np.asarray(t1), np.asarray(t2))
+        # train steps reduce the loss; test exe sees the updated params
+        for _ in range(8):
+            train_pe.run(feed=feed, fetch_list=[avg_cost.name])
+        (t3,) = test_pe.run(feed=feed, fetch_list=[avg_cost.name])
+        assert float(np.asarray(t3)) < float(np.asarray(t1))

@@ -259,3 +259,46 @@ def test_sequence_concat_packs_valid_rows():
         np.testing.assert_allclose(r_cc[0, 2:5], bv[0, :3])
         expected_sum = av[0, :2].sum(axis=0) + bv[0, :3].sum(axis=0)
         np.testing.assert_allclose(r_sum[0], expected_sum, rtol=1e-5)
+
+
+def test_lod_rank_table_and_reorder():
+    """LoDRankTable capability on the padded stack (reference
+    lod_rank_table_op.cc / reorder_lod_tensor_by_rank_op.cc): rank sorts
+    by descending length (stable), reorder gathers rows + lengths, and
+    gradients flow back through the gather (checked via a trained
+    parameter upstream of the reorder)."""
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[-1, 2], dtype="float32",
+                            lod_level=1)
+            w = layers.create_parameter(shape=[1], dtype="float32",
+                                        name="rank_w")
+            scaled = layers.elementwise_mul(
+                x, layers.expand(layers.reshape(w, [1, 1, 1]), [4, 3, 2]))
+            from paddle_tpu.fluid.layers.sequence import _propagate_lengths
+            _propagate_lengths(x, scaled)
+            table = layers.lod_rank_table(x)
+            reordered = layers.reorder_lod_tensor_by_rank(scaled, table)
+            # lengths follow the reorder: last-step picks the true rows
+            last = layers.sequence_last_step(reordered)
+            loss = layers.mean(last)
+            pg = fluid.append_backward(loss)
+        grad_map = {p.name: g for p, g in pg}
+        assert "rank_w" in grad_map  # grad flows back through the gather
+        exe = fluid.Executor()
+        exe.run(startup)
+        scope.set_var("rank_w", np.ones(1, np.float32))
+        xv = np.arange(4 * 3 * 2, dtype=np.float32).reshape(4, 3, 2)
+        lens = np.array([1, 3, 2, 3], dtype=np.int32)
+        t, r, l, g = exe.run(
+            main, feed={"x": xv, "x@LEN": lens},
+            fetch_list=[table, reordered, last, grad_map["rank_w"]])
+        # descending lengths, stable ties: lens [1,3,2,3] -> [1,3,2,0]
+        np.testing.assert_array_equal(np.asarray(t), [1, 3, 2, 0])
+        np.testing.assert_allclose(np.asarray(r), xv[[1, 3, 2, 0]])
+        expect_last = np.stack([xv[1, 2], xv[3, 2], xv[2, 1], xv[0, 0]])
+        np.testing.assert_allclose(np.asarray(l), expect_last)
+        # d loss / d w = mean of the gathered last rows' x values
+        np.testing.assert_allclose(np.asarray(g).ravel(),
+                                   [expect_last.mean()], rtol=1e-5)
