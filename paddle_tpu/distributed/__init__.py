@@ -24,3 +24,4 @@ from .election import (  # noqa: F401
 )
 from .env import get_world_info, global_mesh, init_distributed  # noqa: F401
 from .master import MasterClient, MasterService  # noqa: F401
+from .membership import WorkerRegistry  # noqa: F401

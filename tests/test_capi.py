@@ -68,7 +68,12 @@ def test_c_consumer_matches_python(tmp_path):
     assert r.returncode == 0, r.stderr
 
     env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # hermetic CPU run: the axon site hook re-registers the TPU backend in
+    # every process and a wedged tunnel attach can hang the consumer —
+    # scrub it from PYTHONPATH entirely (same trick as bench.py)
+    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+          if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + pp)
     env["JAX_PLATFORMS"] = "cpu"
     r = subprocess.run([exe_path, model_dir], capture_output=True, text=True,
                        env=env, timeout=240)

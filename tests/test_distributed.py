@@ -500,3 +500,52 @@ def test_pserver_program_includes_lr_decay_chain():
             assert not any(o.endswith("_grad") for o in ops)
         assert sorted(all_owned) == sorted(
             ["pw1", "pb1", "pw2", "pb2"])
+
+
+def test_worker_registry_elastic_membership(tmp_path):
+    """Elastic membership (reference go/pserver/etcd_client.go Register:70):
+    workers claim TTL slots, listers see only live members, a dead worker's
+    slot expires and is reclaimed by a newcomer."""
+    from paddle_tpu.distributed import WorkerRegistry
+
+    root = str(tmp_path / "workers")
+    a = WorkerRegistry(root, "trainer-a", ttl=0.5)
+    b = WorkerRegistry(root, "trainer-b", ttl=0.5)
+    assert a.register() == 0
+    assert b.register() == 1
+    assert a.wait_for(2) == ["trainer-a", "trainer-b"]
+    assert a.is_registered() and b.is_registered()
+
+    # crash a (heartbeat stops without release): slot 0 expires...
+    a._stop.set()
+    a._thread.join(timeout=5)
+    time.sleep(0.8)
+    assert b.members() == {1: "trainer-b"}
+    # ...and a newcomer reclaims the lowest free slot
+    c = WorkerRegistry(root, "trainer-c", ttl=0.5)
+    assert c.register() == 0
+    assert b.wait_for(2) == ["trainer-c", "trainer-b"]
+
+    # clean departure disappears immediately
+    b.deregister()
+    assert c.members() == {0: "trainer-c"}
+    c.deregister()
+    assert c.members() == {}
+
+
+def test_worker_registry_same_id_two_processes_get_two_slots(tmp_path):
+    """Two registry instances with the SAME worker_id (restart race) must
+    claim different slots — never silently share one lease."""
+    from paddle_tpu.distributed import WorkerRegistry
+
+    root = str(tmp_path / "workers")
+    a = WorkerRegistry(root, "trainer-x", ttl=60)
+    b = WorkerRegistry(root, "trainer-x", ttl=60)
+    sa, sb = a.register(), b.register()
+    assert sa != sb
+    assert a.is_registered() and b.is_registered()
+    # old instance departing must not evict the new one
+    a.deregister()
+    assert b.is_registered()
+    assert list(b.members().values()) == ["trainer-x"]
+    b.deregister()

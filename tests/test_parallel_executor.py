@@ -178,4 +178,5 @@ def test_pe_train_then_test_exe_consistency():
         for _ in range(8):
             train_pe.run(feed=feed, fetch_list=[avg_cost.name])
         (t3,) = test_pe.run(feed=feed, fetch_list=[avg_cost.name])
-        assert float(np.asarray(t3)) < float(np.asarray(t1))
+        assert (float(np.asarray(t3).reshape(-1)[0])
+                < float(np.asarray(t1).reshape(-1)[0]))
