@@ -1,0 +1,90 @@
+"""Transformer training throughput on one TPU chip through the full
+framework stack (Program IR -> Executor), with MFU computed from XLA's own
+cost analysis of the compiled step. Prints one JSON line per config."""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skipped": "not on tpu"}))
+        return 0
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.executor import _block_io, _lower
+    from paddle_tpu.fluid.flags import set_flags
+    from paddle_tpu.fluid.framework import Program, program_guard
+    from paddle_tpu.models import transformer
+
+    set_flags({"amp": True})
+    cfg = transformer.TransformerConfig(
+        src_vocab=32000, trg_vocab=32000, max_len=512, d_model=512,
+        n_heads=8, d_ff=2048, n_layers=6, dropout=0.0,
+    )
+    batch = 16
+    main_prog, startup, scope = Program(), Program(), fluid.Scope()
+    main_prog.random_seed = startup.random_seed = 3
+    with fluid.scope_guard(scope):
+        with program_guard(main_prog, startup):
+            src = layers.data(name="src", shape=[cfg.max_len], dtype="int64")
+            trg = layers.data(name="trg", shape=[cfg.max_len], dtype="int64")
+            lbl = layers.data(name="lbl", shape=[cfg.max_len, 1],
+                              dtype="int64")
+            avg_cost, _ = transformer.build_train(cfg, src, trg, lbl)
+            fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        rng = np.random.RandomState(0)
+        s = jnp.asarray(rng.randint(3, cfg.src_vocab,
+                                    (batch, cfg.max_len)).astype(np.int64))
+        t = jnp.concatenate(
+            [jnp.zeros((batch, 1), s.dtype), s[:, :-1]], axis=1)
+        feed = {"src": s, "trg": t, "lbl": s[:, :, None]}
+
+        # flops of the compiled step, from XLA itself
+        block = main_prog.global_block()
+        state_in, state_out = _block_io(block, set(feed), scope)
+        fn, ro, rw = _lower(block, tuple(feed), (avg_cost.name,),
+                            tuple(state_in), tuple(state_out))
+        comp = jax.jit(fn).lower(
+            feed, {n: scope.find_var(n) for n in ro},
+            {n: scope.find_var(n) for n in rw}, jax.random.key(0)).compile()
+        step_flops = comp.cost_analysis().get("flops", 0.0)
+
+        for i in range(5):
+            (l,) = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                           return_numpy=False)
+        jax.block_until_ready(l)
+        iters = 30
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            (l,) = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                           return_numpy=False)
+        jax.block_until_ready(l)
+        dt = (time.perf_counter() - t0) / iters
+
+        tokens_per_sec = batch * cfg.max_len / dt
+        tflops = step_flops / dt / 1e12
+        print(json.dumps({
+            "model": "transformer-base-6L-512d",
+            "seq": cfg.max_len, "batch": batch,
+            "step_ms": round(dt * 1e3, 2),
+            "tokens_per_sec": round(tokens_per_sec),
+            "xla_step_gflop": round(step_flops / 1e9, 1),
+            "sustained_tflops": round(tflops, 1),
+            "loss": float(np.asarray(l).reshape(-1)[0]),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    sys.exit(main())

@@ -18,7 +18,7 @@ __all__ = [
     "While", "StaticRNN", "DynamicRNN", "IfElse", "ConditionalBlock",
     "Switch", "increment", "array_write", "array_read", "array_length",
     "create_array", "less_than", "equal", "zeros_like_array", "Print",
-    "lod_rank_table", "reorder_lod_tensor_by_rank",
+    "lod_rank_table", "reorder_lod_tensor_by_rank", "max_sequence_len",
 ]
 
 
@@ -114,6 +114,26 @@ def lod_rank_table(x, level=0):
     out.stop_gradient = True
     helper.append_op(
         type="lod_rank_table", inputs={"X": [x], "Lengths": [lens]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def max_sequence_len(x):
+    """Max sequence length in the batch as an int64 [1] tensor (reference
+    layers/control_flow.py max_sequence_len — there it reads the
+    LoDRankTable; here the lengths companion of the sequence var)."""
+    from .sequence import seq_lengths_of
+
+    lens = seq_lengths_of(x)
+    if lens is None:
+        raise ValueError("max_sequence_len needs a sequence input "
+                         "(padded var with a lengths companion)")
+    helper = LayerHelper("max_sequence_len")
+    out = helper.create_variable_for_type_inference("int64")
+    out.stop_gradient = True
+    helper.append_op(
+        type="max_sequence_len", inputs={"Lengths": [lens]},
         outputs={"Out": [out]},
     )
     return out

@@ -309,3 +309,88 @@ def print_op(ctx, ins, attrs):
     if attrs.get("print_phase", "both") in ("forward", "both"):
         _emit_print(x, attrs, "forward")
     return {"Out": x}
+
+
+@register_op("fill", ref="paddle/fluid/operators/fill_op.cc")
+def fill(ctx, ins, attrs):
+    """Fill Out with literal values from the `value` attr (the reference's
+    host-side cousin of fill_constant)."""
+    shape = [int(s) for s in attrs["shape"]]
+    vals = jnp.asarray(attrs["value"], dtype=dtype_of(attrs))
+    return {"Out": jnp.reshape(vals, shape)}
+
+
+@register_op("max_sequence_len", no_grad=("Lengths",),
+             ref="paddle/fluid/operators/max_sequence_len_op.cc")
+def max_sequence_len(ctx, ins, attrs):
+    """Max length of the batch. The reference reads it off the LoDRankTable;
+    this repo's rank table is a permutation, so the op takes the lengths
+    companion directly (layers.max_sequence_len wires it from a sequence
+    var)."""
+    lengths = one(ins, "Lengths")
+    if lengths is None:
+        raise ValueError(
+            "max_sequence_len needs the Lengths input — build it with "
+            "layers.max_sequence_len(x) on a sequence var")
+    return {"Out": jnp.max(jnp.asarray(lengths)).reshape(1).astype(jnp.int64)}
+
+
+@register_op("lod_tensor_to_array", no_grad=("RankTable",),
+             ref="paddle/fluid/operators/lod_tensor_to_array_op.cc")
+def lod_tensor_to_array(ctx, ins, attrs):
+    """[N, T, ...] -> time-major array [T, N, ...] (the reference splits a
+    LoD tensor into per-timestep batches for the dynamic RNN machinery;
+    the padded-stack equivalent is the transpose, with masking left to the
+    consumers exactly like dynamic_recurrent)."""
+    x = one(ins, "X")
+    return {"Out": jnp.swapaxes(x, 0, 1)}
+
+
+@register_op("array_to_lod_tensor", no_grad=("RankTable",),
+             ref="paddle/fluid/operators/array_to_lod_tensor_op.cc")
+def array_to_lod_tensor(ctx, ins, attrs):
+    """Inverse of lod_tensor_to_array: [T, N, ...] -> [N, T, ...]."""
+    x = one(ins, "X")
+    return {"Out": jnp.swapaxes(x, 0, 1)}
+
+
+@register_op("split_ids", no_grad=("Ids",),
+             ref="paddle/fluid/operators/split_ids_op.cc")
+def split_ids(ctx, ins, attrs):
+    """Partition ids across `num_shards` by id % num_shards (the pserver
+    sharding rule for distributed sparse embeddings). XLA needs static
+    shapes, so each shard output keeps the input extent with -1 padding
+    where the id belongs to another shard (consumers mask on >= 0)."""
+    ids = jnp.reshape(one(ins, "Ids"), (-1,))
+    n = int(attrs["num_shards"])
+    outs = []
+    for s in range(n):
+        keep = (ids % n) == s
+        outs.append(jnp.where(keep, ids, -1))
+    return {"Out": outs}
+
+
+@register_op("split_selected_rows", no_grad=("X",),
+             ref="paddle/fluid/operators/split_selected_rows_op.cc")
+def split_selected_rows(ctx, ins, attrs):
+    """Split a SelectedRows by contiguous row sections (`height_sections`)
+    — how the reference ships a sparse gradient to the pservers owning
+    each slice of the embedding table. Static shapes: every output keeps
+    the input's row count; rows outside the section get row index -1 and
+    zero values (apply-side treats them as absent)."""
+    from ..selected_rows import SelectedRows, is_selected_rows
+
+    x = one(ins, "X")
+    sections = [int(s) for s in attrs["height_sections"]]
+    if not is_selected_rows(x):
+        raise ValueError("split_selected_rows expects a SelectedRows input")
+    outs = []
+    start = 0
+    for sec in sections:
+        in_sec = jnp.logical_and(x.rows >= start, x.rows < start + sec)
+        rows = jnp.where(in_sec, x.rows - start, -1)
+        vals = jnp.where(
+            in_sec.reshape((-1,) + (1,) * (x.value.ndim - 1)), x.value, 0)
+        outs.append(SelectedRows(rows=rows, value=vals, height=sec))
+        start += sec
+    return {"Out": outs}

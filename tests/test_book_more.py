@@ -309,3 +309,45 @@ def test_label_semantic_roles():
         assert path.min() >= 0 and path.max() < c5.LABEL_DICT_LEN
         for i, ln in enumerate(lens):
             assert (path[i, ln:] == 0).all()
+
+
+def test_book_under_memory_optimize():
+    """reference tests/book_memory_optimization/: a book chapter re-run
+    with memory_optimize applied must still converge (recognize_digits
+    flow; buffer-reuse rewrites may not change results)."""
+    from paddle_tpu.fluid.memory_optimization_transpiler import (
+        estimate_peak_bytes,
+        memory_optimize,
+    )
+    from paddle_tpu.models import lenet
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    main.random_seed = startup.random_seed = 7
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            img = layers.data(name="img", shape=[1, 28, 28],
+                              dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            avg_cost, acc, prediction = lenet.build(img, label)
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+
+        before = estimate_peak_bytes(main)
+        n_rewrites = memory_optimize(main)
+        after = estimate_peak_bytes(main)
+        assert n_rewrites > 0
+        assert after <= before
+
+        reader = paddle_tpu.batch(paddle_tpu.dataset.mnist.train(),
+                                  batch_size=64)
+        feeder = fluid.DataFeeder(feed_list=[img, label], program=main)
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for i, data in enumerate(reader()):
+            if i >= 16:
+                break
+            (loss,) = exe.run(main, feed=feeder.feed(data),
+                              fetch_list=[avg_cost])
+            losses.append(float(np.asarray(loss).reshape(-1)[0]))
+        assert np.isfinite(losses[-1])
+        assert min(losses[1:]) < losses[0], (losses[0], losses[-1])

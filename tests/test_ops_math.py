@@ -299,3 +299,71 @@ class TestMisc(OpTest):
         self.attrs = {}
         self.outputs = {"Out": np.array([x.mean()])}
         self.check_output(rtol=1e-4)
+
+
+class TestFillOp(OpTest):
+    def setup(self):
+        self.op_type = "fill"
+        self.inputs = {}
+        self.attrs = {"shape": [2, 3], "dtype": "float32",
+                      "value": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]}
+        self.outputs = {"Out": np.arange(1, 7, dtype=np.float32).reshape(2, 3)}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+class TestMaxSequenceLenOp(OpTest):
+    def setup(self):
+        self.op_type = "max_sequence_len"
+        self.inputs = {"Lengths": np.array([3, 7, 2], np.int32)}
+        self.attrs = {}
+        self.outputs = {"Out": np.array([7], np.int64)}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+class TestLodTensorToArrayRoundTrip(OpTest):
+    def test(self):
+        x = np.random.RandomState(0).rand(2, 5, 3).astype(np.float32)
+        self.op_type = "lod_tensor_to_array"
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": x.transpose(1, 0, 2)}
+        self.check_output()
+        self.op_type = "array_to_lod_tensor"
+        self.inputs = {"X": x.transpose(1, 0, 2)}
+        self.outputs = {"Out": x}
+        self.check_output()
+
+
+def test_split_ids_op():
+    from paddle_tpu.fluid.registry import EmitCtx, run_forward
+
+    ids = np.array([0, 1, 2, 3, 4, 5, 10, 11], np.int32)
+    outs = run_forward(EmitCtx(), "split_ids",
+                       {"Ids": [ids]}, {"num_shards": 2})["Out"]
+    a, b = np.asarray(outs[0]), np.asarray(outs[1])
+    np.testing.assert_array_equal(a, [0, -1, 2, -1, 4, -1, 10, -1])
+    np.testing.assert_array_equal(b, [-1, 1, -1, 3, -1, 5, -1, 11])
+
+
+def test_split_selected_rows_op():
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.registry import EmitCtx, run_forward
+    from paddle_tpu.fluid.selected_rows import SelectedRows
+
+    sr = SelectedRows(rows=jnp.asarray([1, 5, 8], jnp.int32),
+                      value=jnp.asarray([[1.0], [2.0], [3.0]]), height=10)
+    outs = run_forward(EmitCtx(), "split_selected_rows", {"X": [sr]},
+                       {"height_sections": [4, 6]})["Out"]
+    lo, hi = outs
+    assert lo.height == 4 and hi.height == 6
+    np.testing.assert_array_equal(np.asarray(lo.rows), [1, -1, -1])
+    np.testing.assert_allclose(np.asarray(lo.value), [[1.0], [0.0], [0.0]])
+    np.testing.assert_array_equal(np.asarray(hi.rows), [-1, 1, 4])
+    np.testing.assert_allclose(np.asarray(hi.value), [[0.0], [2.0], [3.0]])

@@ -302,3 +302,17 @@ def test_lod_rank_table_and_reorder():
         # d loss / d w = mean of the gathered last rows' x values
         np.testing.assert_allclose(np.asarray(g).ravel(),
                                    [expect_last.mean()], rtol=1e-5)
+
+
+def test_max_sequence_len_layer():
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[-1, 2], dtype="float32",
+                            lod_level=1)
+            m = layers.max_sequence_len(x)
+        exe = fluid.Executor()
+        (mv,) = exe.run(main, feed={
+            "x": np.zeros((3, 9, 2), np.float32),
+            "x@LEN": np.array([3, 7, 2], np.int32)}, fetch_list=[m])
+        np.testing.assert_array_equal(np.asarray(mv), [7])
