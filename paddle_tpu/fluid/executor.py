@@ -178,40 +178,14 @@ class Executor:
         fetch_list = fetch_list or []
         scope = scope or global_scope()
 
-        block = program.global_block()
-        fetch_names = tuple(_as_name(v) for v in fetch_list)
         feed_arrays = {
             k: jnp.asarray(v) if not isinstance(v, jax.Array) else v
             for k, v in feed.items()
         }
-        feed_sig = tuple(
-            sorted((k, tuple(v.shape), str(v.dtype)) for k, v in feed_arrays.items())
+        fetch_names = tuple(_as_name(v) for v in fetch_list)
+        jfn, ro_names, rw_names, state_out = self._entry(
+            program, feed_arrays, fetch_names, scope, use_program_cache
         )
-        from .flags import trace_flags
-
-        cache_key = (program._version, feed_sig, fetch_names, trace_flags())
-
-        prog_cache = self._cache.setdefault(program, {})
-        entry = prog_cache.get(cache_key) if use_program_cache else None
-        if entry is None:
-            state_in, state_out = _block_io(block, set(feed_arrays), scope)
-            missing = [n for n in state_in if not scope.has_var(n)]
-            if missing:
-                raise RuntimeError(
-                    f"vars {missing} are read by the program but not initialized in "
-                    "scope — run the startup program first or feed them"
-                )
-            fn, ro_names, rw_names = _lower(
-                block, tuple(feed_arrays), fetch_names, tuple(state_in),
-                tuple(state_out),
-            )
-            donate = (2,) if FLAGS["donate_state"] else ()
-            jfn = jax.jit(fn, donate_argnums=donate)
-            entry = (jfn, ro_names, rw_names, tuple(state_out))
-            if use_program_cache:
-                prog_cache[cache_key] = entry
-
-        jfn, ro_names, rw_names, state_out = entry
         state_ro = {n: scope.find_var(n) for n in ro_names}
         state_rw = {n: scope.find_var(n) for n in rw_names}
         key = _next_key(program)
@@ -237,6 +211,71 @@ class Executor:
 
             return [f if is_selected_rows(f) else np.asarray(f) for f in fetches]
         return list(fetches)
+
+    def _entry(self, program, feed_arrays, fetch_names, scope,
+               use_program_cache):
+        """Find-or-build the jitted step for (program version, feed
+        signature, fetches, trace flags)."""
+        from .flags import trace_flags
+
+        block = program.global_block()
+        feed_sig = tuple(
+            sorted((k, tuple(v.shape), str(v.dtype))
+                   for k, v in feed_arrays.items())
+        )
+        cache_key = (program._version, feed_sig, fetch_names, trace_flags())
+        prog_cache = self._cache.setdefault(program, {})
+        entry = prog_cache.get(cache_key) if use_program_cache else None
+        if entry is None:
+            state_in, state_out = _block_io(block, set(feed_arrays), scope)
+            missing = [n for n in state_in if not scope.has_var(n)]
+            if missing:
+                raise RuntimeError(
+                    f"vars {missing} are read by the program but not initialized in "
+                    "scope — run the startup program first or feed them"
+                )
+            fn, ro_names, rw_names = _lower(
+                block, tuple(feed_arrays), fetch_names, tuple(state_in),
+                tuple(state_out),
+            )
+            donate = (2,) if FLAGS["donate_state"] else ()
+            jfn = jax.jit(fn, donate_argnums=donate)
+            entry = (jfn, ro_names, rw_names, tuple(state_out))
+            if use_program_cache:
+                prog_cache[cache_key] = entry
+        return entry
+
+    def lowered(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence[Any]] = None,
+        scope: Optional[Scope] = None,
+    ):
+        """AOT handle onto the exact cache entry run() would use: returns
+        (jfn, args) where jfn is the jitted step function and args the
+        (feed, state_ro, state_rw, key) tuple for these shapes. Callers can
+        jfn.lower(*args).compile() for cost_analysis()/memory_analysis()
+        without a second compile — the jit object is shared with run(), so
+        AOT and traced calls hit one executable (used by benchmarks/)."""
+        program = program or default_main_program()
+        feed = feed or {}
+        scope = scope or global_scope()
+        feed_arrays = {
+            k: jnp.asarray(v) if not isinstance(v, jax.Array) else v
+            for k, v in feed.items()
+        }
+        entry = self._entry(program, feed_arrays,
+                            tuple(_as_name(v) for v in fetch_list or []),
+                            scope, use_program_cache=True)
+        jfn, ro_names, rw_names, _ = entry
+        args = (
+            feed_arrays,
+            {n: scope.find_var(n) for n in ro_names},
+            {n: scope.find_var(n) for n in rw_names},
+            jax.random.key(0),
+        )
+        return jfn, args
 
     def close(self):
         self._cache.clear()

@@ -130,7 +130,9 @@ def max_sequence_len(x):
         raise ValueError("max_sequence_len needs a sequence input "
                          "(padded var with a lengths companion)")
     helper = LayerHelper("max_sequence_len")
-    out = helper.create_variable_for_type_inference("int64")
+    # int32: x64 is disabled throughout, so an int64 decl would never match
+    # the runtime dtype (and jnp warns on every trace)
+    out = helper.create_variable_for_type_inference("int32")
     out.stop_gradient = True
     helper.append_op(
         type="max_sequence_len", inputs={"Lengths": [lens]},

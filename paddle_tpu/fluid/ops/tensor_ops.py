@@ -332,7 +332,7 @@ def max_sequence_len(ctx, ins, attrs):
         raise ValueError(
             "max_sequence_len needs the Lengths input — build it with "
             "layers.max_sequence_len(x) on a sequence var")
-    return {"Out": jnp.max(jnp.asarray(lengths)).reshape(1).astype(jnp.int64)}
+    return {"Out": jnp.max(jnp.asarray(lengths)).reshape(1).astype(jnp.int32)}
 
 
 @register_op("lod_tensor_to_array", no_grad=("RankTable",),

@@ -1,4 +1,13 @@
 """Model zoo — the workloads the reference benchmarks/book tests run
 (reference benchmark/fluid/{mnist,resnet,vgg,stacked_dynamic_lstm,
-machine_translation}.py), built on the paddle_tpu.fluid layer API."""
-from . import lenet, resnet, transformer, vgg  # noqa: F401
+machine_translation}.py plus the legacy benchmark/{alexnet,googlenet,
+smallnet_mnist_cifar}.py suite), built on the paddle_tpu.fluid layer API."""
+from . import (  # noqa: F401
+    alexnet,
+    googlenet,
+    lenet,
+    resnet,
+    smallnet,
+    transformer,
+    vgg,
+)

@@ -122,3 +122,27 @@ def test_trace_flags_in_jit_cache_key():
         np.testing.assert_allclose(a, ref32, rtol=1e-4, atol=1e-6)
         np.testing.assert_allclose(b, refbf, rtol=1e-5, atol=1e-6)
         assert not np.array_equal(a, b)
+
+
+def test_lowered_shares_cache_with_run():
+    """Executor.lowered() (AOT inspection handle, used by benchmarks/) maps
+    to the same jitted entry run() uses, and its compiled object reports a
+    cost analysis."""
+    import jax
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[4], dtype="float32")
+            y = layers.fc(input=x, size=3)
+            loss = layers.mean(y)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        jfn, args = exe.lowered(main, feed, [loss], scope)
+        comp = jfn.lower(*args).compile()
+        assert comp.cost_analysis().get("flops", 0.0) > 0
+        exe.run(main, feed=feed, fetch_list=[loss])
+        jfn2, _ = exe.lowered(main, feed, [loss], scope)
+        assert jfn is jfn2

@@ -137,3 +137,36 @@ def test_amp_flag_trains_lenet():
             assert str(np.asarray(w).dtype) == "float32"
     finally:
         set_flags({"amp": False})
+
+
+@pytest.mark.parametrize("name", ["alexnet", "googlenet", "smallnet"])
+def test_legacy_benchmark_models_train_step(name):
+    """The legacy K40m benchmark suite models (reference benchmark/
+    {alexnet,googlenet,smallnet_mnist_cifar}.py) build and take a training
+    step; reduced spatial dims (96 vs the benchmark's 224) keep the CPU
+    compile fast while exercising every stage (alexnet's stride-4 stem +
+    3 pools needs >=67px; googlenet's head is a global pool)."""
+    from paddle_tpu.models import alexnet, googlenet, smallnet
+
+    mod = {"alexnet": alexnet, "googlenet": googlenet,
+           "smallnet": smallnet}[name]
+    shape = [3, 32, 32] if name == "smallnet" else [3, 96, 96]
+    class_dim = 10 if name == "smallnet" else 1000
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            img = layers.data(name="img", shape=shape, dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            avg_cost, acc, pred = mod.build_train(
+                img, label, class_dim=class_dim)
+            fluid.optimizer.Momentum(
+                learning_rate=0.01, momentum=0.9).minimize(avg_cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, *shape).astype(np.float32)
+        y = rng.randint(0, class_dim, size=(2, 1)).astype(np.int64)
+        for _ in range(2):
+            (loss,) = exe.run(main, feed={"img": x, "label": y},
+                              fetch_list=[avg_cost])
+            assert np.isfinite(loss).all()
