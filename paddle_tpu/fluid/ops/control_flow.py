@@ -308,3 +308,33 @@ def slice_op(ctx, ins, attrs):
         e = e + dim if e < 0 else min(e, dim)
         idx[ax] = slice(s, e)
     return {"Out": x[tuple(idx)]}
+
+
+@register_op("get_places", no_grad=(),
+             ref="paddle/fluid/operators/get_places_op.cc")
+def get_places(ctx, ins, attrs):
+    """Device indices for a ParallelDo region. Place = mesh position here,
+    so the PLACE_LIST var is just [0..n): under jit the count is a static
+    trace-time constant (jax.device_count() when device_count attr is 0)."""
+    n = int(attrs.get("device_count", 0) or 0)
+    if n == 0:
+        n = jax.device_count()
+    return {"Out": jnp.arange(n, dtype=jnp.int32)}
+
+
+@register_op("parallel_do", no_grad=("Places",),
+             ref="paddle/fluid/operators/parallel_do_op.cc:115")
+def parallel_do(ctx, ins, attrs):
+    """Data-parallel region (reference: SplitTensorAndMoveTensorToScopes +
+    per-place threads + NCCL grad all-reduce, parallel_do_op.cc:39,115).
+
+    TPU lowering: trace the sub-block ONCE over the full batch — the split/
+    merge and the gradient all-reduce are GSPMD's job when ParallelExecutor
+    shards the batch axis over the mesh. The region is a pure function of
+    (Inputs, X), so the generic emitter vjp differentiates it; the Places
+    input only sizes the mesh and carries no gradient."""
+    ops = _sub_op_descs(ctx, attrs)
+    env = dict(zip(list(attrs["x_var_names"]), ins.get("X", [])))
+    env.update(zip(list(attrs["input_var_names"]), ins.get("Inputs", [])))
+    exec_op_descs(ctx, ops, env)
+    return {"Out": [env[n] for n in list(attrs["out_var_names"])]}

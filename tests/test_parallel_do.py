@@ -1,0 +1,102 @@
+"""ParallelDo / get_places (reference layers/control_flow.py:234,
+operators/parallel_do_op.cc, test_parallel_op.py): the data-parallel region
+must train identically to the same net without the region — here the split/
+merge/all-reduce is GSPMD's, so equivalence is exact, not approximate."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def _build(use_pd):
+    from paddle_tpu.fluid import unique_name
+
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 11
+    with unique_name.guard(), program_guard(main, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+
+        def net(inp, lbl):
+            h = layers.fc(input=inp, size=16, act="relu",
+                          param_attr=fluid.ParamAttr(name="w1"),
+                          bias_attr=fluid.ParamAttr(name="b1"))
+            p = layers.fc(input=h, size=1,
+                          param_attr=fluid.ParamAttr(name="w2"),
+                          bias_attr=fluid.ParamAttr(name="b2"))
+            return layers.mean(
+                layers.square_error_cost(input=p, label=lbl))
+
+        if use_pd:
+            places = layers.get_places()
+            pd = layers.ParallelDo(places)
+            with pd.do():
+                x_ = pd.read_input(x)
+                y_ = pd.read_input(y)
+                loss = net(x_, y_)
+                pd.write_output(loss)
+            cost = pd()
+            avg_cost = layers.mean(cost)
+        else:
+            avg_cost = net(x, y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+    return main, startup, avg_cost
+
+
+def _train(main, startup, cost, steps=6):
+    rng = np.random.RandomState(0)
+    w = rng.rand(8, 1).astype(np.float32)
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        for i in range(steps):
+            x = rng.rand(16, 8).astype(np.float32)
+            y = x @ w
+            (l,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=[cost])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+    return losses
+
+
+def test_parallel_do_trains_and_matches_plain_net():
+    plain = _train(*_build(use_pd=False))
+    pd = _train(*_build(use_pd=True))
+    assert np.isfinite(pd).all()
+    assert pd[-1] < pd[0]
+    np.testing.assert_allclose(pd, plain, rtol=1e-5, atol=1e-6)
+
+
+def test_parallel_do_region_under_parallel_executor():
+    """The region's batch axis shards over the dp mesh — the reference's
+    per-place threads + NCCL become GSPMD."""
+    main, startup, cost = _build(use_pd=True)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(1)
+    w = rng.rand(8, 1).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=cost.name,
+                                    main_program=main)
+        losses = []
+        for _ in range(4):
+            x = rng.rand(32, 8).astype(np.float32)
+            y = x @ w
+            (l,) = pe.run(feed={"x": x, "y": y}, fetch_list=[cost.name])
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_get_places_device_count():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        places = layers.get_places(device_count=4)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (p,) = exe.run(main, fetch_list=[places])
+    np.testing.assert_array_equal(np.asarray(p), np.arange(4, dtype=np.int32))
