@@ -85,36 +85,76 @@ def _run_child(env, timeout, label):
     return None
 
 
+def _probe_once():
+    """Returns 'tpu' / 'cpu' (probe succeeded, reporting that platform) or
+    None (probe failed or hung)."""
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            timeout=PROBE_TIMEOUT_S, capture_output=True, text=True,
+            env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        print(f"# probe timed out ({PROBE_TIMEOUT_S}s) — tunnel blocked",
+              file=sys.stderr)
+        return None
+    ok_lines = [ln for ln in p.stdout.splitlines()
+                if ln.startswith("PROBE_OK")]
+    if p.returncode == 0 and ok_lines:
+        platform = ok_lines[0].split()[1]
+        print(f"# device probe ok: {platform}", file=sys.stderr)
+        return "tpu" if platform != "cpu" else "cpu"
+    print(f"# probe rc={p.returncode}: {p.stderr.strip()[-300:]}",
+          file=sys.stderr)
+    return None
+
+
 def supervise():
-    tpu_ok = False
+    platform = None
     for i in range(PROBE_RETRIES):
-        try:
-            p = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC],
-                timeout=PROBE_TIMEOUT_S, capture_output=True, text=True,
-                env=dict(os.environ),
-            )
-            ok_lines = [ln for ln in p.stdout.splitlines()
-                        if ln.startswith("PROBE_OK")]
-            if p.returncode == 0 and ok_lines:
-                platform = ok_lines[0].split()[1]
-                print(f"# device probe ok: {platform}", file=sys.stderr)
-                tpu_ok = platform != "cpu"
-                break
-            print(f"# probe {i + 1}/{PROBE_RETRIES} rc={p.returncode}: "
-                  f"{p.stderr.strip()[-300:]}", file=sys.stderr)
-        except subprocess.TimeoutExpired:
-            print(f"# probe {i + 1}/{PROBE_RETRIES} timed out "
-                  f"({PROBE_TIMEOUT_S}s) — tunnel blocked", file=sys.stderr)
+        platform = _probe_once()
+        if platform is not None:  # a cpu-only host needs no backoff retries
+            break
         if i < PROBE_RETRIES - 1:
             time.sleep(10 * (i + 1))
+    tpu_ok = platform == "tpu"
 
-    if tpu_ok:
-        line = _run_child(os.environ, CHILD_TIMEOUT_S, "tpu")
+    # Staged TPU attempts: the tunnel's remote-compile service has died
+    # mid-compile of the full bs=32 train-step graph before ("Connection
+    # refused" after ~25min). Each retry shrinks the compile (smaller batch,
+    # then f32-only = fewer cast ops), re-probing first since a failed
+    # attempt may have wedged the tunnel. Any attempt that lands still
+    # reports the true imgs/sec for its batch size. Dedup keeps the ladder
+    # strictly shrinking when the user already chose a small BENCH_BATCH.
+    small = min(16, BATCH)
+    ladder = [({}, f"tpu-bs{BATCH}"),
+              ({"BENCH_BATCH": str(small)}, f"tpu-bs{small}"),
+              ({"BENCH_BATCH": str(small), "BENCH_AMP": "0"},
+               f"tpu-bs{small}-f32")]
+    attempts, seen = [], set()
+    for overrides, label in ladder:
+        sig = (overrides.get("BENCH_BATCH", str(BATCH)),
+               overrides.get("BENCH_AMP", os.environ.get("BENCH_AMP", "1")))
+        if sig not in seen:
+            seen.add(sig)
+            attempts.append((overrides, label))
+    tpu_attempted = False
+    for i, (overrides, label) in enumerate(attempts):
+        if not tpu_ok:
+            break
+        tpu_attempted = True
+        env = dict(os.environ)
+        env.update(overrides)
+        line = _run_child(env, CHILD_TIMEOUT_S, label)
         if line:
             print(line)
             return 0
-        print("# tpu bench failed despite probe ok; falling back to cpu",
+        print(f"# {label} bench failed", file=sys.stderr)
+        if i < len(attempts) - 1:
+            print("# re-probing tunnel before next attempt", file=sys.stderr)
+            tpu_ok = _probe_once() == "tpu"
+    if tpu_attempted or tpu_ok:
+        print("# tpu attempts exhausted; falling back to cpu",
               file=sys.stderr)
 
     env = _scrubbed_cpu_env()

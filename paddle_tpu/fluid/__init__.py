@@ -5,6 +5,7 @@ from . import (  # noqa: F401
     backward,
     clip,
     concurrency,
+    enforce,
     evaluator,
     initializer,
     io,
@@ -18,6 +19,7 @@ from . import (  # noqa: F401
     regularizer,
     unique_name,
 )
+from .enforce import EnforceNotMet  # noqa: F401
 from .distribute_transpiler import DistributeTranspiler  # noqa: F401
 from .memory_optimization_transpiler import (  # noqa: F401
     memory_optimize,

@@ -11,6 +11,7 @@ import copy
 from typing import Any, Dict, Optional
 
 from . import unique_name
+from .enforce import enforce_not_none
 from .framework import (
     Parameter, Variable, default_main_program, default_startup_program,
 )
@@ -122,6 +123,13 @@ class LayerHelper:
         return inputs
 
     def append_bias_op(self, input_var: Variable, dim_start: int = 1, dim_end=None):
+        enforce_not_none(
+            input_var.shape,
+            f"shape of '{input_var.name}' (build-time inference could not "
+            f"resolve the producing op's output shape; check the dims "
+            f"feeding layer '{self.layer_type}')",
+            context=self.layer_type,
+        )
         size = list(input_var.shape[dim_start:dim_end])
         bias_attr = self.bias_attr
         if bias_attr is False or bias_attr is None:

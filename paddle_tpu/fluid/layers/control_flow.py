@@ -362,6 +362,7 @@ class ParallelDo:
         self._outputs = []  # sub-block Variables registered by write_output
         self._sub = None
         self._parent = None
+        self._result_vars = None
 
     def read_input(self, var):
         if self._sub is None:
@@ -424,6 +425,9 @@ class ParallelDo:
             )
 
     def __call__(self):
+        if self._result_vars is None:
+            raise RuntimeError("ParallelDo has no results — call pd() after "
+                               "a completed `with pd.do():` region")
         outs = self._result_vars
         return outs[0] if len(outs) == 1 else tuple(outs)
 

@@ -26,6 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import core
+from .enforce import throw_on
 from .executor import Scope, _block_io, _lower, _next_key, global_scope
 from .framework import Program, Variable, default_main_program
 
@@ -106,10 +107,12 @@ class ParallelExecutor:
                 return spec
             if int(np.prod(shape, dtype=np.int64)) <= 1:
                 return P(*([None] * len(shape)))
-            raise ValueError(
-                f"sharding plan maps var '{name}' (shape {tuple(shape)}) to "
-                f"{spec}, but a dimension does not divide the mesh axis size "
-                f"{axis_sizes} — fix the plan rules or the model dims"
+            throw_on(
+                "sharding plan maps var '%s' (shape %s) to %s, but a "
+                "dimension does not divide the mesh axis size %s — fix the "
+                "plan rules or the model dims",
+                name, tuple(shape), spec, axis_sizes,
+                context="ParallelExecutor",
             )
 
         feed_arrays = {}

@@ -29,6 +29,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from .enforce import enforce
+
 # attr key carrying the forward-op metadata on generated grad ops
 FWD_META_ATTR = "__fwd__"
 RNG_SEED_ATTR = "__rng_seed__"
@@ -90,8 +92,8 @@ def register_op(
     op_registry.h:127,192)."""
 
     def deco(fn):
-        if type in OPS:
-            raise ValueError(f"op '{type}' registered twice")
+        enforce(type not in OPS, "op '%s' registered twice", type,
+                context="register_op")
         OPS[type] = OpInfo(
             type, fn, needs_rng=needs_rng, grad=grad, infer_shape=infer_shape,
             no_grad=no_grad, ref=ref,

@@ -1,0 +1,66 @@
+"""Invariant layer (reference platform/enforce.h PADDLE_ENFORCE* family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.enforce import (
+    EnforceNotMet, enforce, enforce_eq, enforce_ge, enforce_not_none,
+    enforce_shape_match, throw_on,
+)
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+def test_enforce_family():
+    enforce(True)
+    enforce_eq(3, 3)
+    enforce_ge(4, 4)
+    enforce_shape_match([-1, 8], [32, 8])
+    assert enforce_not_none(5) == 5
+
+    with pytest.raises(EnforceNotMet, match="enforce failed"):
+        enforce(False)
+    with pytest.raises(EnforceNotMet, match="expected 3 == 4"):
+        enforce_eq(3, 4)
+    with pytest.raises(EnforceNotMet, match=r"\[conv2d\] bad filter 7"):
+        throw_on("bad filter %d", 7, context="conv2d")
+    with pytest.raises(EnforceNotMet, match="shape mismatch"):
+        enforce_shape_match([2, 3], [2, 4])
+    with pytest.raises(EnforceNotMet, match="must not be None"):
+        enforce_not_none(None, "weights")
+    # ValueError subclass: existing except-ValueError callers keep working
+    with pytest.raises(ValueError):
+        enforce(False)
+
+
+def test_enforce_in_framework_paths():
+    """The adopted sites raise EnforceNotMet with framework context."""
+    from paddle_tpu.fluid.registry import register_op
+
+    with pytest.raises(EnforceNotMet, match="registered twice"):
+        register_op("relu")(lambda ctx, ins, attrs: None)
+
+    # ParallelExecutor's indivisible-sharding check
+    from paddle_tpu.parallel import make_mesh
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data(name="x", shape=[6], dtype="float32")
+        y = layers.fc(input=x, size=5)
+        cost = layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        from paddle_tpu.parallel import ShardingPlan
+
+        pe = fluid.ParallelExecutor(
+            use_cuda=False, loss_name=cost.name, main_program=main,
+            mesh=make_mesh({"tp": 8}),
+            sharding_plan=ShardingPlan([(r".*\.w_.*", ("tp", None))],
+                                       batch_axis=None),
+        )
+        with pytest.raises(EnforceNotMet, match="does not divide"):
+            pe.run(feed={"x": np.ones((8, 6), np.float32)},
+                   fetch_list=[cost.name])

@@ -1,0 +1,147 @@
+"""Staged TPU bring-up diagnostic.
+
+The TPU attach here is a PJRT plugin over a tunnel whose remote-compile
+service has been observed to (a) fail fast, (b) hang indefinitely, or
+(c) die mid-compile of a large graph ("Connection refused" on
+/remote_compile after the probe and small graphs succeeded). This script
+bisects where the stack breaks by running progressively larger workloads,
+EACH IN ITS OWN SUBPROCESS with a hard timeout, so one wedged stage can't
+take down the report:
+
+  1. attach        — jax.devices()
+  2. matmul        — one 256x256 matmul
+  3. conv          — one conv2d+relu forward
+  4. lenet_train   — full train step, tiny convnet (Program IR stack)
+  5. resnet_fwd    — ResNet-50 forward only, bs=8
+  6. resnet_train  — ResNet-50 train step, bs=32 (the bench workload)
+
+Prints one JSON line per stage: {"stage": ..., "ok": bool, "seconds": N,
+"error": ...}. Use STAGES=attach,matmul to subset; STAGE_TIMEOUT to widen
+the default 600s per-stage cap.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_STAGE_SRC = {
+    "attach": """
+import jax
+print("devices:", jax.devices())
+""",
+    "matmul": """
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+jax.block_until_ready(x @ x)
+""",
+    "conv": """
+import jax, jax.numpy as jnp
+x = jnp.ones((8, 3, 64, 64))
+w = jnp.ones((16, 3, 3, 3))
+y = jax.lax.conv_general_dilated(x, w, (1, 1), "SAME",
+                                 dimension_numbers=("NCHW", "OIHW", "NCHW"))
+jax.block_until_ready(jax.nn.relu(y))
+""",
+    "lenet_train": """
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+from paddle_tpu.models import lenet
+main, startup, scope = Program(), Program(), fluid.Scope()
+with fluid.scope_guard(scope):
+    with program_guard(main, startup):
+        img = layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        avg_cost, _, _ = lenet.build(img, label)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+    exe = fluid.Executor()
+    exe.run(startup)
+    x = np.random.rand(32, 1, 28, 28).astype(np.float32)
+    y = np.random.randint(0, 10, size=(32, 1)).astype(np.int64)
+    (l,) = exe.run(main, feed={"img": x, "label": y}, fetch_list=[avg_cost])
+    print("loss:", float(l.reshape(-1)[0]))
+""",
+    "resnet_fwd": """
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+from paddle_tpu.models import resnet
+main, startup, scope = Program(), Program(), fluid.Scope()
+with fluid.scope_guard(scope):
+    with program_guard(main, startup):
+        img = layers.data(name="img", shape=[3, 224, 224], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        avg_cost, _, _ = resnet.build_train(img, label, class_dim=1000,
+                                            depth=50)
+    exe = fluid.Executor()
+    exe.run(startup)
+    x = np.random.rand(8, 3, 224, 224).astype(np.float32)
+    y = np.random.randint(0, 1000, size=(8, 1)).astype(np.int64)
+    (l,) = exe.run(main, feed={"img": x, "label": y}, fetch_list=[avg_cost])
+    print("loss:", float(l.reshape(-1)[0]))
+""",
+    "resnet_train": """
+import numpy as np
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+from paddle_tpu.models import resnet
+main, startup, scope = Program(), Program(), fluid.Scope()
+with fluid.scope_guard(scope):
+    with program_guard(main, startup):
+        img = layers.data(name="img", shape=[3, 224, 224], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        avg_cost, _, _ = resnet.build_train(img, label, class_dim=1000,
+                                            depth=50)
+        fluid.optimizer.Momentum(learning_rate=0.01,
+                                 momentum=0.9).minimize(avg_cost)
+    exe = fluid.Executor()
+    exe.run(startup)
+    x = np.random.rand(32, 3, 224, 224).astype(np.float32)
+    y = np.random.randint(0, 1000, size=(32, 1)).astype(np.int64)
+    (l,) = exe.run(main, feed={"img": x, "label": y}, fetch_list=[avg_cost])
+    print("loss:", float(l.reshape(-1)[0]))
+""",
+}
+
+STAGE_ORDER = ["attach", "matmul", "conv", "lenet_train", "resnet_fwd",
+               "resnet_train"]
+
+
+def run_stage(name: str, timeout_s: int) -> dict:
+    src = "import sys; sys.path.insert(0, %r)\n" % REPO + _STAGE_SRC[name]
+    t0 = time.time()
+    try:
+        p = subprocess.run([sys.executable, "-c", src], timeout=timeout_s,
+                           capture_output=True, text=True)
+        dt = time.time() - t0
+        if p.returncode == 0:
+            return {"stage": name, "ok": True, "seconds": round(dt, 1)}
+        return {"stage": name, "ok": False, "seconds": round(dt, 1),
+                "error": (p.stderr or p.stdout).strip()[-400:]}
+    except subprocess.TimeoutExpired:
+        return {"stage": name, "ok": False, "seconds": timeout_s,
+                "error": f"timed out after {timeout_s}s (tunnel hang)"}
+
+
+def main():
+    timeout_s = int(os.environ.get("STAGE_TIMEOUT", "600"))
+    only = os.environ.get("STAGES")
+    stages = [s for s in STAGE_ORDER
+              if not only or s in only.split(",")]
+    stop_on_fail = os.environ.get("KEEP_GOING", "0") != "1"
+    for s in stages:
+        r = run_stage(s, timeout_s)
+        print(json.dumps(r), flush=True)
+        if not r["ok"] and stop_on_fail:
+            break
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
