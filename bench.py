@@ -109,15 +109,21 @@ def _probe_once():
     return None
 
 
-def supervise():
+def _probe_with_retries():
+    """PROBE_RETRIES attempts with linear backoff; stops early on any
+    conclusive answer (a cpu-only host needs no retries)."""
     platform = None
     for i in range(PROBE_RETRIES):
         platform = _probe_once()
-        if platform is not None:  # a cpu-only host needs no backoff retries
+        if platform is not None:
             break
         if i < PROBE_RETRIES - 1:
             time.sleep(10 * (i + 1))
-    tpu_ok = platform == "tpu"
+    return platform
+
+
+def supervise():
+    tpu_ok = _probe_with_retries() == "tpu"
 
     # Staged TPU attempts: the tunnel's remote-compile service has died
     # mid-compile of the full bs=32 train-step graph before ("Connection
@@ -152,7 +158,7 @@ def supervise():
         print(f"# {label} bench failed", file=sys.stderr)
         if i < len(attempts) - 1:
             print("# re-probing tunnel before next attempt", file=sys.stderr)
-            tpu_ok = _probe_once() == "tpu"
+            tpu_ok = _probe_with_retries() == "tpu"
     if tpu_attempted or tpu_ok:
         print("# tpu attempts exhausted; falling back to cpu",
               file=sys.stderr)

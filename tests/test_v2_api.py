@@ -1,6 +1,7 @@
 """v2 facade: event-loop trainer, parameters, inference (reference
 python/paddle/v2/trainer.py SGD + tests/book v2-style usage)."""
 import numpy as np
+import pytest
 
 import paddle_tpu.v2 as paddle
 
@@ -191,3 +192,68 @@ def test_v2_topology_serialize_roundtrip(tmp_path):
                           fetch_list=topo2.layers)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_v2_ploter(capsys, tmp_path, monkeypatch):
+    """reference v2/plot Ploter: series accumulate; DISABLE_PLOT degrades to
+    text; file output renders a png."""
+    from paddle_tpu.v2.plot import Ploter
+
+    p = Ploter("train cost", "test cost")
+    for i in range(3):
+        p.append("train cost", i, 1.0 / (i + 1))
+    p.append("test cost", 0, 0.5)
+    assert p.data("train cost")[1][0] == 1.0
+    with pytest.raises(KeyError):
+        p.append("bogus", 0, 0.0)
+
+    monkeypatch.setenv("DISABLE_PLOT", "True")
+    p.plot()
+    out = capsys.readouterr().out
+    assert "train cost" in out and "3 points" in out
+
+    monkeypatch.delenv("DISABLE_PLOT")
+    png = tmp_path / "curve.png"
+    p.plot(path=str(png))
+    assert png.exists() and png.stat().st_size > 0
+
+    p.reset()
+    assert p.data("train cost") == ([], [])
+
+
+def test_v2_trainer_cli(tmp_path, capsys):
+    """paddle_trainer-style CLI (reference TrainerMain.cpp): config file in,
+    passes + checkpoints out."""
+    from paddle_tpu.v2 import trainer_cli
+
+    cfg = tmp_path / "config.py"
+    cfg.write_text(
+        "import numpy as np\n"
+        "import paddle_tpu.v2 as paddle\n"
+        "x = paddle.layer.data(name='x', type=paddle.layer.data_type"
+        ".dense_vector(4))\n"
+        "y = paddle.layer.data(name='y', type=paddle.layer.data_type"
+        ".dense_vector(1))\n"
+        "pred = paddle.layer.fc_layer(input=x, size=1)\n"
+        "cost = paddle.layer.square_error_cost(input=pred, label=y)\n"
+        "optimizer = paddle.optimizer.Momentum(learning_rate=0.05)\n"
+        "_w = np.arange(4).astype('float32').reshape(4, 1)\n"
+        "_rng = np.random.RandomState(0)\n"
+        "def train_reader():\n"
+        "    for _ in range(8):\n"
+        "        xb = _rng.rand(8, 4).astype('float32')\n"
+        "        yield [(xb[i], xb[i] @ _w) for i in range(8)]\n"
+        "test_reader = train_reader\n"
+    )
+    rc = trainer_cli.main([
+        "--config", str(cfg), "--num-passes", "2",
+        "--save-dir", str(tmp_path / "ckpt"), "--log-period", "4",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "pass 0 batch 0" in out and "test cost" in out
+    assert (tmp_path / "ckpt" / "params_pass_1.tar").exists()
+    # the linear target must be learnable: last logged test cost < first
+    tests = [float(l.split()[-1]) for l in out.splitlines()
+             if "test cost" in l]
+    assert tests[-1] < tests[0]

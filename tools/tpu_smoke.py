@@ -132,6 +132,12 @@ def run_stage(name: str, timeout_s: int) -> dict:
 def main():
     timeout_s = int(os.environ.get("STAGE_TIMEOUT", "600"))
     only = os.environ.get("STAGES")
+    if only:
+        unknown = sorted(set(only.split(",")) - set(STAGE_ORDER))
+        if unknown:
+            print(json.dumps({"error": f"unknown STAGES {unknown}; "
+                              f"valid: {STAGE_ORDER}"}))
+            return 1
     stages = [s for s in STAGE_ORDER
               if not only or s in only.split(",")]
     stop_on_fail = os.environ.get("KEEP_GOING", "0") != "1"
