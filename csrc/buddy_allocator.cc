@@ -17,18 +17,49 @@
 
 namespace {
 
+struct Alloc {
+  int level;
+  uint64_t requested;  // bytes the caller asked for (guard starts after)
+};
+
+// Guard bytes: the slack between the requested size and the (power-of-two)
+// block is stamped with a canary on alloc and verified on free/check —
+// the role of the reference's MetadataCache guard fields
+// (memory/detail/memory_block_desc.cc checksums, meta_cache.cc).
+constexpr unsigned char kGuardByte = 0xAB;
+constexpr uint64_t kGuardMax = 16;  // stamp at most this many slack bytes
+
 struct Buddy {
   unsigned char* arena = nullptr;
   uint64_t total = 0;       // power of two
   uint64_t min_block = 0;   // power of two
   int levels = 0;           // level 0 = whole arena
-  // free offsets per level; allocated offset -> level
+  // free offsets per level; allocated offset -> alloc record
   std::vector<std::set<uint64_t>> free_lists;
-  std::map<uint64_t, int> allocated;
+  std::map<uint64_t, Alloc> allocated;
   uint64_t used = 0;
   std::mutex mu;
 
   uint64_t block_size(int level) const { return total >> level; }
+
+  uint64_t guard_len(const Alloc& a) const {
+    uint64_t slack = block_size(a.level) - a.requested;
+    return slack < kGuardMax ? slack : kGuardMax;
+  }
+
+  void stamp(uint64_t off, const Alloc& a) {
+    uint64_t n = guard_len(a);
+    unsigned char* g = arena + off + a.requested;
+    for (uint64_t i = 0; i < n; ++i) g[i] = kGuardByte;
+  }
+
+  bool intact(uint64_t off, const Alloc& a) const {
+    uint64_t n = guard_len(a);
+    const unsigned char* g = arena + off + a.requested;
+    for (uint64_t i = 0; i < n; ++i)
+      if (g[i] != kGuardByte) return false;
+    return true;
+  }
 };
 
 uint64_t next_pow2(uint64_t v) {
@@ -79,8 +110,10 @@ void* pt_buddy_alloc(void* bp, uint64_t size) {
     uint64_t buddy_off = off + b->block_size(l);
     b->free_lists[l].insert(buddy_off);
   }
-  b->allocated[off] = level;
+  Alloc rec{level, size};
+  b->allocated[off] = rec;
   b->used += b->block_size(level);
+  b->stamp(off, rec);
   return b->arena + off;
 }
 
@@ -90,7 +123,8 @@ int pt_buddy_free(void* bp, void* p) {
   std::lock_guard<std::mutex> lk(b->mu);
   auto it = b->allocated.find(off);
   if (it == b->allocated.end()) return -1;  // double free / bad pointer
-  int level = it->second;
+  int rc = b->intact(off, it->second) ? 0 : -2;  // -2 = overwrite detected
+  int level = it->second.level;
   b->allocated.erase(it);
   b->used -= b->block_size(level);
   // coalesce with buddy while possible
@@ -104,7 +138,18 @@ int pt_buddy_free(void* bp, void* p) {
     level--;
   }
   b->free_lists[level].insert(off);
-  return 0;
+  return rc;
+}
+
+// Sweep every live allocation's guard region; returns the number of
+// corrupted blocks (0 = clean). The reference's meta_cache guard check.
+uint64_t pt_buddy_check(void* bp) {
+  auto* b = static_cast<Buddy*>(bp);
+  std::lock_guard<std::mutex> lk(b->mu);
+  uint64_t bad = 0;
+  for (const auto& kv : b->allocated)
+    if (!b->intact(kv.first, kv.second)) bad++;
+  return bad;
 }
 
 uint64_t pt_buddy_used(void* bp) {

@@ -1,0 +1,93 @@
+/* Multi-threaded C consumer of the inference C API (role of the
+ * reference's inference/tests/book multi-thread variant,
+ * test_multi_thread_helper.h: N threads, each with its own executor/scope
+ * over one loaded model). Each thread creates its OWN predictor for the
+ * model dir, runs the same fixed input, and the main thread checks every
+ * thread produced byte-identical results. */
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "inference_capi.h"
+
+#define NTHREADS 4
+#define NROWS 2
+#define NFEAT 13
+
+typedef struct {
+  const char* model_dir;
+  int id;
+  int ok;
+  long long total;
+  float* values; /* malloc'd copy of the outputs */
+} job_t;
+
+static void* worker(void* arg) {
+  job_t* j = (job_t*)arg;
+  j->ok = 0;
+  pt_predictor_t p = pt_predictor_create(j->model_dir);
+  if (p == NULL) {
+    fprintf(stderr, "[t%d] create failed: %s\n", j->id, pt_last_error());
+    return NULL;
+  }
+  float in[NROWS * NFEAT];
+  for (int i = 0; i < NROWS * NFEAT; ++i) in[i] = 0.1f * (float)i;
+  int64_t dims[2] = {NROWS, NFEAT};
+  float* out = NULL;
+  int64_t* odims = NULL;
+  int ondim = 0;
+  if (pt_predictor_set_input(p, 0, in, dims, 2) != 0 ||
+      pt_predictor_run(p) != 0 ||
+      pt_predictor_get_output(p, 0, &out, &odims, &ondim) != 0) {
+    fprintf(stderr, "[t%d] run failed: %s\n", j->id, pt_last_error());
+    pt_predictor_destroy(p);
+    return NULL;
+  }
+  long long total = 1;
+  for (int i = 0; i < ondim; ++i) total *= odims[i];
+  j->total = total;
+  j->values = (float*)malloc(sizeof(float) * (size_t)total);
+  memcpy(j->values, out, sizeof(float) * (size_t)total);
+  pt_buffer_free(out);
+  pt_buffer_free(odims);
+  pt_predictor_destroy(p);
+  j->ok = 1;
+  return NULL;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_dir>\n", argv[0]);
+    return 2;
+  }
+  pthread_t th[NTHREADS];
+  job_t jobs[NTHREADS];
+  for (int t = 0; t < NTHREADS; ++t) {
+    jobs[t].model_dir = argv[1];
+    jobs[t].id = t;
+    jobs[t].values = NULL;
+    pthread_create(&th[t], NULL, worker, &jobs[t]);
+  }
+  for (int t = 0; t < NTHREADS; ++t) pthread_join(th[t], NULL);
+
+  for (int t = 0; t < NTHREADS; ++t) {
+    if (!jobs[t].ok) {
+      fprintf(stderr, "thread %d failed\n", t);
+      return 1;
+    }
+    if (jobs[t].total != jobs[0].total ||
+        memcmp(jobs[t].values, jobs[0].values,
+               sizeof(float) * (size_t)jobs[0].total) != 0) {
+      fprintf(stderr, "thread %d output differs from thread 0\n", t);
+      return 1;
+    }
+  }
+  printf("threads=%d agree total=%lld\nvalues:", NTHREADS,
+         jobs[0].total);
+  for (long long i = 0; i < jobs[0].total; ++i)
+    printf(" %.6f", jobs[0].values[i]);
+  printf("\n");
+  for (int t = 0; t < NTHREADS; ++t) free(jobs[t].values);
+  return 0;
+}

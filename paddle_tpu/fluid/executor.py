@@ -67,9 +67,19 @@ class Scope:
 
 _global_scope = Scope()
 
+import threading as _threading
+
+# Per-thread guard stack (reference scope_guard swaps a process global, but
+# its multithread inference path gives each thread its own Scope — a shared
+# mutable "current scope" made concurrent predictors read each other's
+# scopes, caught by the multithreaded C-API test). A thread with no guards
+# of its own sees the process root scope.
+_scope_tls = _threading.local()
+
 
 def global_scope() -> Scope:
-    return _global_scope
+    stack = getattr(_scope_tls, "stack", None)
+    return stack[-1] if stack else _global_scope
 
 
 import contextlib
@@ -77,12 +87,14 @@ import contextlib
 
 @contextlib.contextmanager
 def scope_guard(scope: Scope):
-    global _global_scope
-    prev, _global_scope = _global_scope, scope
+    stack = getattr(_scope_tls, "stack", None)
+    if stack is None:
+        stack = _scope_tls.stack = []
+    stack.append(scope)
     try:
         yield
     finally:
-        _global_scope = prev
+        stack.pop()
 
 
 def fetch_var(name: str, scope: Optional[Scope] = None, return_numpy: bool = True):

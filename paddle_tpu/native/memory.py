@@ -58,10 +58,26 @@ class BuddyAllocator:
             raise ValueError("array was not allocated by this allocator")
         del self._handles[id(arr)]
         if self._h is not None:
-            if self._lib.pt_buddy_free(self._h, entry[0]):
+            rc = self._lib.pt_buddy_free(self._h, entry[0])
+            if rc == -1:
                 raise ValueError("double free or bad pointer")
+            if rc == -2:
+                # block was returned to the arena, but its guard bytes were
+                # clobbered — someone wrote past the requested size
+                raise MemoryError(
+                    "heap overwrite detected: guard bytes past the block's "
+                    "requested size were clobbered (reference meta_cache "
+                    "guard check)")
         else:
             self._used -= arr.nbytes
+
+    def check(self) -> int:
+        """Sweep all live blocks' guard regions; returns the number of
+        corrupted blocks (reference memory/detail/meta_cache.cc guards —
+        the §5.2 memory-debug capability)."""
+        if self._h is not None:
+            return int(self._lib.pt_buddy_check(self._h))
+        return 0
 
     def memory_usage(self) -> int:
         """Bytes currently allocated (reference memory::memory_usage)."""

@@ -39,8 +39,10 @@ def _load_config(path: str) -> dict:
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(prog="paddle_tpu.v2.trainer_cli",
-                                 description=__doc__.splitlines()[0])
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.v2.trainer_cli",
+        description="paddle_trainer-style CLI over the v2 facade",
+    )
     ap.add_argument("--config", required=True,
                     help="python file declaring cost/optimizer/train_reader")
     ap.add_argument("--num-passes", type=int, default=1)

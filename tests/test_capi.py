@@ -83,3 +83,36 @@ def test_c_consumer_matches_python(tmp_path):
                    if ln.startswith("values:")][0]
     got = np.array([float(v) for v in values_line.split()[1:]])
     np.testing.assert_allclose(got, expect.ravel(), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_c_consumer_multithreaded(tmp_path):
+    """reference inference/tests/book test_multi_thread_helper.h: N threads
+    each with its own predictor over one saved model; outputs must agree
+    (and match Python)."""
+    r = subprocess.run(["make", "-C", CSRC, "capi"], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+
+    model_dir, expect = _save_model(str(tmp_path))
+    exe_path = str(tmp_path / "mt_consumer")
+    r = subprocess.run(
+        ["gcc", os.path.join(CSRC, "test_capi_mt_consumer.c"),
+         "-I", CSRC, "-L", CSRC, "-lpaddle_tpu_capi", "-lpthread",
+         f"-Wl,-rpath,{CSRC}", "-o", exe_path],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    env = dict(os.environ)
+    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+          if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + pp)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([exe_path, model_dir], capture_output=True, text=True,
+                       env=env, timeout=300)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr[-2000:]}"
+    assert "threads=4 agree" in r.stdout
+    values_line = [ln for ln in r.stdout.splitlines()
+                   if ln.startswith("values:")][0]
+    got = np.array([float(v) for v in values_line.split()[1:]])
+    np.testing.assert_allclose(got, expect.ravel(), rtol=1e-4, atol=1e-5)

@@ -309,3 +309,29 @@ def test_channel_rendezvous_try_send():
     t.join()
     assert got == ["y"]
     ch.close()
+
+
+def test_buddy_guard_bytes_detect_overwrite():
+    """Memory-debug guards (reference memory/detail/meta_cache.cc metadata
+    checksums, SURVEY 5.2): writing past a block's requested size must be
+    caught by check() and by free()."""
+    import ctypes
+
+    if not native.available():
+        pytest.skip("needs the native library")
+    a = BuddyAllocator(1 << 16, min_block=256)
+    try:
+        buf = a.alloc(100)  # block rounds to 256 -> guard bytes exist
+        assert a.check() == 0
+        # clobber one byte past the requested 100
+        addr, _ = a._handles[id(buf)]
+        ctypes.memset(addr + 100, 0x5A, 1)
+        assert a.check() == 1
+        with pytest.raises(MemoryError, match="heap overwrite"):
+            a.free(buf)
+        # clean block round-trips fine
+        b2 = a.alloc(100)
+        assert a.check() == 0
+        a.free(b2)
+    finally:
+        a.close()
