@@ -28,6 +28,7 @@ struct Alloc {
 // (memory/detail/memory_block_desc.cc checksums, meta_cache.cc).
 constexpr unsigned char kGuardByte = 0xAB;
 constexpr uint64_t kGuardMax = 16;  // stamp at most this many slack bytes
+constexpr uint64_t kGuardMin = 8;   // always reserve at least this much
 
 struct Buddy {
   unsigned char* arena = nullptr;
@@ -92,7 +93,12 @@ void* pt_buddy_create(uint64_t total_bytes, uint64_t min_block) {
 void* pt_buddy_alloc(void* bp, uint64_t size) {
   auto* b = static_cast<Buddy*>(bp);
   if (size == 0 || size > b->total) return nullptr;
+  // Reserve guard space beyond the request so even exact power-of-two
+  // sizes (the common staging-buffer case) carry a stamped guard region:
+  // bump one block level when the natural slack is under kGuardMin. A
+  // whole-arena request keeps working (and stays guardless, as before).
   uint64_t want = next_pow2(size < b->min_block ? b->min_block : size);
+  if (want - size < kGuardMin && want < b->total) want <<= 1;
   int level = 0;
   while (b->block_size(level) > want && level < b->levels) level++;
   if (b->block_size(level) < want) level--;

@@ -63,13 +63,18 @@ int main(int argc, char** argv) {
   }
   pthread_t th[NTHREADS];
   job_t jobs[NTHREADS];
+  int spawned[NTHREADS];
   for (int t = 0; t < NTHREADS; ++t) {
     jobs[t].model_dir = argv[1];
     jobs[t].id = t;
+    jobs[t].ok = 0;
+    jobs[t].total = 0;
     jobs[t].values = NULL;
-    pthread_create(&th[t], NULL, worker, &jobs[t]);
+    spawned[t] = pthread_create(&th[t], NULL, worker, &jobs[t]) == 0;
+    if (!spawned[t]) fprintf(stderr, "pthread_create failed for %d\n", t);
   }
-  for (int t = 0; t < NTHREADS; ++t) pthread_join(th[t], NULL);
+  for (int t = 0; t < NTHREADS; ++t)
+    if (spawned[t]) pthread_join(th[t], NULL);
 
   for (int t = 0; t < NTHREADS; ++t) {
     if (!jobs[t].ok) {

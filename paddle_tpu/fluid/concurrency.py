@@ -59,7 +59,18 @@ class Go:
         self._threads: List[threading.Thread] = []
 
     def spawn(self, fn: Callable, *args, **kwargs) -> threading.Thread:
-        t = threading.Thread(target=fn, args=args, kwargs=kwargs, daemon=True)
+        # scope guards are per-thread (executor.py _scope_tls), so inherit
+        # the SPAWNER's current scope explicitly — a goroutine driving its
+        # own Executor.run loop keeps resolving the scope its creator was in
+        from .executor import global_scope, scope_guard
+
+        spawner_scope = global_scope()
+
+        def run():
+            with scope_guard(spawner_scope):
+                fn(*args, **kwargs)
+
+        t = threading.Thread(target=run, daemon=True)
         t.start()
         self._threads.append(t)
         return t

@@ -49,56 +49,27 @@ def _save_model(tmp):
     return model_dir, np.asarray(expect)
 
 
-@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
-def test_c_consumer_matches_python(tmp_path):
-    lib = os.path.join(CSRC, "libpaddle_tpu_capi.so")
+def _cc():
+    """The C compiler to drive (the capi consumers are plain C)."""
+    return shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
+
+
+def _compile_and_run_consumer(tmp_path, src_name, exe_name, model_dir,
+                              extra_flags=()):
+    """Build libpaddle_tpu_capi.so, compile csrc/<src_name> against it, and
+    run it on model_dir in a hermetic CPU env (the axon site hook
+    re-registers the TPU backend in every process and a wedged tunnel
+    attach can hang the consumer - scrub it from PYTHONPATH, same trick as
+    bench.py). Returns captured stdout."""
     r = subprocess.run(["make", "-C", CSRC, "capi"], capture_output=True,
                        text=True)
     assert r.returncode == 0, r.stderr
-    assert os.path.exists(lib)
+    assert os.path.exists(os.path.join(CSRC, "libpaddle_tpu_capi.so"))
 
-    model_dir, expect = _save_model(str(tmp_path))
-
-    exe_path = str(tmp_path / "consumer")
+    exe_path = str(tmp_path / exe_name)
     r = subprocess.run(
-        ["gcc", os.path.join(CSRC, "test_capi_consumer.c"),
-         "-I", CSRC, "-L", CSRC, "-lpaddle_tpu_capi",
-         f"-Wl,-rpath,{CSRC}", "-o", exe_path],
-        capture_output=True, text=True)
-    assert r.returncode == 0, r.stderr
-
-    env = dict(os.environ)
-    # hermetic CPU run: the axon site hook re-registers the TPU backend in
-    # every process and a wedged tunnel attach can hang the consumer —
-    # scrub it from PYTHONPATH entirely (same trick as bench.py)
-    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-          if p and "axon" not in p]
-    env["PYTHONPATH"] = os.pathsep.join([REPO] + pp)
-    env["JAX_PLATFORMS"] = "cpu"
-    r = subprocess.run([exe_path, model_dir], capture_output=True, text=True,
-                       env=env, timeout=240)
-    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr[-2000:]}"
-    assert "feeds=1 fetches=1 feed0=x" in r.stdout
-    values_line = [ln for ln in r.stdout.splitlines()
-                   if ln.startswith("values:")][0]
-    got = np.array([float(v) for v in values_line.split()[1:]])
-    np.testing.assert_allclose(got, expect.ravel(), rtol=1e-4, atol=1e-5)
-
-
-@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
-def test_c_consumer_multithreaded(tmp_path):
-    """reference inference/tests/book test_multi_thread_helper.h: N threads
-    each with its own predictor over one saved model; outputs must agree
-    (and match Python)."""
-    r = subprocess.run(["make", "-C", CSRC, "capi"], capture_output=True,
-                       text=True)
-    assert r.returncode == 0, r.stderr
-
-    model_dir, expect = _save_model(str(tmp_path))
-    exe_path = str(tmp_path / "mt_consumer")
-    r = subprocess.run(
-        ["gcc", os.path.join(CSRC, "test_capi_mt_consumer.c"),
-         "-I", CSRC, "-L", CSRC, "-lpaddle_tpu_capi", "-lpthread",
+        [_cc(), os.path.join(CSRC, src_name),
+         "-I", CSRC, "-L", CSRC, "-lpaddle_tpu_capi", *extra_flags,
          f"-Wl,-rpath,{CSRC}", "-o", exe_path],
         capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
@@ -111,8 +82,35 @@ def test_c_consumer_multithreaded(tmp_path):
     r = subprocess.run([exe_path, model_dir], capture_output=True, text=True,
                        env=env, timeout=300)
     assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr[-2000:]}"
-    assert "threads=4 agree" in r.stdout
-    values_line = [ln for ln in r.stdout.splitlines()
-                   if ln.startswith("values:")][0]
-    got = np.array([float(v) for v in values_line.split()[1:]])
-    np.testing.assert_allclose(got, expect.ravel(), rtol=1e-4, atol=1e-5)
+    return r.stdout
+
+
+def _fetch_values(stdout):
+    line = [ln for ln in stdout.splitlines() if ln.startswith("values:")][0]
+    return np.array([float(v) for v in line.split()[1:]])
+
+
+@pytest.mark.skipif(shutil.which("g++") is None or _cc() is None,
+                    reason="no C/C++ toolchain")
+def test_c_consumer_matches_python(tmp_path):
+    model_dir, expect = _save_model(str(tmp_path))
+    out = _compile_and_run_consumer(tmp_path, "test_capi_consumer.c",
+                                    "consumer", model_dir)
+    assert "feeds=1 fetches=1 feed0=x" in out
+    np.testing.assert_allclose(_fetch_values(out), expect.ravel(),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.skipif(shutil.which("g++") is None or _cc() is None,
+                    reason="no C/C++ toolchain")
+def test_c_consumer_multithreaded(tmp_path):
+    """reference inference/tests/book test_multi_thread_helper.h: N threads
+    each with its own predictor over one saved model; outputs must agree
+    (and match Python)."""
+    model_dir, expect = _save_model(str(tmp_path))
+    out = _compile_and_run_consumer(tmp_path, "test_capi_mt_consumer.c",
+                                    "mt_consumer", model_dir,
+                                    extra_flags=("-lpthread",))
+    assert "threads=4 agree" in out
+    np.testing.assert_allclose(_fetch_values(out), expect.ravel(),
+                               rtol=1e-4, atol=1e-5)

@@ -335,3 +335,40 @@ def test_buddy_guard_bytes_detect_overwrite():
         a.free(b2)
     finally:
         a.close()
+
+
+def test_buddy_guard_covers_power_of_two_sizes():
+    """Exact power-of-two requests bump one block level so a guard region
+    always exists (except a whole-arena alloc, which has nowhere to put
+    one)."""
+    import ctypes
+
+    if not native.available():
+        pytest.skip("needs the native library")
+    a = BuddyAllocator(1 << 16, min_block=256)
+    try:
+        buf = a.alloc(1024)  # pow2: guard lives in the bumped block's slack
+        addr, _ = a._handles[id(buf)]
+        ctypes.memset(addr + 1024, 0x5A, 1)
+        assert a.check() == 1
+        with pytest.raises(MemoryError, match="heap overwrite"):
+            a.free(buf)
+    finally:
+        a.close()
+
+
+def test_go_inherits_spawner_scope():
+    """Go-routines run under the scope their spawner was in (scope guards
+    are per-thread; spawn captures the creator's current scope)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import concurrency as cc
+
+    sc = fluid.Scope()
+    seen = {}
+    with fluid.scope_guard(sc):
+        sc.set_var("x", np.arange(3))
+        with cc.Go() as g:
+            g.spawn(lambda: seen.update(
+                ok=fluid.executor.global_scope().has_var("x")))
+        g.join()
+    assert seen["ok"]

@@ -1,0 +1,189 @@
+"""Loss-curve parity against an independent implementation (BASELINE.json:
+"match ... with loss-curve parity"). The same LeNet + Momentum training
+run — identical initial weights, identical data stream — is executed on
+this framework and on torch (CPU); per-step losses must track each other.
+
+This is a *behavioral* cross-check: two frameworks implementing the same
+math (conv2d valid-padding, max-pool, fc, softmax-CE-mean, classic
+momentum) should produce the same curve up to float accumulation order."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers, nets
+from paddle_tpu.fluid.framework import Program, program_guard
+
+torch = pytest.importorskip("torch")
+
+STEPS = 8
+BATCH = 16
+LR = 0.05
+MU = 0.9
+
+
+def _build_paddle():
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            img = layers.data(name="img", shape=[1, 28, 28],
+                              dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            c1 = nets.simple_img_conv_pool(
+                input=img, filter_size=5, num_filters=8, pool_size=2,
+                pool_stride=2, act="relu")
+            c2 = nets.simple_img_conv_pool(
+                input=c1, filter_size=5, num_filters=16, pool_size=2,
+                pool_stride=2, act="relu")
+            fc1 = layers.fc(input=c2, size=64, act="relu")
+            logits = layers.fc(input=fc1, size=10)
+            cost = layers.mean(layers.softmax_with_cross_entropy(
+                logits=logits, label=label))
+            fluid.optimizer.Momentum(learning_rate=LR,
+                                     momentum=MU).minimize(cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+    return main, scope, exe, cost
+
+
+def test_lenet_loss_curve_matches_torch():
+    main, scope, exe, cost = _build_paddle()
+
+    # mirror the paddle-initialized weights into a torch net
+    params = {p.name: np.asarray(scope.find_var(p.name))
+              for p in main.global_block().all_parameters()}
+    # conv2d_N.w_0 = filter [O,I,H,W], .w_1 = channel bias; fc_N.w_0 =
+    # weight [in,out], .w_1 = bias — distinguish by rank
+    conv_w = sorted(n for n in params
+                    if "conv2d" in n and params[n].ndim == 4)
+    conv_b = sorted(n for n in params
+                    if "conv2d" in n and params[n].ndim < 4)
+    fc_w = sorted(n for n in params
+                  if n.startswith("fc") and params[n].ndim == 2)
+    fc_b = sorted(n for n in params
+                  if n.startswith("fc") and params[n].ndim < 2)
+    assert len(conv_w) == 2 and len(fc_w) == 2, sorted(params)
+
+    class Net(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = torch.nn.Conv2d(1, 8, 5)
+            self.c2 = torch.nn.Conv2d(8, 16, 5)
+            self.f1 = torch.nn.Linear(16 * 4 * 4, 64)
+            self.f2 = torch.nn.Linear(64, 10)
+
+        def forward(self, x):
+            x = torch.relu(self.c1(x))
+            x = torch.max_pool2d(x, 2, 2)
+            x = torch.relu(self.c2(x))
+            x = torch.max_pool2d(x, 2, 2)
+            x = x.flatten(1)
+            x = torch.relu(self.f1(x))
+            return self.f2(x)
+
+    net = Net()
+    with torch.no_grad():
+        net.c1.weight.copy_(torch.from_numpy(params[conv_w[0]]))
+        net.c1.bias.copy_(torch.from_numpy(params[conv_b[0]].ravel()))
+        net.c2.weight.copy_(torch.from_numpy(params[conv_w[1]]))
+        net.c2.bias.copy_(torch.from_numpy(params[conv_b[1]].ravel()))
+        # paddle fc weight is [in, out]; torch Linear is [out, in]
+        net.f1.weight.copy_(torch.from_numpy(params[fc_w[0]].T))
+        net.f1.bias.copy_(torch.from_numpy(params[fc_b[0]].ravel()))
+        net.f2.weight.copy_(torch.from_numpy(params[fc_w[1]].T))
+        net.f2.bias.copy_(torch.from_numpy(params[fc_b[1]].ravel()))
+
+    opt = torch.optim.SGD(net.parameters(), lr=LR, momentum=MU)
+    ce = torch.nn.CrossEntropyLoss()
+
+    rng = np.random.RandomState(0)
+    ours, theirs = [], []
+    with fluid.scope_guard(scope):
+        for step in range(STEPS):
+            x = rng.rand(BATCH, 1, 28, 28).astype(np.float32)
+            y = rng.randint(0, 10, size=(BATCH, 1)).astype(np.int64)
+            (l,) = exe.run(main, feed={"img": x, "label": y},
+                           fetch_list=[cost])
+            ours.append(float(np.asarray(l).ravel()[0]))
+
+            opt.zero_grad()
+            out = net(torch.from_numpy(x))
+            loss = ce(out, torch.from_numpy(y.ravel()))
+            loss.backward()
+            opt.step()
+            theirs.append(float(loss.detach()))
+
+    # same math, different accumulation order: curves must track closely
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+    assert ours[-1] < ours[0]  # and actually train
+
+
+def test_convbn_loss_curve_matches_torch():
+    """Same cross-check over batch_norm (training-mode batch-stats
+    normalization + affine) — the op family LeNet doesn't touch."""
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            img = layers.data(name="img", shape=[3, 16, 16],
+                              dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            conv = layers.conv2d(input=img, num_filters=8, filter_size=3,
+                                 padding=1, bias_attr=False)
+            bn = layers.batch_norm(input=conv, act="relu")
+            pool = layers.pool2d(input=bn, pool_size=2, pool_stride=2)
+            logits = layers.fc(input=pool, size=10)
+            cost = layers.mean(layers.softmax_with_cross_entropy(
+                logits=logits, label=label))
+            fluid.optimizer.Momentum(learning_rate=LR,
+                                     momentum=MU).minimize(cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        params = {p.name: np.asarray(scope.find_var(p.name))
+                  for p in main.global_block().all_parameters()}
+
+        class Net(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.c = torch.nn.Conv2d(3, 8, 3, padding=1, bias=False)
+                self.bn = torch.nn.BatchNorm2d(8, eps=1e-5, momentum=0.1)
+                self.f = torch.nn.Linear(8 * 8 * 8, 10)
+
+            def forward(self, x):
+                x = torch.relu(self.bn(self.c(x)))
+                x = torch.max_pool2d(x, 2, 2)
+                return self.f(x.flatten(1))
+
+        net = Net()
+        conv_w = [n for n in params if "conv2d" in n][0]
+        # batch_norm_0.w_0 = scale, .w_1 = shift
+        bn_scale = [n for n in params
+                    if "batch_norm" in n and ".w_0" in n][0]
+        bn_shift = [n for n in params
+                    if "batch_norm" in n and ".w_1" in n][0]
+        fc_w = [n for n in params
+                if n.startswith("fc") and params[n].ndim == 2][0]
+        fc_b = [n for n in params
+                if n.startswith("fc") and params[n].ndim == 1][0]
+        with torch.no_grad():
+            net.c.weight.copy_(torch.from_numpy(params[conv_w]))
+            net.bn.weight.copy_(torch.from_numpy(params[bn_scale].ravel()))
+            net.bn.bias.copy_(torch.from_numpy(params[bn_shift].ravel()))
+            net.f.weight.copy_(torch.from_numpy(params[fc_w].T))
+            net.f.bias.copy_(torch.from_numpy(params[fc_b].ravel()))
+
+        opt = torch.optim.SGD(net.parameters(), lr=LR, momentum=MU)
+        ce = torch.nn.CrossEntropyLoss()
+        rng = np.random.RandomState(1)
+        ours, theirs = [], []
+        for step in range(STEPS):
+            x = rng.rand(BATCH, 3, 16, 16).astype(np.float32)
+            y = rng.randint(0, 10, size=(BATCH, 1)).astype(np.int64)
+            (l,) = exe.run(main, feed={"img": x, "label": y},
+                           fetch_list=[cost])
+            ours.append(float(np.asarray(l).ravel()[0]))
+            opt.zero_grad()
+            loss = ce(net(torch.from_numpy(x)), torch.from_numpy(y.ravel()))
+            loss.backward()
+            opt.step()
+            theirs.append(float(loss.detach()))
+        np.testing.assert_allclose(ours, theirs, rtol=3e-3, atol=3e-3)
