@@ -34,6 +34,7 @@ struct Buddy {
   unsigned char* arena = nullptr;
   uint64_t total = 0;       // power of two
   uint64_t min_block = 0;   // power of two
+  bool guard_always = false;  // bump blocks so every alloc has a guard
   int levels = 0;           // level 0 = whole arena
   // free offsets per level; allocated offset -> alloc record
   std::vector<std::set<uint64_t>> free_lists;
@@ -73,9 +74,16 @@ uint64_t next_pow2(uint64_t v) {
 
 extern "C" {
 
-void* pt_buddy_create(uint64_t total_bytes, uint64_t min_block) {
+// guard_mode: 0 = guards only in natural slack (zero capacity overhead;
+// exact power-of-two requests go unguarded), 1 = always guard (requests
+// within kGuardMin of a power of two bump one block level — full coverage
+// at up to 2x block cost for those sizes). The capacity trade-off is the
+// caller's call, so it's a create-time knob.
+void* pt_buddy_create(uint64_t total_bytes, uint64_t min_block,
+                      int guard_mode) {
   if (total_bytes == 0) return nullptr;
   auto* b = new Buddy();
+  b->guard_always = guard_mode != 0;
   b->total = next_pow2(total_bytes);
   b->min_block = next_pow2(min_block ? min_block : 256);
   if (b->min_block > b->total) b->min_block = b->total;
@@ -93,12 +101,12 @@ void* pt_buddy_create(uint64_t total_bytes, uint64_t min_block) {
 void* pt_buddy_alloc(void* bp, uint64_t size) {
   auto* b = static_cast<Buddy*>(bp);
   if (size == 0 || size > b->total) return nullptr;
-  // Reserve guard space beyond the request so even exact power-of-two
-  // sizes (the common staging-buffer case) carry a stamped guard region:
-  // bump one block level when the natural slack is under kGuardMin. A
-  // whole-arena request keeps working (and stays guardless, as before).
   uint64_t want = next_pow2(size < b->min_block ? b->min_block : size);
-  if (want - size < kGuardMin && want < b->total) want <<= 1;
+  // guard_always: reserve guard space even for exact power-of-two sizes
+  // by bumping one block level (whole-arena requests stay guardless —
+  // there's nowhere to put the guard)
+  if (b->guard_always && want - size < kGuardMin && want < b->total)
+    want <<= 1;
   int level = 0;
   while (b->block_size(level) > want && level < b->levels) level++;
   if (b->block_size(level) < want) level--;

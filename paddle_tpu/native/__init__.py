@@ -69,7 +69,7 @@ def _declare(lib):
     lib.rio_multi_reader_close.argtypes = [c.c_void_p]
 
     lib.pt_buddy_create.restype = c.c_void_p
-    lib.pt_buddy_create.argtypes = [c.c_uint64, c.c_uint64]
+    lib.pt_buddy_create.argtypes = [c.c_uint64, c.c_uint64, c.c_int]
     lib.pt_buddy_alloc.restype = c.c_void_p
     lib.pt_buddy_alloc.argtypes = [c.c_void_p, c.c_uint64]
     lib.pt_buddy_free.restype = c.c_int

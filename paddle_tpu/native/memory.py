@@ -15,11 +15,19 @@ from . import load_native
 
 
 class BuddyAllocator:
-    def __init__(self, total_bytes: int, min_block: int = 256):
+    def __init__(self, total_bytes: int, min_block: int = 256,
+                 guard: str = "slack"):
+        """guard='slack' stamps canaries only in a block's natural slack
+        (zero capacity overhead; exact power-of-two requests go
+        unguarded); guard='always' bumps near-power-of-two requests one
+        block level so every allocation carries a guard region."""
+        if guard not in ("slack", "always"):
+            raise ValueError("guard must be 'slack' or 'always'")
         self._lib = load_native()
         self._handles: Dict[int, int] = {}
         if self._lib is not None:
-            self._h = self._lib.pt_buddy_create(total_bytes, min_block)
+            self._h = self._lib.pt_buddy_create(
+                total_bytes, min_block, 1 if guard == "always" else 0)
             if not self._h:
                 raise MemoryError("buddy arena allocation failed")
         else:

@@ -50,7 +50,9 @@ def _save_model(tmp):
 
 
 def _cc():
-    """The C compiler to drive (the capi consumers are plain C)."""
+    """The C compiler for the consumers (g++ is guaranteed by the skipif —
+    building libpaddle_tpu_capi.so needs it anyway — so this always
+    resolves; cc/gcc are only preferred when present)."""
     return shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
 
 
@@ -90,8 +92,8 @@ def _fetch_values(stdout):
     return np.array([float(v) for v in line.split()[1:]])
 
 
-@pytest.mark.skipif(shutil.which("g++") is None or _cc() is None,
-                    reason="no C/C++ toolchain")
+@pytest.mark.skipif(shutil.which("g++") is None,
+                    reason="no C++ toolchain")
 def test_c_consumer_matches_python(tmp_path):
     model_dir, expect = _save_model(str(tmp_path))
     out = _compile_and_run_consumer(tmp_path, "test_capi_consumer.c",
@@ -101,8 +103,8 @@ def test_c_consumer_matches_python(tmp_path):
                                rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.skipif(shutil.which("g++") is None or _cc() is None,
-                    reason="no C/C++ toolchain")
+@pytest.mark.skipif(shutil.which("g++") is None,
+                    reason="no C++ toolchain")
 def test_c_consumer_multithreaded(tmp_path):
     """reference inference/tests/book test_multi_thread_helper.h: N threads
     each with its own predictor over one saved model; outputs must agree

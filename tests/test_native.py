@@ -338,14 +338,23 @@ def test_buddy_guard_bytes_detect_overwrite():
 
 
 def test_buddy_guard_covers_power_of_two_sizes():
-    """Exact power-of-two requests bump one block level so a guard region
-    always exists (except a whole-arena alloc, which has nowhere to put
-    one)."""
+    """With guard='always', exact power-of-two requests bump one block
+    level so a guard region always exists (except a whole-arena alloc,
+    which has nowhere to put one); the default 'slack' mode keeps pow2
+    capacity untouched instead."""
     import ctypes
 
     if not native.available():
         pytest.skip("needs the native library")
-    a = BuddyAllocator(1 << 16, min_block=256)
+    # default mode: two half-arena staging buffers still fit (no bump)
+    d = BuddyAllocator(1 << 16, min_block=256)
+    try:
+        b1, b2 = d.alloc(1 << 15), d.alloc(1 << 15)
+        assert b1 is not None and b2 is not None
+    finally:
+        d.close()
+
+    a = BuddyAllocator(1 << 16, min_block=256, guard="always")
     try:
         buf = a.alloc(1024)  # pow2: guard lives in the bumped block's slack
         addr, _ = a._handles[id(buf)]

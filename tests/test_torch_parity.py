@@ -187,3 +187,85 @@ def test_convbn_loss_curve_matches_torch():
             opt.step()
             theirs.append(float(loss.detach()))
         np.testing.assert_allclose(ours, theirs, rtol=3e-3, atol=3e-3)
+
+
+def test_lstm_loss_curve_matches_torch():
+    """RNN-family cross-check: embedding -> fc(4H) -> dynamic_lstm -> last
+    step -> fc classifier, vs torch nn.LSTM with the weights mapped in.
+    Gate order matches by construction (ours i,f,c,o; torch i,f,g,o with
+    g = candidate); the fc x-projection plays torch's W_ih role."""
+    V, E, H, T, CLS = 50, 16, 16, 12, 5
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            words = layers.data(name="words", shape=[1], dtype="int64",
+                                lod_level=1)
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            emb = layers.embedding(input=words, size=[V, E])
+            proj = layers.fc(input=emb, size=4 * H, num_flatten_dims=2)
+            hidden, _ = layers.dynamic_lstm(input=proj, size=4 * H,
+                                            use_peepholes=False)
+            last = layers.sequence_last_step(hidden)
+            logits = layers.fc(input=last, size=CLS)
+            cost = layers.mean(layers.softmax_with_cross_entropy(
+                logits=logits, label=label))
+            fluid.optimizer.Momentum(learning_rate=LR,
+                                     momentum=MU).minimize(cost)
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        params = {p.name: np.asarray(scope.find_var(p.name))
+                  for p in main.global_block().all_parameters()}
+        emb_w = [n for n in params if "embedding" in n or "lookup" in n][0]
+        fc_names = sorted(n for n in params if n.startswith("fc"))
+        proj_w = [n for n in fc_names if params[n].shape == (E, 4 * H)][0]
+        proj_b = [n for n in fc_names if params[n].shape == (4 * H,)][0]
+        out_w = [n for n in fc_names if params[n].shape == (H, CLS)][0]
+        out_b = [n for n in fc_names if params[n].shape == (CLS,)][0]
+        lstm_w = [n for n in params if n.startswith("lstm")
+                  and params[n].shape == (H, 4 * H)][0]
+        lstm_b = [n for n in params if n.startswith("lstm")
+                  and params[n].shape == (4 * H,)][0]
+
+        class Net(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.emb = torch.nn.Embedding(V, E)
+                self.lstm = torch.nn.LSTM(E, H, batch_first=True)
+                self.out = torch.nn.Linear(H, CLS)
+
+            def forward(self, ids):
+                h, _ = self.lstm(self.emb(ids))
+                return self.out(h[:, -1])
+
+        net = Net()
+        with torch.no_grad():
+            net.emb.weight.copy_(torch.from_numpy(params[emb_w]))
+            # torch gates = W_ih x + b_ih + W_hh h + b_hh; our x-projection
+            # fc supplies W_ih/b_ih and the lstm op supplies W_hh/b_hh
+            net.lstm.weight_ih_l0.copy_(torch.from_numpy(params[proj_w].T))
+            net.lstm.bias_ih_l0.copy_(torch.from_numpy(params[proj_b]))
+            net.lstm.weight_hh_l0.copy_(torch.from_numpy(params[lstm_w].T))
+            net.lstm.bias_hh_l0.copy_(torch.from_numpy(params[lstm_b]))
+            net.out.weight.copy_(torch.from_numpy(params[out_w].T))
+            net.out.bias.copy_(torch.from_numpy(params[out_b]))
+
+        opt = torch.optim.SGD(net.parameters(), lr=LR, momentum=MU)
+        ce = torch.nn.CrossEntropyLoss()
+        rng = np.random.RandomState(3)
+        ours, theirs = [], []
+        for step in range(STEPS):
+            ids = rng.randint(0, V, size=(BATCH, T)).astype(np.int64)
+            lens = np.full((BATCH,), T, dtype=np.int64)
+            y = rng.randint(0, CLS, size=(BATCH, 1)).astype(np.int64)
+            (l,) = exe.run(main, feed={"words": ids, "words@LEN": lens,
+                                       "label": y}, fetch_list=[cost])
+            ours.append(float(np.asarray(l).ravel()[0]))
+            opt.zero_grad()
+            loss = ce(net(torch.from_numpy(ids)),
+                      torch.from_numpy(y.ravel()))
+            loss.backward()
+            opt.step()
+            theirs.append(float(loss.detach()))
+        np.testing.assert_allclose(ours, theirs, rtol=3e-3, atol=3e-3)
