@@ -243,15 +243,22 @@ def child_main():
                       f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
         jax.block_until_ready(scope.find_var(a_param))
 
+        losses = []
         t0 = time.perf_counter()
         for _ in range(ITERS):
             out = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
                           return_numpy=False)
+            losses.append(out[0])
         # force the full dependency chain incl. the last step's param update
         jax.block_until_ready(scope.find_var(a_param))
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0
 
+        # integrity evidence that real steps executed: every fetched loss is
+        # a distinct, finite value from a param-chained step (a stalled or
+        # elided execution would repeat or NaN), reported alongside the rate
+        loss_vals = [float(np.asarray(l).ravel()[0]) for l in losses]
+        distinct = len({round(v, 6) for v in loss_vals})
         imgs_per_sec = BATCH * ITERS / dt
         print(json.dumps({
             "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
@@ -259,6 +266,12 @@ def child_main():
             "unit": "images/sec",
             "vs_baseline": round(imgs_per_sec / V100_BASELINE_IMGS_PER_SEC, 3),
             "backend": backend,
+            "step_ms": round(dt / ITERS * 1000, 3),
+            "batch": BATCH,
+            "loss_first": round(loss_vals[0], 4),
+            "loss_last": round(loss_vals[-1], 4),
+            "distinct_losses": distinct,
+            "finite": bool(np.isfinite(loss_vals).all()),
         }))
 
 

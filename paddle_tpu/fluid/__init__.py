@@ -2,9 +2,11 @@
 from . import core  # noqa: F401
 from . import ops as _ops  # registers all op emitters  # noqa: F401
 from . import (  # noqa: F401
+    average,
     backward,
     clip,
     concurrency,
+    default_scope_funcs,
     enforce,
     evaluator,
     initializer,

@@ -1,0 +1,37 @@
+"""Weighted running average (reference python/paddle/fluid/average.py
+WeightedAverage — the event-loop-side metric accumulator book chapters use
+to average per-batch losses/accuracies weighted by batch size)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+def _flatten_value_weight(value, weight):
+    """Accept scalars or arrays: an array value contributes its mean with
+    the given weight (matching the reference's usage where `value` is a
+    fetched loss/metric tensor and `weight` the batch size)."""
+    v = np.asarray(value, dtype=np.float64)
+    w = float(weight if weight is not None else 1.0)
+    return float(v.mean()), w
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = 0.0
+        self.denominator = 0.0
+
+    def add(self, value, weight=None):
+        v, w = _flatten_value_weight(value, weight)
+        self.numerator += v * w
+        self.denominator += w
+
+    def eval(self):
+        if self.denominator == 0.0:
+            raise ValueError(
+                "There is no data to be averaged in WeightedAverage.")
+        return self.numerator / self.denominator

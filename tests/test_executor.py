@@ -1,5 +1,6 @@
 """Executor lowering + scope state (reference test_executor_and_mul.py)."""
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import layers
@@ -146,3 +147,57 @@ def test_lowered_shares_cache_with_run():
         exe.run(main, feed=feed, fetch_list=[loss])
         jfn2, _ = exe.lowered(main, feed, [loss], scope)
         assert jfn is jfn2
+
+
+def test_weighted_average():
+    """reference fluid/average.py WeightedAverage."""
+    from paddle_tpu.fluid.average import WeightedAverage
+
+    wa = WeightedAverage()
+    with pytest.raises(ValueError):
+        wa.eval()
+    wa.add(2.0, weight=1)
+    wa.add(np.array([4.0, 6.0]), weight=3)  # array -> its mean, weight 3
+    assert wa.eval() == pytest.approx((2.0 + 5.0 * 3) / 4)
+    wa.reset()
+    wa.add(7.0)
+    assert wa.eval() == 7.0
+
+
+def test_default_scope_funcs():
+    """reference fluid/default_scope_funcs.py: thread-local scope stack."""
+    from paddle_tpu.fluid import default_scope_funcs as dsf
+    from paddle_tpu.fluid.executor import _scope_tls
+
+    root = dsf.get_cur_scope()
+    depth = len(getattr(_scope_tls, "stack", []) or [])
+    try:
+        dsf.var("a")
+        assert dsf.find_var("a") is None  # created, unset
+        root.set_var("a", 5)
+        assert dsf.find_var("a") == 5
+
+        child = dsf.enter_local_scope()
+        assert dsf.get_cur_scope() is child
+        assert dsf.find_var("a") == 5       # parent chain visible
+        # local-only create: a child var SHADOWS the parent's
+        child.set_var("b", 9)
+        dsf.var("a")
+        assert dsf.find_var("a") is None
+        dsf.leave_local_scope()
+        assert dsf.get_cur_scope() is root
+        assert dsf.find_var("b") is None    # local scope gone
+        assert dsf.find_var("a") == 5       # shadow gone with it
+
+        out = dsf.scoped_function(lambda: dsf.find_var("a"))
+        assert out == 5
+        with pytest.raises(RuntimeError):
+            dsf.leave_local_scope()
+        # a scope_guard frame is never ours to pop
+        with fluid.scope_guard(fluid.Scope()):
+            with pytest.raises(RuntimeError):
+                dsf.leave_local_scope()
+    finally:
+        root.drop_var("a")
+        stack = getattr(_scope_tls, "stack", []) or []
+        del stack[depth:]  # unwind anything a failed assert left behind
