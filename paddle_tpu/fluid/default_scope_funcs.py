@@ -43,14 +43,24 @@ def find_var(name: str):
 _local_tls = threading.local()
 
 
-def enter_local_scope() -> Scope:
-    """Push a child of the current scope onto this thread's stack."""
+def _stacks():
     stack = getattr(_scope_tls, "stack", None)
     if stack is None:
         stack = _scope_tls.stack = []
     mine = getattr(_local_tls, "stack", None)
     if mine is None:
         mine = _local_tls.stack = []
+    # drop records of local scopes a scope_guard already unwound (it pops
+    # by identity and discards orphaned frames above its own) so one
+    # unmatched enter can't wedge every later leave on this thread
+    live = {id(s) for s in stack}
+    mine[:] = [s for s in mine if id(s) in live]
+    return stack, mine
+
+
+def enter_local_scope() -> Scope:
+    """Push a child of the current scope onto this thread's stack."""
+    stack, mine = _stacks()
     child = get_cur_scope().new_scope()
     stack.append(child)
     mine.append(child)
@@ -58,8 +68,7 @@ def enter_local_scope() -> Scope:
 
 
 def leave_local_scope() -> None:
-    stack = getattr(_scope_tls, "stack", None)
-    mine = getattr(_local_tls, "stack", None)
+    stack, mine = _stacks()
     if not mine or not stack or stack[-1] is not mine[-1]:
         raise RuntimeError(
             "leave_local_scope without a matching enter_local_scope on "

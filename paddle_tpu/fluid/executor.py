@@ -94,7 +94,14 @@ def scope_guard(scope: Scope):
     try:
         yield
     finally:
-        stack.pop()
+        # pop OUR frame by identity, unwinding any frames the body left
+        # above it (e.g. an unmatched enter_local_scope) — a blind pop()
+        # would remove the orphan and silently leak `scope` as the
+        # thread's current scope forever
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is scope:
+                del stack[i:]
+                break
 
 
 def fetch_var(name: str, scope: Optional[Scope] = None, return_numpy: bool = True):

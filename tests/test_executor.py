@@ -201,3 +201,20 @@ def test_default_scope_funcs():
         root.drop_var("a")
         stack = getattr(_scope_tls, "stack", []) or []
         del stack[depth:]  # unwind anything a failed assert left behind
+
+
+def test_scope_guard_unwinds_orphaned_local_scopes():
+    """A scope_guard exiting with an unmatched enter_local_scope must pop
+    its OWN frame (by identity) and discard the orphan — not leak its
+    scope as the thread's current scope; later enter/leave pairs work."""
+    from paddle_tpu.fluid import default_scope_funcs as dsf
+
+    root = dsf.get_cur_scope()
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        dsf.enter_local_scope()  # deliberately unmatched
+    assert dsf.get_cur_scope() is root
+    # no cascade: a fresh matched pair still works
+    dsf.enter_local_scope()
+    dsf.leave_local_scope()
+    assert dsf.get_cur_scope() is root

@@ -78,3 +78,48 @@ def test_release_memory_preserves_results():
                for op in main2.global_block().ops)
     out = _train_losses(main2, startup2, cost2)
     np.testing.assert_allclose(ref, out, rtol=1e-6)
+
+
+def test_book_lenet_under_memory_optimize():
+    """reference tests/book_memory_optimization/: a full book chapter
+    (recognize_digits LeNet + Adam) re-run under memory_optimize must
+    train identically to the plain program."""
+    from paddle_tpu.fluid import unique_name
+    from paddle_tpu.models import lenet
+
+    def build(seed):
+        main, startup = Program(), Program()
+        main.random_seed = startup.random_seed = seed
+        with unique_name.guard(), program_guard(main, startup):
+            img = layers.data(name="img", shape=[1, 28, 28],
+                              dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            avg_cost, acc, _ = lenet.build(img, label)
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+        return main, startup, avg_cost
+
+    def run(main, startup, cost, steps=4):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            losses = []
+            for _ in range(steps):
+                x = rng.rand(8, 1, 28, 28).astype(np.float32)
+                y = rng.randint(0, 10, size=(8, 1)).astype(np.int64)
+                losses.append(exe.run(main, feed={"img": x, "label": y},
+                                      fetch_list=[cost])[0].item())
+            return losses
+
+    plain_main, plain_start, plain_cost = build(seed=5)
+    plain = run(plain_main, plain_start, plain_cost)
+
+    opt_main, opt_start, opt_cost = build(seed=5)
+    before = estimate_peak_bytes(opt_main)
+    memory_optimize(opt_main, skip_opt_set={opt_cost.name})
+    after = estimate_peak_bytes(opt_main)
+    optimized = run(opt_main, opt_start, opt_cost)
+
+    np.testing.assert_allclose(optimized, plain, rtol=1e-5, atol=1e-6)
+    assert after <= before
