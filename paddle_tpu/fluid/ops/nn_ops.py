@@ -78,9 +78,14 @@ def conv2d_transpose(ctx, ins, attrs):
     strides = _pair(attrs.get("strides", [1, 1]))
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
-    # filter layout [in_c, out_c, kh, kw] (reference conv_transpose convention)
+    # filter layout [in_c, out_c, kh, kw] (reference conv_transpose
+    # convention). Transposed conv = dilate the input by `strides`, pad by
+    # (k-1)-p, and CORRELATE with the spatially-flipped kernel (the adjoint
+    # of correlation flips); IOHW already contracts dim0 against x's
+    # channels, so no I/O swap is needed.
+    w_flipped = jnp.flip(w, axis=(2, 3))
     out = jax.lax.conv_general_dilated(
-        x, w,
+        x, w_flipped,
         window_strides=[1, 1],
         padding=[
             (dilations[0] * (w.shape[2] - 1) - paddings[0],
@@ -91,7 +96,6 @@ def conv2d_transpose(ctx, ins, attrs):
         lhs_dilation=strides,
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True,
     )
     if restore is not None:
         out = out.astype(restore)

@@ -260,3 +260,36 @@ class TestDropout(OpTest):
         kept = (o != 0).mean()
         assert abs(kept - 0.6) < 0.05
         assert set(np.unique(o)) <= {0.0, 1.0}
+
+
+def test_conv2d_transpose_matches_torch():
+    """conv2d_transpose (adjoint-of-correlation: input dilation + flipped
+    kernel) against torch's ConvTranspose2d across stride/pad/kernel
+    configs — a layer-sweep regression caught this op lowering with an
+    invalid lax argument, unexercised by any test."""
+    torch = pytest.importorskip("torch")
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.framework import Program, program_guard
+
+    for stride, pad, k, dil in [(2, 0, 2, 1), (2, 1, 3, 1), (1, 1, 3, 1),
+                                (2, 1, 3, 2)]:
+        main, startup, scope = Program(), Program(), fluid.Scope()
+        with fluid.scope_guard(scope):
+            with program_guard(main, startup):
+                x = layers.data(name="x", shape=[3, 10, 10],
+                                dtype="float32")
+                y = layers.conv2d_transpose(
+                    input=x, num_filters=5, filter_size=k, stride=stride,
+                    padding=pad, dilation=dil, bias_attr=False)
+            exe = fluid.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            xv = rng.rand(2, 3, 10, 10).astype(np.float32)
+            wname = main.global_block().all_parameters()[0].name
+            w = np.asarray(scope.find_var(wname)).copy()
+            (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(xv), torch.from_numpy(w), stride=stride,
+            padding=pad, dilation=dil)
+        np.testing.assert_allclose(out, ref.numpy(), rtol=1e-4, atol=1e-5)
