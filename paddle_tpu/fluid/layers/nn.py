@@ -162,9 +162,11 @@ def _append_channel_bias(helper, pre_bias):
 
 
 def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
-                     padding=0, stride=1, dilation=1, param_attr=None,
-                     bias_attr=None, use_cudnn=True, act=None, name=None):
-    """reference layers/nn.py:1710."""
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """reference layers/nn.py:1710. Filter layout [in_c, out_c/groups,
+    kh, kw] (the conv_transpose convention — conv2d's is flipped)."""
     helper = LayerHelper(
         "conv2d_transpose", param_attr=param_attr, bias_attr=bias_attr,
         act=act, name=name,
@@ -190,14 +192,18 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
         ]
     else:
         filter_size = _pair(filter_size)
-    filter_shape = [input.shape[1], num_filters] + filter_size
+    groups = groups or 1
+    if num_filters % groups != 0:
+        raise ValueError("num_filters must be divisible by groups")
+    filter_shape = [input.shape[1], num_filters // groups] + filter_size
     w = helper.create_parameter(helper.param_attr, filter_shape, dtype)
     pre_bias = helper.create_variable_for_type_inference(dtype)
     helper.append_op(
         type="conv2d_transpose",
         inputs={"Input": [input], "Filter": [w]},
         outputs={"Output": [pre_bias]},
-        attrs={"strides": stride, "paddings": padding, "dilations": dilation},
+        attrs={"strides": stride, "paddings": padding,
+               "dilations": dilation, "groups": groups},
     )
     pre_act = _append_channel_bias(helper, pre_bias)
     return helper.append_activation(pre_act)

@@ -78,11 +78,19 @@ def conv2d_transpose(ctx, ins, attrs):
     strides = _pair(attrs.get("strides", [1, 1]))
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
-    # filter layout [in_c, out_c, kh, kw] (reference conv_transpose
+    # filter layout [in_c, out_c/groups, kh, kw] (reference conv_transpose
     # convention). Transposed conv = dilate the input by `strides`, pad by
     # (k-1)-p, and CORRELATE with the spatially-flipped kernel (the adjoint
     # of correlation flips); IOHW already contracts dim0 against x's
     # channels, so no I/O swap is needed.
+    groups = int(attrs.get("groups", 1) or 1)
+    if groups > 1:
+        # XLA grouped-conv rhs layout: I = in_c/groups, O = groups blocks of
+        # out_c/groups where block i convolves lhs channel-block i — stack
+        # the reference's leading-dim groups along O
+        in_c = w.shape[0]
+        wg = w.reshape(groups, in_c // groups, *w.shape[1:])
+        w = jnp.concatenate([wg[i] for i in range(groups)], axis=1)
     w_flipped = jnp.flip(w, axis=(2, 3))
     out = jax.lax.conv_general_dilated(
         x, w_flipped,
@@ -95,6 +103,7 @@ def conv2d_transpose(ctx, ins, attrs):
         ],
         lhs_dilation=strides,
         rhs_dilation=dilations,
+        feature_group_count=groups,
         dimension_numbers=("NCHW", "IOHW", "NCHW"),
     )
     if restore is not None:

