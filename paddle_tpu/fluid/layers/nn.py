@@ -195,6 +195,12 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     groups = groups or 1
     if num_filters % groups != 0:
         raise ValueError("num_filters must be divisible by groups")
+    if input.shape[1] % groups != 0:
+        # the op-level grouped reshape needs in_c divisible too; fail at
+        # build time with a clear message, not a deep reshape error
+        raise ValueError(
+            f"input channels ({input.shape[1]}) must be divisible by "
+            f"groups ({groups})")
     filter_shape = [input.shape[1], num_filters // groups] + filter_size
     w = helper.create_parameter(helper.param_attr, filter_shape, dtype)
     pre_bias = helper.create_variable_for_type_inference(dtype)

@@ -111,15 +111,37 @@ def conv2d_transpose(ctx, ins, attrs):
     return {"Output": out}
 
 
+def _ceil_extra(dim, k, s, p):
+    """Extra hi-side padding so the window count matches ceil mode
+    (reference pool_op.cc PoolOutputSize with ceil_mode: one more output
+    when stride doesn't divide; the extra region is implicit padding).
+    Clamp: the last window must START inside input+left-padding — a window
+    living entirely in padding is dropped (torch clamps identically),
+    otherwise max pools emit -inf and exclusive avgs divide 0/0."""
+    span = dim + 2 * p - k
+    ceil_out = -(-span // s) + 1
+    if (ceil_out - 1) * s >= dim + p:
+        ceil_out -= 1
+    return max((ceil_out - 1) * s + k - (dim + 2 * p), 0)
+
+
 def _pool2d(x, pooling_type, ksize, strides, paddings, global_pooling, exclusive,
-            adaptive=False):
+            ceil_mode=False, adaptive=False):
     if global_pooling:
         ksize = [x.shape[2], x.shape[3]]
         paddings = [0, 0]
         strides = [1, 1]
     window = (1, 1, ksize[0], ksize[1])
     wstrides = (1, 1, strides[0], strides[1])
-    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]), (paddings[1], paddings[1]))
+    extra = [
+        _ceil_extra(x.shape[2], ksize[0], strides[0], paddings[0])
+        if ceil_mode else 0,
+        _ceil_extra(x.shape[3], ksize[1], strides[1], paddings[1])
+        if ceil_mode else 0,
+    ]
+    pads = ((0, 0), (0, 0),
+            (paddings[0], paddings[0] + extra[0]),
+            (paddings[1], paddings[1] + extra[1]))
     if pooling_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return jax.lax.reduce_window(x, init, jax.lax.max, window, wstrides, pads)
@@ -142,6 +164,7 @@ def pool2d(ctx, ins, attrs):
         _pair(attrs.get("paddings", [0, 0])),
         bool(attrs.get("global_pooling", False)),
         bool(attrs.get("exclusive", True)),
+        ceil_mode=bool(attrs.get("ceil_mode", False)),
     )
     return {"Out": out}
 

@@ -36,14 +36,22 @@ def norm(ctx, ins, attrs):
 
 
 def _pool_nd(x, pooling_type, ksize, strides, paddings, global_pooling,
-             exclusive, spatial):
+             exclusive, spatial, ceil_mode=False):
+    from .nn_ops import _ceil_extra
+
     if global_pooling:
         ksize = list(x.shape[2:2 + spatial])
         paddings = [0] * spatial
         strides = [1] * spatial
     window = (1, 1) + tuple(ksize)
     wstrides = (1, 1) + tuple(strides)
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    extra = [
+        _ceil_extra(x.shape[2 + i], ksize[i], strides[i], paddings[i])
+        if ceil_mode else 0
+        for i in range(spatial)
+    ]
+    pads = ((0, 0), (0, 0)) + tuple(
+        (p, p + e) for p, e in zip(paddings, extra))
     if pooling_type == "max":
         init = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
                 else jnp.iinfo(x.dtype).min)
@@ -75,7 +83,8 @@ def pool3d(ctx, ins, attrs):
         _tuple_n(attrs.get("strides", [1, 1, 1]), 3),
         _tuple_n(attrs.get("paddings", [0, 0, 0]), 3),
         bool(attrs.get("global_pooling", False)),
-        bool(attrs.get("exclusive", True)), 3)
+        bool(attrs.get("exclusive", True)), 3,
+        ceil_mode=bool(attrs.get("ceil_mode", False)))
     return {"Out": out}
 
 

@@ -272,22 +272,25 @@ def test_conv2d_transpose_matches_torch():
     from paddle_tpu.fluid import layers
     from paddle_tpu.fluid.framework import Program, program_guard
 
+    # the groups=3 case has multi-channel groups (in_c/g=1 would make any
+    # block-order bug degenerate to the identity permutation)
     for stride, pad, k, dil, g in [(2, 0, 2, 1, 1), (2, 1, 3, 1, 1),
                                    (1, 1, 3, 1, 1), (2, 1, 3, 2, 1),
                                    (2, 1, 3, 1, 3)]:
         main, startup, scope = Program(), Program(), fluid.Scope()
         with fluid.scope_guard(scope):
             with program_guard(main, startup):
-                x = layers.data(name="x", shape=[3, 10, 10],
+                in_c = 6 if g > 1 else 3
+                x = layers.data(name="x", shape=[in_c, 10, 10],
                                 dtype="float32")
                 y = layers.conv2d_transpose(
-                    input=x, num_filters=3 if g > 1 else 5, filter_size=k,
+                    input=x, num_filters=6 if g > 1 else 5, filter_size=k,
                     stride=stride, padding=pad, dilation=dil, groups=g,
                     bias_attr=False)
             exe = fluid.Executor()
             exe.run(startup)
             rng = np.random.RandomState(0)
-            xv = rng.rand(2, 3, 10, 10).astype(np.float32)
+            xv = rng.rand(2, in_c, 10, 10).astype(np.float32)
             wname = main.global_block().all_parameters()[0].name
             w = np.asarray(scope.find_var(wname)).copy()
             (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
@@ -295,3 +298,48 @@ def test_conv2d_transpose_matches_torch():
             torch.from_numpy(xv), torch.from_numpy(w), stride=stride,
             padding=pad, dilation=dil, groups=g)
         np.testing.assert_allclose(out, ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_pool2d_semantics_match_torch():
+    """ceil_mode (was silently ignored — floor shapes always) and the avg
+    divisor conventions: exclusive=True (reference default; pads don't
+    count) == torch count_include_pad=False, exclusive=False == True."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.framework import Program, program_guard
+
+    x = np.random.RandomState(0).rand(2, 3, 7, 7).astype(np.float32)
+
+    def run(**pool_kwargs):
+        main, startup, scope = Program(), Program(), fluid.Scope()
+        with fluid.scope_guard(scope):
+            with program_guard(main, startup):
+                xv = layers.data(name="x", shape=[3, 7, 7],
+                                 dtype="float32")
+                y = layers.pool2d(input=xv, **pool_kwargs)
+            exe = fluid.Executor()
+            (out,) = exe.run(main, feed={"x": x}, fetch_list=[y])
+        return out
+
+    out = run(pool_size=2, pool_stride=2, pool_type="max", ceil_mode=True)
+    ref = F.max_pool2d(torch.from_numpy(x), 2, stride=2, ceil_mode=True)
+    assert out.shape == tuple(ref.shape)  # floor mode would give 3x3
+    np.testing.assert_allclose(out, ref.numpy(), rtol=1e-5, atol=1e-6)
+
+    # last-window-in-padding clamp: k=2 s=3 p=1 on 7px -> torch drops the
+    # window living entirely in padding; unclamped ceil emits -inf there
+    out = run(pool_size=2, pool_stride=3, pool_padding=1, pool_type="max",
+              ceil_mode=True)
+    ref = F.max_pool2d(torch.from_numpy(x), 2, stride=3, padding=1,
+                       ceil_mode=True)
+    assert out.shape == tuple(ref.shape)
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, ref.numpy(), rtol=1e-5, atol=1e-6)
+
+    out = run(pool_size=3, pool_stride=2, pool_padding=1, pool_type="avg")
+    ref = F.avg_pool2d(torch.from_numpy(x), 3, stride=2, padding=1,
+                       count_include_pad=False)
+    np.testing.assert_allclose(out, ref.numpy(), rtol=1e-4, atol=1e-5)
