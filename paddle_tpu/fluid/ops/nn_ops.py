@@ -125,38 +125,13 @@ def _ceil_extra(dim, k, s, p):
     return max((ceil_out - 1) * s + k - (dim + 2 * p), 0)
 
 
-def _pool2d(x, pooling_type, ksize, strides, paddings, global_pooling, exclusive,
-            ceil_mode=False, adaptive=False):
-    if global_pooling:
-        ksize = [x.shape[2], x.shape[3]]
-        paddings = [0, 0]
-        strides = [1, 1]
-    window = (1, 1, ksize[0], ksize[1])
-    wstrides = (1, 1, strides[0], strides[1])
-    extra = [
-        _ceil_extra(x.shape[2], ksize[0], strides[0], paddings[0])
-        if ceil_mode else 0,
-        _ceil_extra(x.shape[3], ksize[1], strides[1], paddings[1])
-        if ceil_mode else 0,
-    ]
-    pads = ((0, 0), (0, 0),
-            (paddings[0], paddings[0] + extra[0]),
-            (paddings[1], paddings[1] + extra[1]))
-    if pooling_type == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return jax.lax.reduce_window(x, init, jax.lax.max, window, wstrides, pads)
-    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, wstrides, pads)
-    if exclusive:
-        ones = jnp.ones((1, 1, x.shape[2], x.shape[3]), dtype=x.dtype)
-        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, wstrides, pads)
-        return s / cnt
-    return s / float(ksize[0] * ksize[1])
-
-
 @register_op("pool2d", ref="paddle/fluid/operators/pool_op.cc")
 def pool2d(ctx, ins, attrs):
+    # one pooling implementation for 2d/3d: vision_ops._pool_nd
+    from .vision_ops import _pool_nd
+
     x = one(ins, "X")
-    out = _pool2d(
+    out = _pool_nd(
         x,
         str(attrs.get("pooling_type", "max")),
         _pair(attrs.get("ksize", [2, 2])),
@@ -164,6 +139,7 @@ def pool2d(ctx, ins, attrs):
         _pair(attrs.get("paddings", [0, 0])),
         bool(attrs.get("global_pooling", False)),
         bool(attrs.get("exclusive", True)),
+        spatial=2,
         ceil_mode=bool(attrs.get("ceil_mode", False)),
     )
     return {"Out": out}

@@ -269,3 +269,52 @@ def test_lstm_loss_curve_matches_torch():
             opt.step()
             theirs.append(float(loss.detach()))
         np.testing.assert_allclose(ours, theirs, rtol=3e-3, atol=3e-3)
+
+
+def test_warpctc_matches_torch_ctc_loss():
+    """warpctc (dynamic-programming CTC in jnp) against torch's ctc_loss,
+    with per-sample logit/label lengths and reduction='none'."""
+    import torch.nn.functional as F
+
+    import jax.numpy as jnp
+    from paddle_tpu.fluid.registry import EmitCtx, get_op_info, normalize_outs
+
+    rng = np.random.RandomState(0)
+    N, T, C, L = 3, 8, 5, 3
+    logits = rng.randn(N, T, C).astype(np.float32)
+    labels = rng.randint(1, C, (N, L)).astype(np.int64)
+    in_len = np.array([8, 7, 6], np.int32)
+    lab_len = np.array([3, 2, 3], np.int32)
+
+    import jax
+    ctx = EmitCtx(root_key=jax.random.key(0))
+    loss = normalize_outs(get_op_info("warpctc").forward(ctx, {
+        "Logits": [jnp.asarray(logits)], "Label": [jnp.asarray(labels)],
+        "LogitsLength": [jnp.asarray(in_len)],
+        "LabelLength": [jnp.asarray(lab_len)],
+    }, {"blank": 0}))["Loss"][0]
+    ref = F.ctc_loss(
+        torch.from_numpy(logits).permute(1, 0, 2).log_softmax(-1),
+        torch.from_numpy(labels), torch.from_numpy(in_len.astype(np.int64)),
+        torch.from_numpy(lab_len.astype(np.int64)), blank=0,
+        reduction="none")
+    np.testing.assert_allclose(np.asarray(loss).ravel(), ref.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_im2sequence_matches_torch_unfold():
+    import torch.nn.functional as F
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.fluid.registry import EmitCtx, get_op_info, normalize_outs
+
+    x = np.random.RandomState(0).rand(2, 3, 6, 6).astype(np.float32)
+    ctx = EmitCtx(root_key=jax.random.key(0))
+    out = normalize_outs(get_op_info("im2sequence").forward(
+        ctx, {"X": [jnp.asarray(x)]},
+        {"kernels": [2, 2], "strides": [1, 1],
+         "paddings": [0, 0, 0, 0]}))["Out"][0]
+    ref = F.unfold(torch.from_numpy(x), 2).transpose(1, 2).reshape(-1, 12)
+    np.testing.assert_allclose(np.asarray(out), ref.numpy(), rtol=1e-5,
+                               atol=1e-6)
