@@ -316,3 +316,46 @@ def test_max_sequence_len_layer():
             "x": np.zeros((3, 9, 2), np.float32),
             "x@LEN": np.array([3, 7, 2], np.int32)}, fetch_list=[m])
         np.testing.assert_array_equal(np.asarray(mv), [7])
+
+
+def test_gru_op_matches_manual_reference():
+    """Pin the fused GRU to the reference formulas (math/detail/
+    gru_kernel.h): u,r = sigmoid(x_{u,r} + h W_{u,r}); c = tanh(x_c +
+    (r*h) W_c); h' = (1-u)*h + u*c  (gru_finalOutput: prev - u*prev +
+    u*frame_state), with masking past each row's length."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.registry import EmitCtx, get_op_info, normalize_outs
+
+    rng = np.random.RandomState(0)
+    N, T, H = 3, 5, 4
+    x = rng.randn(N, T, 3 * H).astype(np.float32)
+    w = rng.randn(H, 3 * H).astype(np.float32) * 0.3
+    lengths = np.array([5, 3, 4], np.int32)
+
+    ctx = EmitCtx(root_key=jax.random.key(0))
+    outs = normalize_outs(get_op_info("gru").forward(ctx, {
+        "Input": [jnp.asarray(x)], "Weight": [jnp.asarray(w)],
+        "Bias": [None], "Lengths": [jnp.asarray(lengths)], "H0": [None],
+    }, {}))
+    hidden = np.asarray(outs["Hidden"][0])
+
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h = np.zeros((N, H), np.float32)
+    expect = np.zeros((N, T, H), np.float32)
+    for t in range(T):
+        xu, xr, xc = np.split(x[:, t], 3, axis=1)
+        ur = h @ w[:, :2 * H]
+        u = sigmoid(xu + ur[:, :H])
+        r = sigmoid(xr + ur[:, H:])
+        c = np.tanh(xc + (r * h) @ w[:, 2 * H:])
+        h_new = (1 - u) * h + u * c
+        valid = (t < lengths)[:, None]
+        h = np.where(valid, h_new, h)
+        # padded+lengths convention: masked slots are ZERO in the padded
+        # output (consumers rely on zeros for sums), state carries inside
+        expect[:, t] = np.where(valid, h, 0.0)
+    np.testing.assert_allclose(hidden, expect, rtol=1e-5, atol=1e-5)
