@@ -165,8 +165,10 @@ def adamax(ctx, ins, attrs):
     b2 = float(attrs.get("beta2", 0.999))
     eps = float(attrs.get("epsilon", 1e-8))
     mn = b1 * m + (1 - b1) * g
-    infn = jnp.maximum(b2 * inf, jnp.abs(g))
-    pn = p - (lr / (1 - b1p)) * mn / (infn + eps)
+    # reference adamax_op.h: eps joins the DECAYED norm before the max, and
+    # the division uses inf_norm_out directly (no extra +eps)
+    infn = jnp.maximum(jnp.abs(g), b2 * inf + eps)
+    pn = p - (lr / (1 - b1p)) * mn / infn
     return {"ParamOut": pn, "MomentOut": mn, "InfNormOut": infn}
 
 

@@ -126,3 +126,124 @@ class TestAdadelta(OpTest):
         self.outputs = {"ParamOut": p + upd, "AvgSquaredGradOut": asgn,
                         "AvgSquaredUpdateOut": asun}
         self.check_output(rtol=1e-4)
+
+
+class TestAdamax(OpTest):
+    def test_adamax(self):
+        self.op_type = "adamax"
+        p = np.random.rand(4).astype(np.float32)
+        g = np.random.rand(4).astype(np.float32)
+        m = np.random.rand(4).astype(np.float32)
+        inf = (np.random.rand(4) + 0.5).astype(np.float32)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        b1p = np.array([b1 ** 4], dtype=np.float32)
+        lr = np.array([0.002], dtype=np.float32)
+        # reference adamax_op.h: eps joins the decayed norm BEFORE the max;
+        # division uses inf_norm_out directly
+        mn = b1 * m + (1 - b1) * g
+        infn = np.maximum(np.abs(g), b2 * inf + eps)
+        pn = p - (0.002 / (1 - b1p)) * mn / infn
+        self.inputs = {"Param": p, "Grad": g, "Moment": m, "InfNorm": inf,
+                       "Beta1Pow": b1p, "LearningRate": lr}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {"ParamOut": pn.astype(np.float32), "MomentOut": mn,
+                        "InfNormOut": infn}
+        self.check_output(rtol=1e-4)
+
+    def test_adamax_eps_placement_near_zero(self):
+        """First-step regime (inf=0, tiny grads): the denominator is eps
+        itself under the reference placement, vs ~|g| under the old
+        (max-then-add) form — the case that distinguishes the two."""
+        self.op_type = "adamax"
+        p = np.random.rand(4).astype(np.float32)
+        g = np.full(4, 1e-10, dtype=np.float32)
+        m = np.zeros(4, dtype=np.float32)
+        inf = np.zeros(4, dtype=np.float32)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        b1p = np.array([b1], dtype=np.float32)
+        lr = np.array([0.002], dtype=np.float32)
+        mn = (1 - b1) * g
+        infn = np.maximum(np.abs(g), eps)   # = eps, not |g|
+        pn = p - (0.002 / (1 - b1p)) * mn / infn
+        self.inputs = {"Param": p, "Grad": g, "Moment": m, "InfNorm": inf,
+                       "Beta1Pow": b1p, "LearningRate": lr}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {"ParamOut": pn.astype(np.float32), "MomentOut": mn,
+                        "InfNormOut": infn}
+        self.check_output(rtol=1e-5)
+
+
+class TestDecayedAdagrad(OpTest):
+    def test_decayed_adagrad(self):
+        self.op_type = "decayed_adagrad"
+        p = np.random.rand(4).astype(np.float32)
+        g = np.random.rand(4).astype(np.float32)
+        m = np.random.rand(4).astype(np.float32)
+        lr = np.array([0.05], dtype=np.float32)
+        decay, eps = 0.95, 1e-6
+        mn = decay * m + (1 - decay) * g * g
+        self.inputs = {"Param": p, "Grad": g, "Moment": m,
+                       "LearningRate": lr}
+        self.attrs = {"decay": decay, "epsilon": eps}
+        self.outputs = {"ParamOut": p - 0.05 * g / (np.sqrt(mn) + eps),
+                        "MomentOut": mn}
+        self.check_output(rtol=1e-4)
+
+
+class TestFtrl(OpTest):
+    def test_ftrl(self):
+        self.op_type = "ftrl"
+        p = np.random.uniform(-1, 1, 4).astype(np.float32)
+        g = np.random.uniform(-1, 1, 4).astype(np.float32)
+        sq = (np.random.rand(4) + 0.1).astype(np.float32)
+        lin = np.random.uniform(-2, 2, 4).astype(np.float32)
+        lr = np.array([0.1], dtype=np.float32)
+        l1, l2 = 0.5, 0.1
+        # reference ftrl_op.h, lr_power=-0.5 branch
+        new_sq = sq + g * g
+        sigma = (np.sqrt(new_sq) - np.sqrt(sq)) / 0.1
+        new_lin = lin + g - sigma * p
+        x = l1 * np.sign(new_lin) - new_lin
+        y = np.sqrt(new_sq) / 0.1 + 2 * l2
+        pn = np.where(np.abs(new_lin) > l1, x / y, 0.0)
+        self.inputs = {"Param": p, "Grad": g, "SquaredAccumulator": sq,
+                       "LinearAccumulator": lin, "LearningRate": lr}
+        self.attrs = {"l1": l1, "l2": l2, "lr_power": -0.5}
+        self.outputs = {"ParamOut": pn.astype(np.float32),
+                        "SquaredAccumOut": new_sq,
+                        "LinearAccumOut": new_lin}
+        self.check_output(rtol=1e-4)
+
+
+class TestProximal(OpTest):
+    def test_proximal_gd(self):
+        self.op_type = "proximal_gd"
+        p = np.random.uniform(-1, 1, 4).astype(np.float32)
+        g = np.random.uniform(-1, 1, 4).astype(np.float32)
+        lr = np.array([0.1], dtype=np.float32)
+        l1, l2 = 0.2, 0.05
+        prox = p - 0.1 * g
+        pn = (np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0)
+              / (1 + 0.1 * l2))
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.attrs = {"l1": l1, "l2": l2}
+        self.outputs = {"ParamOut": pn.astype(np.float32)}
+        self.check_output(rtol=1e-4)
+
+    def test_proximal_adagrad(self):
+        self.op_type = "proximal_adagrad"
+        p = np.random.uniform(-1, 1, 4).astype(np.float32)
+        g = np.random.uniform(-1, 1, 4).astype(np.float32)
+        m = (np.random.rand(4) + 0.1).astype(np.float32)
+        lr = np.array([0.1], dtype=np.float32)
+        l1, l2 = 0.2, 0.05
+        mn = m + g * g
+        lr_t = 0.1 / np.sqrt(mn)
+        prox = p - lr_t * g
+        pn = (np.sign(prox) * np.maximum(np.abs(prox) - lr_t * l1, 0)
+              / (1 + lr_t * l2))
+        self.inputs = {"Param": p, "Grad": g, "Moment": m,
+                       "LearningRate": lr}
+        self.attrs = {"l1": l1, "l2": l2}
+        self.outputs = {"ParamOut": pn.astype(np.float32), "MomentOut": mn}
+        self.check_output(rtol=1e-4)
