@@ -367,3 +367,36 @@ def test_split_selected_rows_op():
     np.testing.assert_allclose(np.asarray(lo.value), [[1.0], [0.0], [0.0]])
     np.testing.assert_array_equal(np.asarray(hi.rows), [-1, 1, 4])
     np.testing.assert_allclose(np.asarray(hi.value), [[0.0], [2.0], [3.0]])
+
+
+class TestScatterMultiplex(OpTest):
+    def test_scatter_overwrite(self):
+        """reference scatter_op.cc: rows of X at Ids are REPLACED by
+        Updates (overwrite mode)."""
+        self.op_type = "scatter"
+        x = np.random.rand(6, 4).astype(np.float32)
+        ids = np.array([1, 4], np.int64)
+        upd = np.random.rand(2, 4).astype(np.float32)
+        expect = x.copy()
+        expect[ids] = upd
+        self.inputs = {"X": x, "Ids": ids, "Updates": upd}
+        self.attrs = {}
+        self.outputs = {"Out": expect}
+        self.check_output()
+        self.check_grad(["X", "Updates"], "Out")
+
+    def test_multiplex(self):
+        """reference multiplex_op.cc: out[i] = X[Ids[i]][i] — per-row
+        candidate selection."""
+        self.op_type = "multiplex"
+        x1 = np.random.rand(5, 3).astype(np.float32)
+        x2 = np.random.rand(5, 3).astype(np.float32)
+        x3 = np.random.rand(5, 3).astype(np.float32)
+        ids = np.array([[0], [2], [1], [0], [2]], np.int32)
+        cands = [x1, x2, x3]
+        expect = np.stack([cands[ids[i, 0]][i] for i in range(5)])
+        self.inputs = {"X": [("x1", x1), ("x2", x2), ("x3", x3)],
+                       "Ids": ids}
+        self.attrs = {}
+        self.outputs = {"Out": expect}
+        self.check_output()
