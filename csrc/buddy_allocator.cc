@@ -40,6 +40,7 @@ struct Buddy {
   std::vector<std::set<uint64_t>> free_lists;
   std::map<uint64_t, Alloc> allocated;
   uint64_t used = 0;
+  uint64_t quarantined = 0;  // bytes held out after guard corruption
   std::mutex mu;
 
   uint64_t block_size(int level) const { return total >> level; }
@@ -140,6 +141,15 @@ int pt_buddy_free(void* bp, void* p) {
   int rc = b->intact(off, it->second) ? 0 : -2;  // -2 = overwrite detected
   int level = it->second.level;
   b->allocated.erase(it);
+  if (rc == -2) {
+    // Quarantine: a detected overwrite means unknown bytes past the block
+    // may also be damaged. Keep the block out of the free lists entirely
+    // (it stays "used") so it cannot be handed out again before the
+    // caller's error handling runs — the allocator trades capacity for
+    // containment.
+    b->quarantined += b->block_size(level);
+    return rc;
+  }
   b->used -= b->block_size(level);
   // coalesce with buddy while possible
   while (level > 0) {
@@ -164,6 +174,12 @@ uint64_t pt_buddy_check(void* bp) {
   for (const auto& kv : b->allocated)
     if (!b->intact(kv.first, kv.second)) bad++;
   return bad;
+}
+
+uint64_t pt_buddy_quarantined(void* bp) {
+  auto* b = static_cast<Buddy*>(bp);
+  std::lock_guard<std::mutex> lk(b->mu);
+  return b->quarantined;
 }
 
 uint64_t pt_buddy_used(void* bp) {

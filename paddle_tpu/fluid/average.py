@@ -1,6 +1,12 @@
 """Weighted running average (reference python/paddle/fluid/average.py
 WeightedAverage — the event-loop-side metric accumulator book chapters use
-to average per-batch losses/accuracies weighted by batch size)."""
+to average per-batch losses/accuracies weighted by batch size).
+
+Reference semantics kept exactly: the numerator accumulates
+``value * weight`` ELEMENTWISE (an array value stays an array), the weight
+must be a number, and ``eval()`` returns numerator/denominator — so for an
+array-valued metric the result is the weighted elementwise mean, not the
+mean of per-batch scalar means."""
 from __future__ import annotations
 
 import numpy as np
@@ -8,13 +14,10 @@ import numpy as np
 __all__ = ["WeightedAverage"]
 
 
-def _flatten_value_weight(value, weight):
-    """Accept scalars or arrays: an array value contributes its mean with
-    the given weight (matching the reference's usage where `value` is a
-    fetched loss/metric tensor and `weight` the batch size)."""
-    v = np.asarray(value, dtype=np.float64)
-    w = float(weight if weight is not None else 1.0)
-    return float(v.mean()), w
+def _is_number(v) -> bool:
+    return isinstance(v, (int, float, np.integer, np.floating)) or (
+        isinstance(v, np.ndarray) and v.ndim == 0
+    )
 
 
 class WeightedAverage:
@@ -22,16 +25,29 @@ class WeightedAverage:
         self.reset()
 
     def reset(self):
-        self.numerator = 0.0
-        self.denominator = 0.0
+        self.numerator = None
+        self.denominator = None
 
-    def add(self, value, weight=None):
-        v, w = _flatten_value_weight(value, weight)
-        self.numerator += v * w
-        self.denominator += w
+    def add(self, value, weight):
+        if not (_is_number(value) or isinstance(value, np.ndarray)):
+            raise ValueError(
+                "The 'value' must be a number or a numpy ndarray.")
+        if not _is_number(weight):
+            raise ValueError("The 'weight' must be a number.")
+        value = np.asarray(value, dtype=np.float64)
+        weight = float(weight)
+        if self.numerator is None or self.denominator is None:
+            self.numerator = value * weight
+            self.denominator = weight
+        else:
+            self.numerator = self.numerator + value * weight
+            self.denominator += weight
 
     def eval(self):
-        if self.denominator == 0.0:
+        if self.numerator is None or self.denominator is None:
             raise ValueError(
                 "There is no data to be averaged in WeightedAverage.")
+        if self.denominator == 0.0:
+            raise ValueError(
+                "The 'denominator' of WeightedAverage can not be 0.")
         return self.numerator / self.denominator

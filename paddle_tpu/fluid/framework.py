@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import warnings
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -30,6 +31,9 @@ GRAD_SUFFIX = "@GRAD"
 
 # prime marker used to flow unknown (-1) extents through jax.eval_shape
 _DIM_MARKER = 2477
+
+# op types whose broken emitters were already reported at build time
+_infer_shape_warned: set = set()
 
 
 def grad_var_name(name: str) -> str:
@@ -253,9 +257,38 @@ class Operator:
                 f"time: {msg}\n  inputs: {in_desc}\n  attrs: "
                 f"{ {k: v for k, v in attrs.items() if not k.startswith('__')} }"
             ) from e
-        except Exception:
-            # abstract eval needed concrete values / a sub-block / a mesh:
-            # inference is best-effort; runtime lowering re-traces anyway
+        except Exception as e:
+            # Known-benign abstract-eval failures, where inference is
+            # legitimately best-effort (runtime lowering re-traces anyway):
+            #  - sub-block ops: the stub EmitCtx carries no Program, so
+            #    control-flow/pipeline emitters can't resolve their blocks
+            #  - mesh/collective ops: axis names are unbound outside
+            #    shard_map ("unbound axis name" NameError)
+            #  - emitters needing concrete values (jax concretization)
+            if "sub_block" in attrs:
+                return
+            if isinstance(e, NameError) and "axis name" in str(e):
+                return
+            concretization = getattr(
+                jax.errors, "ConcretizationTypeError", ()
+            )
+            tracer_err = getattr(jax.errors, "TracerError", ())
+            if isinstance(e, (concretization, tracer_err)):
+                return
+            # Anything else is a real emitter bug. Surface it at build time
+            # — once per op type, as a warning rather than a hard error so a
+            # conservative emitter can't brick program construction — instead
+            # of deferring to a deep runtime traceback (the late-error mode
+            # build-time inference exists to kill).
+            if self.desc.type not in _infer_shape_warned:
+                _infer_shape_warned.add(self.desc.type)
+                warnings.warn(
+                    f"shape inference for op '{self.desc.type}' failed with "
+                    f"an unexpected {type(e).__name__}: {e} — the emitter "
+                    "likely has a bug that will resurface at trace time",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
             return
         for slot, names in self.desc.outputs.items():
             shapes = outs.get(slot, [])

@@ -223,6 +223,23 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
     def _pair(v):
         return list(v) if isinstance(v, (list, tuple)) else [v, v]
 
+    if ceil_mode and not global_pooling:
+        # Deliberate divergence from the reference (pool_op.cc:33
+        # PoolOutputSize): this backend clamps away a last window living
+        # entirely in right padding (as torch does) — for padding >
+        # ksize/2 the output would be one element smaller than the
+        # reference's. Those configs are degenerate (a window of pure
+        # padding pools nothing), so reject them at build time rather
+        # than silently differ.
+        for k, p in zip(_pair(pool_size), _pair(pool_padding)):
+            if k > 0 and p * 2 > k:
+                raise ValueError(
+                    f"pool2d(ceil_mode=True) requires padding <= ksize/2 "
+                    f"(got ksize={k}, padding={p}): larger padding would "
+                    "create a final window made entirely of padding, where "
+                    "this backend's output size deliberately diverges from "
+                    "the reference's PoolOutputSize"
+                )
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(
         type="pool2d",

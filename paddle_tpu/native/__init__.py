@@ -78,6 +78,8 @@ def _declare(lib):
     lib.pt_buddy_used.argtypes = [c.c_void_p]
     lib.pt_buddy_check.restype = c.c_uint64
     lib.pt_buddy_check.argtypes = [c.c_void_p]
+    lib.pt_buddy_quarantined.restype = c.c_uint64
+    lib.pt_buddy_quarantined.argtypes = [c.c_void_p]
     lib.pt_buddy_total.restype = c.c_uint64
     lib.pt_buddy_total.argtypes = [c.c_void_p]
     lib.pt_buddy_destroy.argtypes = [c.c_void_p]

@@ -70,12 +70,14 @@ class BuddyAllocator:
             if rc == -1:
                 raise ValueError("double free or bad pointer")
             if rc == -2:
-                # block was returned to the arena, but its guard bytes were
-                # clobbered — someone wrote past the requested size
+                # Guard bytes past the requested size were clobbered. The
+                # allocator QUARANTINES the block (it never re-enters the
+                # free lists), so the damaged memory cannot be handed out
+                # again before this error is handled.
                 raise MemoryError(
                     "heap overwrite detected: guard bytes past the block's "
-                    "requested size were clobbered (reference meta_cache "
-                    "guard check)")
+                    "requested size were clobbered; block quarantined "
+                    "(reference meta_cache guard check)")
         else:
             self._used -= arr.nbytes
 
@@ -85,6 +87,13 @@ class BuddyAllocator:
         the §5.2 memory-debug capability)."""
         if self._h is not None:
             return int(self._lib.pt_buddy_check(self._h))
+        return 0
+
+    def quarantined(self) -> int:
+        """Bytes permanently held out of the arena after guard-corruption
+        detection (containment beats reuse of damaged memory)."""
+        if self._h is not None:
+            return int(self._lib.pt_buddy_quarantined(self._h))
         return 0
 
     def memory_usage(self) -> int:

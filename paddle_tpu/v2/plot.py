@@ -72,28 +72,39 @@ class Ploter:
                     print(f"[plot] {t}: step {d.step[-1]} "
                           f"value {d.value[-1]:.6g} ({len(d.step)} points)")
             return
-        import matplotlib
         if path is not None:
-            matplotlib.use("Agg")  # file output needs no display
+            # File output renders through an explicit Agg canvas, bypassing
+            # the process-global backend entirely — a pyplot import earlier
+            # in the process (with any backend) can't break savefig.
+            from matplotlib.backends.backend_agg import FigureCanvasAgg
+            from matplotlib.figure import Figure
+
+            fig = Figure()
+            FigureCanvasAgg(fig)
+            self._draw(fig.add_subplot(111))
+            fig.savefig(path)
+            return
         import matplotlib.pyplot as plt
 
+        self._draw(plt)
+        try:
+            from IPython import display
+            display.clear_output(wait=True)
+            display.display(plt.gcf())
+        except ImportError:
+            plt.show()
+        plt.gcf().clear()
+
+    def _draw(self, ax) -> None:
+        """Plot all non-empty series onto `ax` (an Axes or the pyplot
+        module — both expose plot/legend)."""
         drawn = []
         for t in self._titles:
             d = self._series[t]
             if d.step:
-                plt.plot(d.step, d.value)
+                ax.plot(d.step, d.value)
                 drawn.append(t)
-        plt.legend(drawn, loc="upper left")
-        if path is None:
-            try:
-                from IPython import display
-                display.clear_output(wait=True)
-                display.display(plt.gcf())
-            except ImportError:
-                plt.show()
-        else:
-            plt.savefig(path)
-        plt.gcf().clear()
+        ax.legend(drawn, loc="upper left")
 
     def reset(self) -> None:
         for d in self._series.values():

@@ -157,10 +157,18 @@ def test_weighted_average():
     with pytest.raises(ValueError):
         wa.eval()
     wa.add(2.0, weight=1)
-    wa.add(np.array([4.0, 6.0]), weight=3)  # array -> its mean, weight 3
-    assert wa.eval() == pytest.approx((2.0 + 5.0 * 3) / 4)
+    wa.add(3.0, weight=3)
+    assert wa.eval() == pytest.approx((2.0 + 3.0 * 3) / 4)
     wa.reset()
-    wa.add(7.0)
+    # elementwise numerator for array values (reference average.py keeps
+    # value*weight as an array; eval() is the weighted elementwise mean)
+    wa.add(np.array([4.0, 6.0]), weight=1)
+    wa.add(np.array([8.0, 2.0]), weight=3)
+    np.testing.assert_allclose(wa.eval(), [(4 + 24) / 4, (6 + 6) / 4])
+    with pytest.raises(ValueError):
+        wa.add(1.0, weight=np.array([1.0, 2.0]))  # weight must be a number
+    wa.reset()
+    wa.add(7.0, weight=1)
     assert wa.eval() == 7.0
 
 

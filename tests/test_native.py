@@ -337,6 +337,40 @@ def test_buddy_guard_bytes_detect_overwrite():
         a.close()
 
 
+def test_buddy_quarantines_corrupted_block():
+    """A block whose guard was clobbered must NOT re-enter the free lists
+    (ADVICE r2: pre-quarantine, the damaged memory was immediately reusable
+    while the MemoryError was still propagating)."""
+    import ctypes
+
+    if not native.available():
+        pytest.skip("needs the native library")
+    # arena sized so the corrupted block's space is the only place a
+    # same-size alloc could come from
+    a = BuddyAllocator(1 << 10, min_block=256)
+    try:
+        buf = a.alloc(500)  # rounds to half arena (512) -> 12 guard bytes
+        buf2 = a.alloc(1 << 9)  # other half
+        addr, _ = a._handles[id(buf)]
+        ctypes.memset(addr + 500, 0x5A, 1)  # clobber slack guard
+        assert a.quarantined() == 0
+        with pytest.raises(MemoryError, match="quarantined"):
+            a.free(buf)
+        assert a.quarantined() == 1 << 9
+        # the quarantined half stays out of circulation: a new half-arena
+        # alloc cannot be satisfied
+        assert a.alloc(1 << 9) is None
+        a.free(buf2)
+        # ...even after its neighbour is freed (no coalescing through a
+        # quarantined block)
+        assert a.alloc(1 << 10) is None
+        b3 = a.alloc(1 << 9)
+        assert b3 is not None
+        a.free(b3)
+    finally:
+        a.close()
+
+
 def test_buddy_guard_covers_power_of_two_sizes():
     """With guard='always', exact power-of-two requests bump one block
     level so a guard region always exists (except a whole-arena alloc,
