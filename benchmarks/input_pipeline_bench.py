@@ -1,0 +1,147 @@
+"""Input-pipeline A/B: synthetic device-resident feed vs the in-graph
+recordio + double_buffer pipeline (VERDICT r2 item 2's done-bar: recordio
+step time within ~10% of synthetic).
+
+Runs the SAME model twice and prints one JSON line:
+  {"synthetic_step_ms", "recordio_step_ms", "ratio", ...}
+
+The pipeline rung stores uint8 images; the double-buffer worker thread does
+the uint8->f32 decode + reshape + host->device transfer for batch N+1 while
+the device runs batch N (reference create_double_buffer_reader_op.cc).
+
+Env knobs: PIPE_BATCH (default 32), PIPE_ITERS (20), PIPE_DEPTH (resnet
+depth, 50; use PIPE_MODEL=lenet for a CPU-friendly smoke).
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import layers  # noqa: E402
+from paddle_tpu.fluid.framework import Program, program_guard  # noqa: E402
+from paddle_tpu.fluid.recordio_writer import (  # noqa: E402
+    convert_reader_to_recordio_file,
+)
+
+BATCH = int(os.environ.get("PIPE_BATCH", "32"))
+ITERS = int(os.environ.get("PIPE_ITERS", "20"))
+WARMUP = int(os.environ.get("PIPE_WARMUP", "3"))
+MODEL = os.environ.get("PIPE_MODEL", "resnet")
+DEPTH = int(os.environ.get("PIPE_DEPTH", "50"))
+
+if MODEL == "lenet":
+    IMG_SHAPE, CLASSES = [1, 28, 28], 10
+else:
+    IMG_SHAPE, CLASSES = [3, 224, 224], 1000
+IMG_ELEMS = int(np.prod(IMG_SHAPE))
+
+
+def _build_model(img, label):
+    if MODEL == "lenet":
+        from paddle_tpu.models import lenet
+
+        cost, _, _ = lenet.build(img, label)
+    else:
+        from paddle_tpu.models import resnet
+
+        cost, _, _ = resnet.build_train(img, label, class_dim=CLASSES,
+                                        depth=DEPTH)
+    fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(cost)
+    return cost
+
+
+def _measure(exe, main, scope, cost, feed):
+    import jax
+
+    a_param = main.global_block().all_parameters()[0].name
+    for _ in range(WARMUP):
+        exe.run(main, feed=feed, fetch_list=[cost], return_numpy=False)
+    jax.block_until_ready(scope.find_var(a_param))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(ITERS):
+        out = exe.run(main, feed=feed, fetch_list=[cost], return_numpy=False)
+    jax.block_until_ready(scope.find_var(a_param))
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / ITERS * 1000
+
+
+def run_synthetic():
+    import jax.numpy as jnp
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            img = layers.data(name="img", shape=IMG_SHAPE, dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            cost = _build_model(img, label)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {
+            "img": jnp.asarray(
+                rng.rand(BATCH, *IMG_SHAPE).astype(np.float32)),
+            "label": jnp.asarray(
+                rng.randint(0, CLASSES, size=(BATCH, 1)).astype(np.int64)),
+        }
+        return _measure(exe, main, scope, cost, feed)
+
+
+def run_recordio(path):
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            reader = layers.open_recordio_file(
+                path, shapes=[IMG_SHAPE, [1]], dtypes=["float32", "int64"]
+            )
+            reader = layers.multi_pass(reader, pass_num=8)
+            reader = layers.batch(reader, batch_size=BATCH, drop_last=True)
+            reader = layers.double_buffer(reader, capacity=2)
+            img, label = layers.read_file(reader)
+            cost = _build_model(img, label)
+        exe = fluid.Executor()
+        exe.run(startup)
+        return _measure(exe, main, scope, cost, feed={})
+
+
+def main():
+    n_samples = (WARMUP + ITERS + 2) * BATCH
+    rng = np.random.RandomState(1)
+
+    def gen():
+        for _ in range(n_samples):
+            yield (rng.randint(0, 256, size=(IMG_ELEMS,)).astype(np.uint8),
+                   rng.randint(0, CLASSES, size=(1,)).astype(np.int64))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "pipe.recordio")
+        t0 = time.perf_counter()
+        convert_reader_to_recordio_file(path, gen)
+        write_s = time.perf_counter() - t0
+
+        syn_ms = run_synthetic()
+        rio_ms = run_recordio(path)
+
+    import jax
+
+    print(json.dumps({
+        "model": MODEL,
+        "batch": BATCH,
+        "iters": ITERS,
+        "backend": jax.default_backend(),
+        "synthetic_step_ms": round(syn_ms, 3),
+        "recordio_step_ms": round(rio_ms, 3),
+        "ratio": round(rio_ms / syn_ms, 3),
+        "within_10pct": rio_ms <= syn_ms * 1.10,
+        "recordio_write_s": round(write_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,12 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class EOFException(Exception):
+    """End of a reader's data stream (reference fluid.core.EOFException,
+    thrown by read_op when the underlying reader is exhausted). Catch it
+    around Executor.run and reset the reader / end the pass."""
+
+
 class VarType(enum.Enum):
     # mirrors framework.proto VarType.Type (reference framework.proto:94)
     LOD_TENSOR = "lod_tensor"

@@ -31,7 +31,12 @@ from .flags import FLAGS
 from .framework import Program, Variable, default_main_program
 from .registry import EmitCtx, exec_op_descs
 
-_SKIP_OP_TYPES = {"feed", "fetch"}
+from .readers import READER_CREATE_OP_TYPES, create_host_reader
+
+# ops the device program never sees: feed/fetch plumbing plus the host-side
+# reader stack (creation ops run in the startup pre-pass; `read` resolves to
+# jit feed arrays each step — readers.py explains the design)
+_SKIP_OP_TYPES = {"feed", "fetch", "read"} | set(READER_CREATE_OP_TYPES)
 
 
 class Scope:
@@ -116,6 +121,93 @@ def _as_name(v) -> str:
     return v.name if isinstance(v, Variable) else str(v)
 
 
+def _run_reader_host_ops(block, scope: Scope) -> Dict[str, Any]:
+    """Host pre-pass over a block's reader ops (reference executor.cc runs
+    reader ops as ordinary OperatorBase; here they can't enter the jitted
+    program). Creation ops (re)build the host reader stack into scope —
+    so re-running the startup program resets the pipeline, like the
+    reference's ReInit. `read` ops pop one minibatch and return it as feed
+    arrays for the device program. Raises core.EOFException at end of
+    data."""
+    # per-program-version cache of the reader ops: the common reader-less
+    # program pays one dict lookup per step, not an O(n_ops) scan
+    program = block.program
+    cached = getattr(program, "_reader_ops_cache", None)
+    if cached is None or cached[0] != program._version:
+        reader_ops = [
+            op for op in block.ops
+            if op.desc.type in READER_CREATE_OP_TYPES
+            or op.desc.type == "read"
+        ]
+        program._reader_ops_cache = cached = (program._version, reader_ops)
+    if not cached[1]:
+        return {}
+    feeds: Dict[str, Any] = {}
+    for op in cached[1]:
+        t = op.desc.type
+        if t in READER_CREATE_OP_TYPES:
+            out_name = op.desc.outputs["Out"][0]
+            inner_names = op.desc.inputs.get("UnderlyingReader") or []
+            inner = scope.find_var(inner_names[0]) if inner_names else None
+            old = scope.find_var(out_name)
+            if old is not None and hasattr(old, "close"):
+                old.close()  # free prefetch threads / file handles
+            out_var = block._var_recursive(out_name)
+            slots = out_var.desc.reader_slots if out_var is not None else None
+            scope.set_var(
+                out_name,
+                create_host_reader(t, op.desc.attrs, inner, slots=slots),
+            )
+        elif t == "read":
+            reader_name = op.desc.inputs["Reader"][0]
+            reader = scope.find_var(reader_name)
+            if reader is None or not hasattr(reader, "read_next"):
+                raise RuntimeError(
+                    f"reader var '{reader_name}' has no host reader in "
+                    "scope — run the startup program first"
+                )
+            try:
+                sample = reader.read_next()
+            except StopIteration:
+                raise core.EOFException(
+                    f"reader '{reader_name}' is exhausted"
+                ) from None
+            out_names = op.desc.outputs["Out"]
+            if len(sample) != len(out_names):
+                raise ValueError(
+                    f"reader '{reader_name}' produced {len(sample)} slots, "
+                    f"the read op declares {len(out_names)}"
+                )
+            for name, slot in zip(out_names, sample):
+                if isinstance(slot, tuple):  # (padded, lengths) ragged pair
+                    feeds[name], feeds[name + "@LEN"] = slot
+                else:
+                    feeds[name] = _conform_slot(block, name, slot)
+    return feeds
+
+
+def _conform_slot(block, name: str, slot):
+    """Reshape/cast a popped batch to the declared out-var desc (the role
+    DataFeeder's converters play on the feed path): record files store flat
+    samples (e.g. mnist's 784-vector), the graph declares [-1, 1, 28, 28]."""
+    if isinstance(slot, jax.Array):
+        # a double-buffered batch was already conformed (and device_put) in
+        # the worker thread — don't re-dispatch a reshape on the step loop
+        return slot
+    var = block._var_recursive(name)
+    if var is None or var.shape is None:
+        return slot
+    shape = list(var.shape)
+    if shape.count(-1) <= 1 and tuple(shape) != tuple(slot.shape):
+        slot = slot.reshape(shape)
+    if isinstance(slot, np.ndarray):
+        want = np.dtype(core.convert_dtype(var.dtype)
+                        if var.dtype != "bfloat16" else "float32")
+        if slot.dtype != want:
+            slot = slot.astype(want)
+    return slot
+
+
 def _block_io(block, feed_names: set, scope: Scope):
     """Classify vars of a block: state read (from scope), state written
     (persistable -> survives the run), and which must exist beforehand."""
@@ -197,9 +289,10 @@ class Executor:
         fetch_list = fetch_list or []
         scope = scope or global_scope()
 
+        reader_feeds = _run_reader_host_ops(program.global_block(), scope)
         feed_arrays = {
             k: jnp.asarray(v) if not isinstance(v, jax.Array) else v
-            for k, v in feed.items()
+            for k, v in {**feed, **reader_feeds}.items()
         }
         fetch_names = tuple(_as_name(v) for v in fetch_list)
         jfn, ro_names, rw_names, state_out = self._entry(

@@ -26,6 +26,10 @@ class VarDesc:
     stop_gradient: bool = False
     is_parameter: bool = False
     trainable: bool = True
+    # READER vars only: per-slot {shape, dtype, lod_level} specs (the
+    # reference's VarType.ReaderDesc lod_tensor list, framework.proto:94) —
+    # read_file() creates its output vars from these
+    reader_slots: Optional[List[Dict[str, Any]]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -213,3 +213,57 @@ def test_understand_sentiment_dynamic_lstm():
         # program content hash)
         assert min(losses[1:]) < losses[0], (losses[0], losses[-1])
         assert np.isfinite(losses[-1])
+
+
+def test_recognize_digits_conv_recordio():
+    """recognize_digits trained through the IN-GRAPH reader pipeline
+    (reference tests/book/test_recognize_digits.py recordio path +
+    layers/io.py:281-490): recordio file -> batch -> double_buffer ->
+    read_file, EOF-terminated epochs, no feed dict."""
+    import tempfile
+
+    from paddle_tpu.fluid import core
+    from paddle_tpu.fluid.recordio_writer import (
+        convert_reader_to_recordio_file,
+    )
+    from paddle_tpu.models import lenet
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = tmp + "/mnist.recordio"
+
+        def limited():
+            for i, s in enumerate(paddle_tpu.dataset.mnist.train()()):
+                if i >= 256:
+                    break
+                yield s
+
+        convert_reader_to_recordio_file(path, limited)
+
+        main, startup, scope = Program(), Program(), fluid.Scope()
+        main.random_seed = startup.random_seed = 7
+        with fluid.scope_guard(scope):
+            with program_guard(main, startup):
+                reader = layers.open_recordio_file(
+                    path, shapes=[[1, 28, 28], [1]],
+                    dtypes=["float32", "int64"],
+                )
+                reader = layers.shuffle(reader, buffer_size=128, seed=3)
+                reader = layers.batch(reader, batch_size=64, drop_last=True)
+                reader = layers.double_buffer(reader)
+                img, label = layers.read_file(reader)
+                avg_cost, acc, prediction = lenet.build(img, label)
+                fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+
+            exe = fluid.Executor()
+            exe.run(startup)
+            losses = []
+            for _ in range(2):  # 2 epochs, EOF-delimited
+                try:
+                    while True:
+                        (loss,) = exe.run(main, fetch_list=[avg_cost])
+                        losses.append(float(np.asarray(loss).reshape(-1)[0]))
+                except core.EOFException:
+                    layers.reset_reader(reader, scope)
+            assert len(losses) == 2 * (256 // 64)
+            assert min(losses[1:]) < losses[0], losses
+            assert np.isfinite(losses).all()
