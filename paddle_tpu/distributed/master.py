@@ -266,23 +266,23 @@ class MasterService:
         self._conns = set()
         self._conns_mu = threading.Lock()
 
+        from .rpc import read_frame, write_frame
+
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
                 with service._conns_mu:
                     service._conns.add(self.connection)
                 try:
                     while True:
-                        head = self.rfile.read(4)
-                        if len(head) != 4:
-                            return
-                        (n,) = struct.unpack("<I", head)
-                        if n > MasterService._MAX_FRAME:
+                        try:
+                            req = read_frame(
+                                self.rfile,
+                                max_frame=MasterService._MAX_FRAME)
+                        except IOError:
                             return  # protocol violation: drop the peer
-                        body = self.rfile.read(n)
-                        if len(body) != n:
+                        if req is None:
                             return
                         try:
-                            req = json.loads(body.decode("utf-8"))
                             method = req["method"]
                             if method not in MasterService._RPC_METHODS:
                                 raise ValueError(
@@ -296,9 +296,7 @@ class MasterService:
                             return
                         except Exception as e:  # report, keep serving
                             resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
-                        out = json.dumps(resp).encode("utf-8")
-                        self.wfile.write(struct.pack("<I", len(out)) + out)
-                        self.wfile.flush()
+                        write_frame(self.wfile, resp)
                 except (ConnectionError, EOFError):
                     return
                 finally:

@@ -1,0 +1,287 @@
+"""Executable parameter server — cross-process async/sync SGD.
+
+The reference's pserver is `listen_and_serv_op.cc:78-192`: block on N
+gradient sends, run per-param optimize sub-blocks via an Executor, handle
+sparse SelectedRows grads, answer parameter gets. This is that capability
+around OUR stack: an RPC service (distributed/rpc.py framing) wrapping an
+Executor that runs the per-param slices of
+`DistributeTranspiler.get_pserver_program(ep)`.
+
+  - push_grad(name, grad[, trainer_id]) — grad is dense ndarray OR
+    SelectedRows (rows/value/height ride the wire, the row-wise lazy
+    optimizer ops apply them without densifying). async mode applies
+    immediately (reference sync_mode=False); sync mode accumulates until
+    all `trainers` have pushed, sums (dense add / SelectedRows concat —
+    reference listen_and_serv_op.cc:181-192), applies once, and releases
+    the barrier.
+  - get_param(name) — current value from the pserver scope.
+  - barrier() — sync mode: wait until the current round's updates applied
+    (the reference's send_barrier_op).
+
+Trainer side: `ParameterClient` (send/recv), or in-graph `send`/`recv`
+ops the Executor runs as host ops (reference send_op.cc/recv_op.cc) —
+see DistributeTranspiler.get_trainer_program(send_recv=True).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .rpc import RpcClient, RpcServer
+
+__all__ = ["ParameterServer", "ParameterClient", "get_client"]
+
+
+class ParameterServer:
+    """Runs the optimize slice of a pserver program behind RPC."""
+
+    def __init__(self, pserver_program, startup_program, trainers: int = 1,
+                 sync_mode: bool = False):
+        import paddle_tpu.fluid as fluid
+
+        self._trainers = max(1, int(trainers))
+        self._sync = bool(sync_mode)
+        self._scope = fluid.Scope()
+        self._exe = fluid.Executor()
+        self._program = pserver_program
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._round = 0
+        # sync: param -> {trainer_id: grad} — DISTINCT trainers complete a
+        # round (a retransmitted push overwrites, it can't phantom-complete)
+        self._pending: Dict[str, Dict[int, Any]] = {}
+        self._applied_round: set = set()
+        self._steps = 0
+        self._apply_mu = threading.Lock()
+        self._pushes_since_shared = 0
+
+        block = pserver_program.global_block()
+        self._owned = sorted(
+            n for n, v in block.vars.items()
+            if getattr(v.desc, "is_parameter", False)
+        )
+        owned = set(self._owned)
+        # Split the pserver program (reference listen_and_serv: per-param
+        # optimize sub-blocks + ONE lr-decay sub-block run once per round):
+        #  - shared STATEFUL ops (advance persistable non-param state, e.g.
+        #    the LR-decay step counter) run once per round, not once per
+        #    param-push — otherwise a 2-param pserver would decay the LR
+        #    twice per step;
+        #  - everything else shared (stateless arithmetic) stays in each
+        #    per-param slice, where recomputing it is free.
+        persistable = {n for n, v in block.vars.items() if v.persistable}
+        shared_stateful = []
+        for op in block.ops:
+            outs = set(op.desc.output_names())
+            if not (outs & owned) and (outs & (persistable - owned)):
+                shared_stateful.append(op)
+        shared_idx = {id(op) for op in shared_stateful}
+
+        def _slice(keep_pred):
+            prog = pserver_program.clone()
+            b = prog.global_block()
+            keep = [op for orig, op in zip(block.ops, b.ops)
+                    if keep_pred(orig)]
+            b.ops = keep
+            used = set(owned)
+            for op in keep:
+                used.update(n for n in op.desc.input_names() if n)
+                used.update(n for n in op.desc.output_names() if n)
+            b.vars = {n: v for n, v in b.vars.items() if n in used}
+            prog._bump_version()
+            return prog
+
+        self._shared_prog = None
+        if shared_stateful:
+            self._shared_prog = _slice(lambda op: id(op) in shared_idx)
+        self._per_param: Dict[str, Any] = {}
+        self._grad_name: Dict[str, str] = {}
+        for p in self._owned:
+            def keep(op, p=p):
+                outs = set(op.desc.output_names())
+                if id(op) in shared_idx:
+                    return False
+                return p in outs or not (outs & owned)
+
+            self._per_param[p] = _slice(keep)
+            # the grad feed name is whatever the optimize op's Grad input
+            # actually is (clipping/regularization can rename it)
+            gname = p + "@GRAD"
+            for op in block.ops:
+                if p in set(op.desc.output_names()):
+                    g = (op.desc.inputs.get("Grad") or [gname])[0]
+                    gname = g or gname
+                    break
+            self._grad_name[p] = gname
+
+        with fluid.scope_guard(self._scope):
+            self._exe.run(startup_program)
+
+        self._server = RpcServer({
+            "get_param": self.get_param,
+            "push_grad": self.push_grad,
+            "barrier": self.barrier,
+            "owned_params": self.owned_params,
+            "stats": self.stats,
+        })
+
+    # --- RPC methods ---------------------------------------------------
+    def owned_params(self) -> List[str]:
+        return list(self._owned)
+
+    def stats(self) -> Dict[str, int]:
+        """Evidence of server-side work: optimize steps applied + round."""
+        return {"steps": self._steps, "round": self._round,
+                "sync": self._sync, "trainers": self._trainers}
+
+    def get_param(self, name: str):
+        if name not in self._owned:
+            raise KeyError(f"param '{name}' is not owned by this pserver")
+        v = self._scope.find_var(name)
+        return np.asarray(v)
+
+    def push_grad(self, name: str, grad, trainer_id: int = 0):
+        if name not in self._owned:
+            raise KeyError(f"param '{name}' is not owned by this pserver")
+        if not self._sync:
+            # hogwild-style async, but each individual update is atomic:
+            # unserialized applies would drop whole gradients (read-modify-
+            # write on the scope), which is worse than async staleness
+            with self._apply_mu:
+                self._apply(name, grad)
+            return {"step": self._steps}
+        with self._cv:
+            self._pending.setdefault(name, {})[int(trainer_id)] = grad
+            if len(self._pending[name]) >= self._trainers:
+                merged = _merge_grads(list(self._pending.pop(name).values()))
+                self._apply(name, merged)
+                self._applied_round.add(name)
+            # a round completes when EVERY owned param applied its merge
+            # (an empty pending map alone is not enough — params not yet
+            # pushed this round leave it empty too)
+            if self._applied_round >= set(self._owned):
+                self._applied_round.clear()
+                self._round += 1
+                self._cv.notify_all()
+            return {"step": self._steps}
+
+    def barrier(self, known_round: Optional[int] = None):
+        """Sync mode: block until every gradient pushed so far has been
+        applied — i.e. no partial round is outstanding (reference
+        send_barrier_op: trainers send, barrier, then recv). The trainer
+        whose push completed the round sees no pending work and returns
+        immediately; earlier trainers wait for the stragglers."""
+        if not self._sync:
+            return {"round": self._round}
+        with self._cv:
+            done = self._cv.wait_for(
+                lambda: not self._pending and not self._applied_round,
+                timeout=120,
+            )
+            if not done:
+                raise TimeoutError(
+                    "sync round incomplete after 120s — a trainer died "
+                    f"mid-round (pending: {list(self._pending)})"
+                )
+            return {"round": self._round}
+
+    # --- internals -----------------------------------------------------
+    def _apply(self, name: str, grad):
+        import paddle_tpu.fluid as fluid
+
+        with fluid.scope_guard(self._scope):
+            # shared stateful chain (LR-decay counters) advances once per
+            # round: every len(owned) pushes, not on every param push
+            if self._shared_prog is not None:
+                if self._pushes_since_shared % len(self._owned) == 0:
+                    self._exe.run(self._shared_prog)
+                self._pushes_since_shared += 1
+            self._exe.run(self._per_param[name],
+                          feed={self._grad_name[name]: grad})
+        self._steps += 1
+
+    # --- lifecycle -----------------------------------------------------
+    def serve(self, host: str = "127.0.0.1", port: int = 0
+              ) -> Tuple[str, int]:
+        return self._server.serve(host, port)
+
+    @property
+    def address(self):
+        return self._server.address
+
+    def shutdown(self):
+        self._server.shutdown()
+
+
+def _merge_grads(grads: List[Any]):
+    """Sum a sync round's gradients (reference listen_and_serv_op.cc
+    :181-192: dense sum / SelectedRows concat-then-merge)."""
+    from ..fluid.selected_rows import SelectedRows, is_selected_rows
+
+    if any(is_selected_rows(g) for g in grads):
+        rows = np.concatenate([np.asarray(g.rows) for g in grads])
+        value = np.concatenate([np.asarray(g.value) for g in grads])
+        return SelectedRows(rows, value, grads[0].height)
+    out = np.asarray(grads[0])
+    for g in grads[1:]:
+        out = out + np.asarray(g)
+    return out
+
+
+class ParameterClient:
+    """Trainer-side client (reference operators/detail/grpc_client.cc +
+    send_op/recv_op): push grads to / pull params from the pserver that
+    owns each variable."""
+
+    def __init__(self, assignment: Dict[str, str], trainer_id: int = 0):
+        """assignment: param name -> "host:port" endpoint
+        (DistributeTranspiler.param_assignment)."""
+        self._assignment = dict(assignment)
+        self._trainer_id = int(trainer_id)
+
+    def _client(self, name: str) -> RpcClient:
+        ep = self._assignment.get(name)
+        if ep is None:
+            raise KeyError(f"no pserver assignment for '{name}'")
+        return get_client(ep)
+
+    def send_grad(self, name: str, grad):
+        return self._client(name).call("push_grad", name, grad,
+                                       self._trainer_id)
+
+    def get_param(self, name: str) -> np.ndarray:
+        return self._client(name).call("get_param", name)
+
+    def barrier(self, known_round: Optional[int] = None):
+        done = {}
+        for ep in set(self._assignment.values()):
+            done[ep] = get_client(ep).call("barrier", known_round)
+        return done
+
+    def pull_all(self, scope=None) -> Dict[str, np.ndarray]:
+        """Fetch every assigned param; writes into `scope` when given
+        (the reference recv+concat step after the barrier)."""
+        out = {}
+        for name in self._assignment:
+            out[name] = self.get_param(name)
+            if scope is not None:
+                import jax.numpy as jnp
+
+                scope.set_var(name, jnp.asarray(out[name]))
+        return out
+
+
+_clients: Dict[str, RpcClient] = {}
+_clients_mu = threading.Lock()
+
+
+def get_client(endpoint: str) -> RpcClient:
+    """Process-wide client cache, one connection per endpoint (the
+    reference's grpc channel cache)."""
+    with _clients_mu:
+        c = _clients.get(endpoint)
+        if c is None:
+            c = _clients[endpoint] = RpcClient(endpoint)
+        return c

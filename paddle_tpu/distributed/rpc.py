@@ -1,0 +1,198 @@
+"""Length-prefixed JSON RPC framing — the transport under the parameter
+server (and the same wire shape the master service uses,
+distributed/master.py:serve). One frame = 4-byte little-endian length +
+UTF-8 JSON. Tensors ride as tagged base64 blobs; nothing needs pickle, so
+a hostile peer can at worst force a parse error or a dropped connection
+(the reference's in-cluster transport is protobuf for the same reason —
+operators/detail/send_recv.proto:17 VariableMessage = name + type + dims +
+chunked raw bytes).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+# tensors are bigger than master-service task lists: cap frames at 256 MiB
+# (a bs=8192 f32 [8192, 4096] embedding push is ~128 MiB)
+MAX_FRAME = 256 << 20
+
+
+def to_wire(obj):
+    """JSON-encode numpy arrays and SelectedRows as tagged blobs."""
+    from ..fluid.selected_rows import SelectedRows, is_selected_rows
+
+    if is_selected_rows(obj):
+        return {"__sr__": {
+            "rows": to_wire(np.asarray(obj.rows)),
+            "value": to_wire(np.asarray(obj.value)),
+            "height": int(obj.height),
+        }}
+    if isinstance(obj, np.ndarray):
+        arr = np.ascontiguousarray(obj)
+        return {"__nd__": {
+            "dtype": str(arr.dtype),
+            "shape": list(arr.shape),
+            "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+        }}
+    if isinstance(obj, dict):
+        return {k: to_wire(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_wire(v) for v in obj]
+    return obj
+
+
+def from_wire(obj):
+    from ..fluid.selected_rows import SelectedRows
+
+    if isinstance(obj, dict):
+        if "__nd__" in obj and len(obj) == 1:
+            spec = obj["__nd__"]
+            arr = np.frombuffer(
+                base64.b64decode(spec["b64"]), dtype=np.dtype(spec["dtype"])
+            ).reshape(spec["shape"])
+            return arr.copy()  # writable, owns its memory
+        if "__sr__" in obj and len(obj) == 1:
+            spec = obj["__sr__"]
+            return SelectedRows(
+                from_wire(spec["rows"]), from_wire(spec["value"]),
+                int(spec["height"]),
+            )
+        return {k: from_wire(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [from_wire(v) for v in obj]
+    return obj
+
+
+def read_frame(rfile, max_frame: int = MAX_FRAME) -> Optional[dict]:
+    head = rfile.read(4)
+    if len(head) != 4:
+        return None
+    (n,) = struct.unpack("<I", head)
+    if n > max_frame:
+        raise IOError(f"frame of {n} bytes exceeds cap")
+    body = rfile.read(n)
+    if len(body) != n:
+        return None
+    return json.loads(body.decode("utf-8"))
+
+
+def write_frame(wfile, obj: dict):
+    out = json.dumps(obj).encode("utf-8")
+    wfile.write(struct.pack("<I", len(out)) + out)
+    wfile.flush()
+
+
+class RpcServer:
+    """Threaded JSON-RPC server over a method dispatch table."""
+
+    def __init__(self, methods: Dict[str, Callable]):
+        self._methods = dict(methods)
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0
+              ) -> Tuple[str, int]:
+        methods = self._methods
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        req = read_frame(self.rfile)
+                        if req is None:
+                            return
+                        try:
+                            fn = methods.get(req["method"])
+                            if fn is None:
+                                raise ValueError(
+                                    f"unknown RPC method {req['method']!r}")
+                            result = fn(*from_wire(req.get("args", [])))
+                            resp = {"ok": True, "result": to_wire(result)}
+                        except Exception as e:  # report, keep serving
+                            resp = {"ok": False,
+                                    "error": f"{type(e).__name__}: {e}"}
+                        write_frame(self.wfile, resp)
+                except (ConnectionError, EOFError, IOError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+        return self._server.server_address
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address
+
+    def shutdown(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+class RpcClient:
+    """Blocking client. Reconnects a broken socket before the NEXT call,
+    but never retransmits a frame that may already have been delivered —
+    push_grad is not idempotent, and a retransmitted gradient would be
+    applied twice. The timeout exceeds the server's 120s sync-barrier
+    wait so a slow round can't masquerade as a dead connection."""
+
+    def __init__(self, addr: Tuple[str, int], timeout: float = 180.0):
+        if isinstance(addr, str):
+            host, _, port = addr.rpartition(":")
+            addr = (host or "127.0.0.1", int(port))
+        self._addr = tuple(addr)
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._mu = threading.Lock()
+
+    def call(self, method: str, *args):
+        with self._mu:
+            if self._sock is None:
+                # connecting is side-effect-free: retry once
+                for attempt in (0, 1):
+                    try:
+                        self._sock = socket.create_connection(
+                            self._addr, timeout=self._timeout)
+                        break
+                    except OSError:
+                        if attempt:
+                            raise
+                self._rfile = self._sock.makefile("rb")
+                self._wfile = self._sock.makefile("wb")
+            try:
+                write_frame(self._wfile,
+                            {"method": method, "args": to_wire(args)})
+                resp = read_frame(self._rfile)
+            except (ConnectionError, OSError):
+                self.close_locked()
+                raise
+            if resp is None:
+                self.close_locked()
+                raise ConnectionError("server closed mid-call")
+        if not resp.get("ok"):
+            raise RuntimeError(f"RPC {method} failed: {resp.get('error')}")
+        return from_wire(resp.get("result"))
+
+    def close_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self):
+        with self._mu:
+            self.close_locked()

@@ -113,10 +113,84 @@ class DistributeTranspiler:
         return plan
 
     # -- reference-API program views -------------------------------------
-    def get_trainer_program(self) -> Program:
-        """SPMD: the trainer program IS the program (the reference instead
-        appends split/send/recv ops here)."""
-        return self._program
+    def get_trainer_program(self, send_recv: bool = False) -> Program:
+        """Default (SPMD): the trainer program IS the program — gradient
+        aggregation is the psum the partitioner inserts.
+
+        send_recv=True builds the REFERENCE flow (transpile:136): optimize
+        ops move to the pserver; the trainer program gets a `recv` op up
+        front (pull current params) and a `send` op at the end (push
+        gradients) which the Executor runs as host RPC ops around the
+        jitted step (send_op.cc / recv_op.cc). With sync_mode a
+        send_barrier op follows the send (send_barrier_op.cc)."""
+        if not send_recv:
+            return self._program
+        if not self.param_assignment:
+            raise ValueError("transpile() was not given pserver endpoints")
+        prog = self._program.clone()
+        block = prog.global_block()
+        owned = set(self.param_assignment)
+        # strip the param-updating (optimize) ops — they now run on the
+        # pserver; the LR-schedule chain left behind is dead scalar code
+        # XLA eliminates
+        pairs = []  # (param, grad) in op order
+        kept = []
+        for op in block.ops:
+            outs = set(op.desc.output_names())
+            if outs & owned:
+                p = next(iter(outs & owned))
+                g = (op.desc.inputs.get("Grad") or [p + "@GRAD"])[0]
+                pairs.append((p, g))
+                continue
+            kept.append(op)
+        block.ops = kept
+        if not pairs:
+            raise ValueError("no optimize ops found to transpile — call "
+                             "minimize() before transpile()")
+
+        from .framework import Operator
+
+        recv = Operator(
+            block, "recv", inputs={},
+            outputs={"Out": [p for p, _ in pairs]},
+            attrs={"endpoints": {p: self.param_assignment[p]
+                                 for p, _ in pairs}},
+        )
+        block.ops.insert(0, recv)
+        send = Operator(
+            block, "send", inputs={"X": [g for _, g in pairs]},
+            outputs={},
+            attrs={
+                "endpoints": {g: self.param_assignment[p] for p, g in pairs},
+                "params": {g: p for p, g in pairs},
+                "trainer_id": self.trainer_id,
+            },
+        )
+        block.ops.append(send)
+        if getattr(self, "sync_mode", True) and self.trainers > 1:
+            barrier = Operator(
+                block, "send_barrier", inputs={}, outputs={},
+                attrs={"endpoints": sorted(set(self.param_assignment.values()))},
+            )
+            block.ops.append(barrier)
+        prog._bump_version()
+        return prog
+
+    def start_pserver(self, endpoint: str, host: str = "127.0.0.1",
+                      port: int = 0, sync_mode: Optional[bool] = None):
+        """Build this endpoint's pserver program pair and serve it
+        (reference listen_and_serv_op.cc:78 behind trainer RPC). Returns
+        the running ParameterServer; its .address is what trainers dial."""
+        from ..distributed.param_server import ParameterServer
+
+        ps = ParameterServer(
+            self.get_pserver_program(endpoint),
+            self.get_startup_program(endpoint),
+            trainers=self.trainers,
+            sync_mode=self.sync_mode if sync_mode is None else sync_mode,
+        )
+        ps.serve(host, port)
+        return ps
 
     def _owned_params(self, endpoint: str) -> List[str]:
         return [n for n, ep in self.param_assignment.items() if ep == endpoint]
@@ -180,14 +254,19 @@ class DistributeTranspiler:
     def get_startup_program(self, endpoint: str,
                             pserver_program: Optional[Program] = None
                             ) -> Program:
+        """Initializers this pserver needs: its params, their optimizer
+        accumulators, LR/step globals — i.e. every var the pserver program
+        reads or writes (the reference builds exactly this, :400)."""
         if self._startup is None:
             raise ValueError("transpile() was not given a startup_program")
-        owned = set(self._owned_params(endpoint))
+        if pserver_program is None:
+            pserver_program = self.get_pserver_program(endpoint)
+        wanted = set(pserver_program.global_block().vars)
         pruned = self._startup.clone()
         block = pruned.global_block()
         keep_ops = [op for op in block.ops
-                    if set(op.desc.output_names()) & owned]
-        used = set(owned)
+                    if set(op.desc.output_names()) & wanted]
+        used = set(wanted)
         for op in keep_ops:
             used.update(n for n in op.desc.input_names() if n)
         block.ops = keep_ops

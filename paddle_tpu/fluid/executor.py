@@ -33,10 +33,15 @@ from .registry import EmitCtx, exec_op_descs
 
 from .readers import READER_CREATE_OP_TYPES, create_host_reader
 
-# ops the device program never sees: feed/fetch plumbing plus the host-side
+# ops the device program never sees: feed/fetch plumbing, the host-side
 # reader stack (creation ops run in the startup pre-pass; `read` resolves to
-# jit feed arrays each step — readers.py explains the design)
-_SKIP_OP_TYPES = {"feed", "fetch", "read"} | set(READER_CREATE_OP_TYPES)
+# jit feed arrays each step — readers.py explains the design), and the
+# pserver transport ops (send/recv/send_barrier run as host RPC around the
+# jitted step — reference send_op.cc/recv_op.cc/send_barrier_op.cc)
+_SKIP_OP_TYPES = (
+    {"feed", "fetch", "read", "send", "recv", "send_barrier"}
+    | set(READER_CREATE_OP_TYPES)
+)
 
 
 class Scope:
@@ -186,6 +191,76 @@ def _run_reader_host_ops(block, scope: Scope) -> Dict[str, Any]:
     return feeds
 
 
+def _as_feed(v):
+    """Feed-dict value -> jit argument. SelectedRows pass through as the
+    pytree they are (a pserver feeds sparse grads straight to the row-wise
+    lazy optimizer ops)."""
+    from .selected_rows import is_selected_rows
+
+    if is_selected_rows(v) or isinstance(v, jax.Array):
+        return v
+    return jnp.asarray(v)
+
+
+def _feed_sig_entry(v):
+    from .selected_rows import is_selected_rows
+
+    if is_selected_rows(v):
+        return ("selrows", tuple(v.rows.shape), tuple(v.value.shape),
+                str(v.value.dtype), v.height)
+    return (tuple(v.shape), str(v.dtype))
+
+
+def _dist_host_ops(block):
+    """(send ops, recv ops) of a block, cached per program version."""
+    program = block.program
+    cached = getattr(program, "_dist_ops_cache", None)
+    if cached is None or cached[0] != program._version:
+        sends = [op for op in block.ops
+                 if op.desc.type in ("send", "send_barrier")]
+        recvs = [op for op in block.ops if op.desc.type == "recv"]
+        program._dist_ops_cache = cached = (program._version, sends, recvs)
+    return cached[1], cached[2]
+
+
+def _run_recv_ops(recv_ops, scope: Scope):
+    """Pull current param values from their pservers into scope BEFORE the
+    step (reference recv_op.cc + concat on the trainer)."""
+    from ..distributed.param_server import get_client
+
+    for op in recv_ops:
+        eps = op.desc.attrs.get("endpoints", {})
+        for name in op.desc.outputs.get("Out", []):
+            ep = eps.get(name)
+            if ep is None:
+                raise ValueError(f"recv op has no endpoint for '{name}'")
+            scope.set_var(name, jnp.asarray(get_client(ep).call(
+                "get_param", name)))
+
+
+def _run_send_ops(send_ops, values: Dict[str, Any]):
+    """Push computed gradients to their pservers AFTER the step (reference
+    send_op.cc AsyncSendVariable; send_barrier_op for sync rounds)."""
+    from .selected_rows import is_selected_rows
+    from ..distributed.param_server import get_client
+
+    for op in send_ops:
+        attrs = op.desc.attrs
+        if op.desc.type == "send_barrier":
+            for ep in attrs.get("endpoints", []):
+                get_client(ep).call("barrier", attrs.get("known_round"))
+            continue
+        eps = attrs.get("endpoints", {})
+        params = attrs.get("params", {})
+        trainer_id = int(attrs.get("trainer_id", 0))
+        for gname in op.desc.inputs.get("X", []):
+            v = values[gname]
+            if not is_selected_rows(v):
+                v = np.asarray(v)
+            get_client(eps[gname]).call(
+                "push_grad", params.get(gname, gname), v, trainer_id)
+
+
 def _conform_slot(block, name: str, slot):
     """Reshape/cast a popped batch to the declared out-var desc (the role
     DataFeeder's converters play on the feed path): record files store flat
@@ -291,12 +366,22 @@ class Executor:
 
         reader_feeds = _run_reader_host_ops(program.global_block(), scope)
         feed_arrays = {
-            k: jnp.asarray(v) if not isinstance(v, jax.Array) else v
-            for k, v in {**feed, **reader_feeds}.items()
+            k: _as_feed(v) for k, v in {**feed, **reader_feeds}.items()
         }
         fetch_names = tuple(_as_name(v) for v in fetch_list)
+        # send ops (host-side, reference send_op.cc) transport gradient
+        # values: fetch them out of the jitted step, push after it runs
+        send_ops, recv_ops = _dist_host_ops(program.global_block())
+        if recv_ops:
+            _run_recv_ops(recv_ops, scope)
+        extra_fetches: Tuple[str, ...] = ()
+        if send_ops:
+            want = [n for op in send_ops
+                    for n in op.desc.inputs.get("X", []) if n]
+            extra_fetches = tuple(n for n in want if n not in fetch_names)
         jfn, ro_names, rw_names, state_out = self._entry(
-            program, feed_arrays, fetch_names, scope, use_program_cache
+            program, feed_arrays, fetch_names + extra_fetches, scope,
+            use_program_cache
         )
         state_ro = {n: scope.find_var(n) for n in ro_names}
         state_rw = {n: scope.find_var(n) for n in rw_names}
@@ -310,6 +395,10 @@ class Executor:
             print(f"[benchmark] run took {(_time.perf_counter()-t0)*1000:.3f} ms")
         for n, v in new_state.items():
             scope.set_var(n, v)
+        if send_ops:
+            sent_vals = dict(zip(fetch_names + extra_fetches, fetches))
+            _run_send_ops(send_ops, sent_vals)
+            fetches = fetches[:len(fetch_names)]
         if FLAGS["check_nan_inf"]:
             # reference FLAGS_check_nan_inf sweep (executor.cc:352-360)
             from .selected_rows import is_selected_rows
@@ -332,8 +421,7 @@ class Executor:
 
         block = program.global_block()
         feed_sig = tuple(
-            sorted((k, tuple(v.shape), str(v.dtype))
-                   for k, v in feed_arrays.items())
+            sorted((k, _feed_sig_entry(v)) for k, v in feed_arrays.items())
         )
         cache_key = (program._version, feed_sig, fetch_names, trace_flags())
         prog_cache = self._cache.setdefault(program, {})
@@ -373,10 +461,7 @@ class Executor:
         program = program or default_main_program()
         feed = feed or {}
         scope = scope or global_scope()
-        feed_arrays = {
-            k: jnp.asarray(v) if not isinstance(v, jax.Array) else v
-            for k, v in feed.items()
-        }
+        feed_arrays = {k: _as_feed(v) for k, v in feed.items()}
         entry = self._entry(program, feed_arrays,
                             tuple(_as_name(v) for v in fetch_list or []),
                             scope, use_program_cache=True)

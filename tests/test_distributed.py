@@ -163,8 +163,15 @@ def test_distribute_transpiler_facade():
             assert op.desc.type == "sgd"
             assert set(op.desc.output_names()) & owned
         sp = t.get_startup_program(ep, pp)
+        # startup initializes everything the pserver program touches:
+        # owned params AND their LR/accumulator globals (a pserver
+        # missing its velocity/LR init cannot run — r3 fix)
+        pserver_vars = set(pp.global_block().vars)
         for op in sp.global_block().ops:
-            assert set(op.desc.output_names()) & owned
+            assert set(op.desc.output_names()) & pserver_vars
+        initialized = {n for op in sp.global_block().ops
+                       for n in op.desc.output_names()}
+        assert owned <= initialized
 
     # hash_name split is stable across processes
     from paddle_tpu.fluid.distribute_transpiler import hash_name
