@@ -278,6 +278,12 @@ class MasterService:
                             req = read_frame(
                                 self.rfile,
                                 max_frame=MasterService._MAX_FRAME)
+                        except json.JSONDecodeError as e:
+                            # malformed but well-framed: report + keep serving
+                            write_frame(self.wfile,
+                                        {"ok": False,
+                                         "error": f"bad frame: {e}"})
+                            continue
                         except IOError:
                             return  # protocol violation: drop the peer
                         if req is None:
@@ -374,26 +380,21 @@ class MasterClient:
             f"{last_err}") from last_err
 
     def _call_once(self, method: str, *args):
+        from .rpc import read_frame, write_frame
+
         with self._lock:
             try:
                 if self._sock is None:
                     addr = self._resolver() if self._resolver else self._addr
                     self._sock = socket.create_connection(addr)
-                payload = json.dumps(
-                    {"method": method, "args": list(args)}).encode("utf-8")
-                self._sock.sendall(struct.pack("<I", len(payload)) + payload)
-                head = self._sock.recv(4, socket.MSG_WAITALL)
-                if len(head) != 4:
+                    self._rfile = self._sock.makefile("rb")
+                    self._wfile = self._sock.makefile("wb")
+                write_frame(self._wfile,
+                            {"method": method, "args": list(args)})
+                resp = read_frame(self._rfile)
+                if resp is None:
                     raise ConnectionError(
                         "master closed the connection mid-call")
-                (n,) = struct.unpack("<I", head)
-                buf = b""
-                while len(buf) < n:
-                    chunk = self._sock.recv(n - len(buf))
-                    if not chunk:
-                        raise ConnectionError(
-                            "master closed the connection mid-frame")
-                    buf += chunk
             except (ConnectionError, OSError):
                 # drop the broken socket so the next call reconnects
                 try:
@@ -402,7 +403,6 @@ class MasterClient:
                 finally:
                     self._sock = None
                 raise
-            resp = json.loads(buf.decode("utf-8"))
             if not resp.get("ok"):
                 raise RuntimeError(f"master RPC failed: {resp.get('error')}")
             return _from_wire(resp.get("result"))

@@ -151,8 +151,13 @@ class ParameterServer:
             # write on the scope), which is worse than async staleness
             with self._apply_mu:
                 self._apply(name, grad)
-            return {"step": self._steps}
+            return {"step": self._steps, "round": self._round}
         with self._cv:
+            # the round this grad belongs to, BEFORE any completion this
+            # push might trigger — the trainer barriers on it (its whole
+            # step's pushes share it: a round cannot complete without this
+            # trainer's last push, so it can't advance mid-step)
+            round_of_push = self._round
             self._pending.setdefault(name, {})[int(trainer_id)] = grad
             if len(self._pending[name]) >= self._trainers:
                 merged = _merge_grads(list(self._pending.pop(name).values()))
@@ -165,25 +170,25 @@ class ParameterServer:
                 self._applied_round.clear()
                 self._round += 1
                 self._cv.notify_all()
-            return {"step": self._steps}
+            return {"step": self._steps, "round": round_of_push}
 
     def barrier(self, known_round: Optional[int] = None):
-        """Sync mode: block until every gradient pushed so far has been
-        applied — i.e. no partial round is outstanding (reference
-        send_barrier_op: trainers send, barrier, then recv). The trainer
-        whose push completed the round sees no pending work and returns
-        immediately; earlier trainers wait for the stragglers."""
-        if not self._sync:
+        """Sync mode: block until round `known_round` (the value push_grad
+        returned for this trainer's sends) has completed (reference
+        send_barrier_op: send, barrier, recv). Waiting on a round NUMBER —
+        not on queue emptiness — keeps a fast trainer's next-round pushes
+        from wedging a slow trainer's barrier. known_round=None just
+        reports the current round."""
+        if not self._sync or known_round is None:
             return {"round": self._round}
+        target = int(known_round) + 1
         with self._cv:
             done = self._cv.wait_for(
-                lambda: not self._pending and not self._applied_round,
-                timeout=120,
-            )
+                lambda: self._round >= target, timeout=120)
             if not done:
                 raise TimeoutError(
-                    "sync round incomplete after 120s — a trainer died "
-                    f"mid-round (pending: {list(self._pending)})"
+                    f"sync round {known_round} incomplete after 120s — a "
+                    f"trainer died mid-round (pending: {list(self._pending)})"
                 )
             return {"round": self._round}
 
@@ -254,10 +259,15 @@ class ParameterClient:
     def get_param(self, name: str) -> np.ndarray:
         return self._client(name).call("get_param", name)
 
-    def barrier(self, known_round: Optional[int] = None):
+    def barrier(self, known_round=None):
+        """known_round: None, an int, or a dict endpoint->round (as
+        collected from send_grad responses). Runs on the dedicated barrier
+        channel so it can't block pushes sharing the endpoint."""
         done = {}
         for ep in set(self._assignment.values()):
-            done[ep] = get_client(ep).call("barrier", known_round)
+            r = known_round.get(ep) if isinstance(known_round, dict) \
+                else known_round
+            done[ep] = get_client(ep, channel="barrier").call("barrier", r)
         return done
 
     def pull_all(self, scope=None) -> Dict[str, np.ndarray]:
@@ -273,15 +283,18 @@ class ParameterClient:
         return out
 
 
-_clients: Dict[str, RpcClient] = {}
+_clients: Dict[Tuple[str, str], RpcClient] = {}
 _clients_mu = threading.Lock()
 
 
-def get_client(endpoint: str) -> RpcClient:
-    """Process-wide client cache, one connection per endpoint (the
-    reference's grpc channel cache)."""
+def get_client(endpoint: str, channel: str = "data") -> RpcClient:
+    """Process-wide client cache, one connection per (endpoint, channel)
+    (the reference's grpc channel cache). Blocking calls (barrier) use
+    their own channel so they can't starve data-plane pushes that share
+    the endpoint."""
     with _clients_mu:
-        c = _clients.get(endpoint)
+        key = (endpoint, channel)
+        c = _clients.get(key)
         if c is None:
-            c = _clients[endpoint] = RpcClient(endpoint)
+            c = _clients[key] = RpcClient(endpoint)
         return c

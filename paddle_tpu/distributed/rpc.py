@@ -83,8 +83,16 @@ def read_frame(rfile, max_frame: int = MAX_FRAME) -> Optional[dict]:
     return json.loads(body.decode("utf-8"))
 
 
-def write_frame(wfile, obj: dict):
+def write_frame(wfile, obj: dict, max_frame: int = MAX_FRAME):
     out = json.dumps(obj).encode("utf-8")
+    if len(out) > max_frame:
+        # fail HERE with the cause — the receiver would just drop the
+        # connection, and the sender would retry the same oversized
+        # payload forever behind an opaque ConnectionError
+        raise IOError(
+            f"frame of {len(out)} bytes exceeds the {max_frame}-byte cap "
+            "(tensor too large for one RPC — shard it)"
+        )
     wfile.write(struct.pack("<I", len(out)) + out)
     wfile.flush()
 
@@ -104,7 +112,14 @@ class RpcServer:
             def handle(self):
                 try:
                     while True:
-                        req = read_frame(self.rfile)
+                        try:
+                            req = read_frame(self.rfile)
+                        except json.JSONDecodeError as e:
+                            # malformed but well-framed: report, keep serving
+                            write_frame(self.wfile,
+                                        {"ok": False,
+                                         "error": f"bad frame: {e}"})
+                            continue
                         if req is None:
                             return
                         try:

@@ -183,9 +183,10 @@ class DistributeTranspiler:
         the running ParameterServer; its .address is what trainers dial."""
         from ..distributed.param_server import ParameterServer
 
+        pp = self.get_pserver_program(endpoint)
         ps = ParameterServer(
-            self.get_pserver_program(endpoint),
-            self.get_startup_program(endpoint),
+            pp,
+            self.get_startup_program(endpoint, pp),
             trainers=self.trainers,
             sync_mode=self.sync_mode if sync_mode is None else sync_mode,
         )

@@ -240,15 +240,20 @@ def _run_recv_ops(recv_ops, scope: Scope):
 
 def _run_send_ops(send_ops, values: Dict[str, Any]):
     """Push computed gradients to their pservers AFTER the step (reference
-    send_op.cc AsyncSendVariable; send_barrier_op for sync rounds)."""
+    send_op.cc AsyncSendVariable; send_barrier_op for sync rounds). The
+    barrier waits on the round number the pushes were assigned to, over a
+    DEDICATED connection — on the shared channel a blocking barrier would
+    starve other trainer threads' pushes to the same endpoint."""
     from .selected_rows import is_selected_rows
     from ..distributed.param_server import get_client
 
+    push_round: Dict[str, int] = {}  # endpoint -> round of this step's sends
     for op in send_ops:
         attrs = op.desc.attrs
         if op.desc.type == "send_barrier":
             for ep in attrs.get("endpoints", []):
-                get_client(ep).call("barrier", attrs.get("known_round"))
+                get_client(ep, channel="barrier").call(
+                    "barrier", push_round.get(ep))
             continue
         eps = attrs.get("endpoints", {})
         params = attrs.get("params", {})
@@ -257,8 +262,11 @@ def _run_send_ops(send_ops, values: Dict[str, Any]):
             v = values[gname]
             if not is_selected_rows(v):
                 v = np.asarray(v)
-            get_client(eps[gname]).call(
+            resp = get_client(eps[gname]).call(
                 "push_grad", params.get(gname, gname), v, trainer_id)
+            ep = eps[gname]
+            if ep not in push_round and isinstance(resp, dict):
+                push_round[ep] = resp.get("round")
 
 
 def _conform_slot(block, name: str, slot):

@@ -35,6 +35,24 @@ def _as_name(v) -> str:
     return v.name if isinstance(v, Variable) else str(v)
 
 
+def _spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh includes devices of OTHER processes (multi-host:
+    one SPMD program over DCN, reference capability = the trainer fleet)."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def _global_state_put(mesh: Mesh, arr, spec):
+    """Place state every process holds IN FULL onto a cross-process mesh:
+    each process contributes the shards its local devices own (params are
+    replicated or plan-sharded; either way the full value is available
+    host-side, so indexing out the local piece is exact)."""
+    sharding = NamedSharding(mesh, spec)
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
 class ParallelExecutor:
     def __init__(
         self,
@@ -115,10 +133,30 @@ class ParallelExecutor:
                 context="ParallelExecutor",
             )
 
+        multiproc = _spans_processes(mesh)
         feed_arrays = {}
         for k, v in feed.items():
             arr = np.asarray(v)
             spec = self._plan.feed_spec(arr.ndim)
+            if multiproc:
+                # each process feeds its LOCAL batch shard; jax assembles
+                # the global array (global batch = concat over processes —
+                # the reference trainer fleet's per-trainer minibatches)
+                try:
+                    feed_arrays[k] = jax.make_array_from_process_local_data(
+                        NamedSharding(mesh, spec), arr)
+                except (ValueError, TypeError) as e:
+                    # replicating a per-process-different feed would be
+                    # silently wrong — fail with the fix spelled out
+                    throw_on(
+                        "feed '%s' local shape %s does not shard over the "
+                        "multi-host mesh %s (%s) — pad the local batch or "
+                        "use drop_last so every process feeds an equal, "
+                        "divisible shard",
+                        k, tuple(arr.shape), dict(axis_sizes), e,
+                        context="ParallelExecutor",
+                    )
+                continue
             if not (arr.shape and self._plan.batch_axis
                     and _divisible(arr.shape, spec)):
                 # indivisible feeds stay replicated (reference PE pads/splits)
@@ -161,6 +199,11 @@ class ParallelExecutor:
         jfn, ro_names, rw_names, state_out = entry
 
         def _place(name, x):
+            if multiproc:
+                if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                    return x  # already a global array from a prior step
+                return _global_state_put(
+                    mesh, x, _resolve_spec(name, np.shape(x)))
             x = jnp.asarray(x)
             target = NamedSharding(mesh, _resolve_spec(name, x.shape))
             if getattr(x, "sharding", None) == target:
