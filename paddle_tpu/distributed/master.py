@@ -389,8 +389,11 @@ class MasterClient:
                     self._sock = socket.create_connection(addr)
                     self._rfile = self._sock.makefile("rb")
                     self._wfile = self._sock.makefile("wb")
+                # sender-side cap must match the SERVER's read cap, or an
+                # oversized request dies as an opaque dropped connection
                 write_frame(self._wfile,
-                            {"method": method, "args": list(args)})
+                            {"method": method, "args": list(args)},
+                            max_frame=MasterService._MAX_FRAME)
                 resp = read_frame(self._rfile)
                 if resp is None:
                     raise ConnectionError(

@@ -245,6 +245,8 @@ class ParameterClient:
         (DistributeTranspiler.param_assignment)."""
         self._assignment = dict(assignment)
         self._trainer_id = int(trainer_id)
+        # endpoint -> round of this step's first send, consumed by barrier()
+        self._send_round: Dict[str, int] = {}
 
     def _client(self, name: str) -> RpcClient:
         ep = self._assignment.get(name)
@@ -253,21 +255,32 @@ class ParameterClient:
         return get_client(ep)
 
     def send_grad(self, name: str, grad):
-        return self._client(name).call("push_grad", name, grad,
+        resp = self._client(name).call("push_grad", name, grad,
                                        self._trainer_id)
+        ep = self._assignment[name]
+        if isinstance(resp, dict) and ep not in self._send_round:
+            # remember which round this step's pushes joined, so a bare
+            # barrier() can wait on the right round number
+            self._send_round[ep] = resp.get("round")
+        return resp
 
     def get_param(self, name: str) -> np.ndarray:
         return self._client(name).call("get_param", name)
 
     def barrier(self, known_round=None):
-        """known_round: None, an int, or a dict endpoint->round (as
-        collected from send_grad responses). Runs on the dedicated barrier
-        channel so it can't block pushes sharing the endpoint."""
+        """Wait until the round this client's sends joined has fully
+        applied (reference send_barrier_op). known_round: None (use the
+        rounds recorded by send_grad since the last barrier — the normal
+        send/barrier/recv flow), an int, or a dict endpoint->round. Runs on
+        the dedicated barrier channel so it can't block pushes sharing the
+        endpoint."""
+        rounds = self._send_round if known_round is None else known_round
         done = {}
         for ep in set(self._assignment.values()):
-            r = known_round.get(ep) if isinstance(known_round, dict) \
-                else known_round
+            r = rounds.get(ep) if isinstance(rounds, dict) else rounds
             done[ep] = get_client(ep, channel="barrier").call("barrier", r)
+        if known_round is None:
+            self._send_round = {}
         return done
 
     def pull_all(self, scope=None) -> Dict[str, np.ndarray]:

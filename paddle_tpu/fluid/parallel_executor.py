@@ -230,10 +230,20 @@ class ParallelExecutor:
 
     def bcast_params(self):
         """Parity with reference bcast_params (parallel_executor.py:149):
-        re-replicate scope params over the mesh."""
+        re-replicate scope params over the mesh (cross-process meshes go
+        through the local-shard contribution path, like run())."""
         mesh = self._mesh
+        multiproc = _spans_processes(mesh)
         for name in list(self._scope.var_names()):
             v = self._scope.find_var(name)
+            if multiproc:
+                if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                    continue  # already global
+                self._scope.set_var(
+                    name,
+                    _global_state_put(mesh, v, P(*([None] * np.ndim(v)))),
+                )
+                continue
             arr = jnp.asarray(v)
             self._scope.set_var(
                 name,
