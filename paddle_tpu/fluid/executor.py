@@ -39,7 +39,8 @@ from .readers import READER_CREATE_OP_TYPES, create_host_reader
 # pserver transport ops (send/recv/send_barrier run as host RPC around the
 # jitted step — reference send_op.cc/recv_op.cc/send_barrier_op.cc)
 _SKIP_OP_TYPES = (
-    {"feed", "fetch", "read", "send", "recv", "send_barrier"}
+    {"feed", "fetch", "read", "send", "recv", "send_barrier", "send_vars",
+     "save", "save_combine", "load", "load_combine"}
     | set(READER_CREATE_OP_TYPES)
 )
 
@@ -216,8 +217,10 @@ def _dist_host_ops(block):
     program = block.program
     cached = getattr(program, "_dist_ops_cache", None)
     if cached is None or cached[0] != program._version:
+        # send_vars is the reference's async-send variant (send_vars_op.cc)
+        # — same transport here, no barrier follows it
         sends = [op for op in block.ops
-                 if op.desc.type in ("send", "send_barrier")]
+                 if op.desc.type in ("send", "send_vars", "send_barrier")]
         recvs = [op for op in block.ops if op.desc.type == "recv"]
         program._dist_ops_cache = cached = (program._version, sends, recvs)
     return cached[1], cached[2]
@@ -267,6 +270,95 @@ def _run_send_ops(send_ops, values: Dict[str, Any]):
             ep = eps[gname]
             if ep not in push_round and isinstance(resp, dict):
                 push_round[ep] = resp.get("round")
+
+
+_IO_OP_TYPES = frozenset({"save", "save_combine", "load", "load_combine"})
+
+
+def _io_path(op_type: str, path: str) -> str:
+    """The actual on-disk path: numpy appends .npy/.npz when missing, so
+    normalize once here — save's overwrite check, load's lookup, and the
+    write all agree for any attr spelling."""
+    if op_type in ("save", "load"):
+        return path if path.endswith(".npy") else path + ".npy"
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _split_io_host_ops(block):
+    """(pre ops, post ops): io ops before the first device op run BEFORE
+    the jitted step (loads feeding it); io ops after the last device op run
+    AFTER it (saves of updated state — the reference's in-order C++
+    executor gives save_op post-update values, so must we). An io op
+    sandwiched BETWEEN device ops has no faithful slot in the
+    one-XLA-program execution model: reject it loudly instead of silently
+    saving stale values."""
+    program = block.program
+    cached = getattr(program, "_io_ops_cache", None)
+    if cached is None or cached[0] != program._version:
+        first_dev = last_dev = None
+        for i, op in enumerate(block.ops):
+            if op.desc.type not in _SKIP_OP_TYPES:
+                if first_dev is None:
+                    first_dev = i
+                last_dev = i
+        pre, post = [], []
+        for i, op in enumerate(block.ops):
+            if op.desc.type not in _IO_OP_TYPES:
+                continue
+            if first_dev is None or i < first_dev:
+                pre.append(op)
+            elif i > last_dev:
+                post.append(op)
+            else:
+                raise RuntimeError(
+                    f"{op.desc.type} op at position {i} sits between device "
+                    "ops — the block lowers to ONE XLA computation, so "
+                    "host-side save/load can only run before or after it; "
+                    "move the op to the program's edge or a separate program"
+                )
+        program._io_ops_cache = cached = (program._version, pre, post)
+    return cached[1], cached[2]
+
+
+def _run_io_host_ops(ops, scope: Scope):
+    """Execute save/load host ops (reference operators/save_op.cc,
+    load_combine_op.cc). Formats match io.py: .npy per var, .npz combined.
+    All save inputs are validated BEFORE any file is written, so a missing
+    var can't leave a partial checkpoint on disk."""
+    if not ops:
+        return
+    import os
+
+    for op in ops:
+        if op.desc.type in ("save", "save_combine"):
+            for n in op.desc.inputs.get("X", []):
+                if scope.find_var(n) is None:
+                    raise RuntimeError(
+                        f"save op: var '{n}' not found in scope — nothing "
+                        "was written")
+    for op in ops:
+        t = op.desc.type
+        path = _io_path(t, str(op.desc.attrs["file_path"]))
+        if t in ("save", "save_combine"):
+            names = op.desc.inputs.get("X", [])
+            arrays = {n: np.asarray(scope.find_var(n)) for n in names}
+            if not op.desc.attrs.get("overwrite", True) and \
+                    os.path.exists(path):
+                raise RuntimeError(f"save op: '{path}' exists and "
+                                   "overwrite=False")
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            if t == "save":
+                np.save(path, arrays[names[0]])
+            else:
+                np.savez(path, **arrays)
+        else:
+            names = op.desc.outputs.get("Out", [])
+            if t == "load":
+                scope.set_var(names[0], jnp.asarray(np.load(path)))
+            else:
+                payload = np.load(path)
+                for n in names:
+                    scope.set_var(n, jnp.asarray(payload[n]))
 
 
 def _conform_slot(block, name: str, slot):
@@ -372,6 +464,8 @@ class Executor:
         fetch_list = fetch_list or []
         scope = scope or global_scope()
 
+        io_pre, io_post = _split_io_host_ops(program.global_block())
+        _run_io_host_ops(io_pre, scope)
         reader_feeds = _run_reader_host_ops(program.global_block(), scope)
         feed_arrays = {
             k: _as_feed(v) for k, v in {**feed, **reader_feeds}.items()
@@ -407,6 +501,9 @@ class Executor:
             sent_vals = dict(zip(fetch_names + extra_fetches, fetches))
             _run_send_ops(send_ops, sent_vals)
             fetches = fetches[:len(fetch_names)]
+        # trailing save ops see the POST-step scope (reference in-order
+        # save_op semantics: a train+checkpoint program saves updated state)
+        _run_io_host_ops(io_post, scope)
         if FLAGS["check_nan_inf"]:
             # reference FLAGS_check_nan_inf sweep (executor.cc:352-360)
             from .selected_rows import is_selected_rows

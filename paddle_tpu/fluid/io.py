@@ -48,6 +48,53 @@ def is_parameter(var: Variable) -> bool:
     return isinstance(var, Parameter)
 
 
+def _build_save_program(var_names, dirname, filename=None) -> Program:
+    """Emit a program of save / save_combine ops (reference io.py:66,145:
+    persistence IS a program — it can be serialized and shipped to another
+    process, which is why save_op exists as an op and not a helper)."""
+    prog = Program()
+    block = prog.global_block()
+    if filename is not None:
+        for n in var_names:
+            block.create_var(name=n, shape=None, persistable=True)
+        block.append_op(
+            "save_combine", inputs={"X": list(var_names)}, outputs={},
+            attrs={"file_path": os.path.join(dirname, _norm_npz(filename))},
+        )
+    else:
+        for n in var_names:
+            block.create_var(name=n, shape=None, persistable=True)
+            block.append_op(
+                "save", inputs={"X": [n]}, outputs={},
+                attrs={"file_path": os.path.join(
+                    dirname, n.replace("/", "__"))},
+            )
+    return prog
+
+
+def _build_load_program(var_names, dirname, filename=None) -> Program:
+    """Emit the inverse load / load_combine program (reference
+    load_combine_op.cc; load_persistables)."""
+    prog = Program()
+    block = prog.global_block()
+    if filename is not None:
+        for n in var_names:
+            block.create_var(name=n, shape=None, persistable=True)
+        block.append_op(
+            "load_combine", inputs={}, outputs={"Out": list(var_names)},
+            attrs={"file_path": os.path.join(dirname, _norm_npz(filename))},
+        )
+    else:
+        for n in var_names:
+            block.create_var(name=n, shape=None, persistable=True)
+            block.append_op(
+                "load", inputs={}, outputs={"Out": [n]},
+                attrs={"file_path": os.path.join(
+                    dirname, n.replace("/", "__") + ".npy")},
+            )
+    return prog
+
+
 def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
               filename=None, scope: Optional[Scope] = None):
     main_program = main_program or default_main_program()
@@ -55,18 +102,9 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
     if vars is None:
         vars = _collect(main_program, predicate or is_persistable)
     os.makedirs(dirname, exist_ok=True)
-    arrays = {}
-    for v in vars:
-        name = v.name if isinstance(v, Variable) else str(v)
-        val = scope.find_var(name)
-        if val is None:
-            raise RuntimeError(f"var '{name}' not found in scope while saving")
-        arrays[name] = np.asarray(val)
-    if filename is not None:
-        np.savez(os.path.join(dirname, _norm_npz(filename)), **arrays)
-    else:
-        for name, arr in arrays.items():
-            np.save(os.path.join(dirname, name.replace("/", "__")), arr)
+    names = [v.name if isinstance(v, Variable) else str(v) for v in vars]
+    prog = _build_save_program(names, dirname, filename)
+    (executor or Executor()).run(prog, scope=scope)
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
@@ -81,22 +119,13 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
               filename=None, scope: Optional[Scope] = None):
-    import jax.numpy as jnp
-
     main_program = main_program or default_main_program()
     scope = scope or global_scope()
     if vars is None:
         vars = _collect(main_program, predicate or is_persistable)
-    if filename is not None:
-        payload = np.load(os.path.join(dirname, _norm_npz(filename)))
-        for v in vars:
-            name = v.name if isinstance(v, Variable) else str(v)
-            scope.set_var(name, jnp.asarray(payload[name]))
-        return
-    for v in vars:
-        name = v.name if isinstance(v, Variable) else str(v)
-        path = os.path.join(dirname, name.replace("/", "__") + ".npy")
-        scope.set_var(name, jnp.asarray(np.load(path)))
+    names = [v.name if isinstance(v, Variable) else str(v) for v in vars]
+    prog = _build_load_program(names, dirname, filename)
+    (executor or Executor()).run(prog, scope=scope)
 
 
 def load_params(executor, dirname, main_program=None, filename=None):

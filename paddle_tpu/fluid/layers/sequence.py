@@ -20,7 +20,7 @@ __all__ = [
     "dynamic_lstm", "dynamic_gru", "sequence_pool", "sequence_conv",
     "sequence_expand", "sequence_first_step", "sequence_last_step",
     "sequence_softmax", "sequence_reshape", "sequence_concat", "seq_lengths_of",
-    "linear_chain_crf", "crf_decoding",
+    "linear_chain_crf", "crf_decoding", "lod_reset",
     "gru_unit", "sequence_mask", "batch_gather", "beam_search",
     "beam_search_decode",
 ]
@@ -383,3 +383,37 @@ def beam_search_decode(ids, scores, parents, beam_size=None, end_id=0):
         attrs={"end_id": int(end_id)},
     )
     return sent_ids, sent_scores
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Repartition x's token stream under new sequence boundaries
+    (reference layers lod_reset -> lod_reset_op.cc): boundaries come from
+    `y`'s lengths or the static offset vector `target_lod`. Returns the
+    re-padded tensor; its lengths companion is `<out>@LEN`."""
+    if y is None and target_lod is None:
+        raise ValueError("lod_reset requires y or target_lod")
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_lens = helper.main_program.current_block().create_var(
+        name=out.name + LEN_SUFFIX, shape=[-1], dtype="int32",
+        stop_gradient=True, persistable=False,
+    )
+    inputs = {"X": [x]}
+    x_lens = seq_lengths_of(x)
+    if x_lens is not None:
+        inputs["XLengths"] = [x_lens]
+    attrs = {}
+    if y is not None:
+        inputs["Y"] = [y]
+        y_lens = seq_lengths_of(y)
+        if y_lens is None:
+            raise ValueError(f"'{y.name}' has no lengths companion")
+        inputs["YLengths"] = [y_lens]
+    else:
+        attrs["target_lod"] = [int(v) for v in target_lod]
+    helper.append_op(
+        type="lod_reset", inputs=inputs,
+        outputs={"Out": [out], "OutLengths": [out_lens]}, attrs=attrs,
+    )
+    out._seq_lengths = out_lens
+    return out

@@ -27,6 +27,8 @@ _unary("sqrt", lambda x, a: jnp.sqrt(x))
 _unary("abs", lambda x, a: jnp.abs(x))
 _unary("ceil", lambda x, a: jnp.ceil(x))
 _unary("floor", lambda x, a: jnp.floor(x))
+_unary("cos", lambda x, a: jnp.cos(x))
+_unary("sin", lambda x, a: jnp.sin(x))
 _unary("round", lambda x, a: jnp.round(x))
 _unary("reciprocal", lambda x, a: 1.0 / x)
 _unary("log", lambda x, a: jnp.log(x))

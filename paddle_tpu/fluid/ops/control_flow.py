@@ -294,6 +294,12 @@ def array_length(ctx, ins, attrs):
     return {"Out": jnp.asarray([one(ins, "X").shape[0]], dtype=jnp.int64)}
 
 
+# the reference registers this op under "lod_array_length"
+register_op("lod_array_length", no_grad=("X",),
+            ref="paddle/fluid/operators/lod_array_length_op.cc")(
+    lambda ctx, ins, attrs: array_length(ctx, ins, attrs))
+
+
 @register_op("slice",
              ref="paddle/fluid/operators (era: crop/sequence_slice family)")
 def slice_op(ctx, ins, attrs):

@@ -111,6 +111,41 @@ def conv2d_transpose(ctx, ins, attrs):
     return {"Output": out}
 
 
+@register_op("conv3d_transpose",
+             ref="paddle/fluid/operators/conv_transpose_op.cc")
+def conv3d_transpose(ctx, ins, attrs):
+    """3d transposed conv (the reference registers conv2d_transpose and
+    conv3d_transpose from one file) — same adjoint construction as the 2d
+    emitter, one more spatial dim."""
+    x, w = one(ins, "Input"), one(ins, "Filter")
+    x, w, restore = amp_operands(x, w)
+    strides = _pair(attrs.get("strides", [1, 1, 1]), 3)
+    paddings = _pair(attrs.get("paddings", [0, 0, 0]), 3)
+    dilations = _pair(attrs.get("dilations", [1, 1, 1]), 3)
+    groups = int(attrs.get("groups", 1) or 1)
+    if groups > 1:
+        in_c = w.shape[0]
+        wg = w.reshape(groups, in_c // groups, *w.shape[1:])
+        w = jnp.concatenate([wg[i] for i in range(groups)], axis=1)
+    w_flipped = jnp.flip(w, axis=(2, 3, 4))
+    out = jax.lax.conv_general_dilated(
+        x, w_flipped,
+        window_strides=[1, 1, 1],
+        padding=[
+            (dilations[d] * (w.shape[2 + d] - 1) - paddings[d],
+             dilations[d] * (w.shape[2 + d] - 1) - paddings[d])
+            for d in range(3)
+        ],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+    )
+    if restore is not None:
+        out = out.astype(restore)
+    return {"Output": out}
+
+
 def _ceil_extra(dim, k, s, p):
     """Extra hi-side padding so the window count matches ceil mode
     (reference pool_op.cc PoolOutputSize with ceil_mode: one more output

@@ -342,3 +342,64 @@ def reorder_lod_tensor_by_rank(ctx, ins, attrs):
     x = one(ins, "X")
     rank = one(ins, "RankTable").astype(jnp.int32)
     return {"Out": jnp.take(x, rank, axis=0)}
+
+
+@register_op("lod_reset", no_grad=("XLengths", "Y", "YLengths"),
+             ref="paddle/fluid/operators/lod_reset_op.cc")
+def lod_reset(ctx, ins, attrs):
+    """Repartition a token stream under new sequence boundaries.
+
+    The reference reinterprets a LoD tensor's flat rows under a new offset
+    vector (from attr `target_lod` or input Y's lod). Padded+lengths
+    equivalent: X's valid tokens are flattened in order, then re-chunked
+    into the target partition and re-padded. X may be dense ([total, ...]
+    lod_level 0, no XLengths) or padded+lengths; the target comes from the
+    static `target_lod` offsets or from Y (padded shape) + YLengths."""
+    x = one(ins, "X")
+    in_lens = (ins.get("XLengths") or [None])[0]
+    y = (ins.get("Y") or [None])[0]
+    y_lens = (ins.get("YLengths") or [None])[0]
+    target = attrs.get("target_lod")
+
+    # 1) flat token stream (bound = total slots; valid tokens lead)
+    if in_lens is None:
+        flat = x if x.ndim >= 2 else x[:, None]
+        cap = flat.shape[0]
+    else:
+        N, T = x.shape[0], x.shape[1]
+        cap = N * T
+        item = x.shape[2:]
+        starts = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(in_lens.astype(jnp.int32))[:-1]])
+        t = jnp.arange(T, dtype=jnp.int32)[None, :]
+        dest = starts[:, None] + t
+        dest = jnp.where(t < in_lens[:, None].astype(jnp.int32), dest, cap)
+        flat = jnp.zeros((cap,) + item, x.dtype)
+        flat = flat.at[dest.reshape(-1)].set(
+            x.reshape((cap,) + item), mode="drop")
+
+    # 2) target partition
+    if target is not None:
+        import numpy as _np
+
+        lens_np = _np.diff(_np.asarray(target, dtype=_np.int64))
+        new_lens = jnp.asarray(lens_np, jnp.int32)
+        out_n, out_t = int(lens_np.shape[0]), int(lens_np.max(initial=1))
+    elif y_lens is not None:
+        new_lens = y_lens.astype(jnp.int32)
+        out_n = y_lens.shape[0]
+        out_t = y.shape[1] if y is not None and y.ndim >= 2 else x.shape[1]
+    else:
+        raise ValueError("lod_reset needs target_lod or Y")
+
+    # 3) gather into the new padding
+    new_starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(new_lens)[:-1]])
+    u = jnp.arange(out_t, dtype=jnp.int32)[None, :]
+    src = jnp.clip(new_starts[:, None] + u, 0, cap - 1)
+    gathered = flat[src.reshape(-1)].reshape((out_n, out_t) + flat.shape[1:])
+    mask = (u < new_lens[:, None]).reshape(
+        (out_n, out_t) + (1,) * (gathered.ndim - 2))
+    out = gathered * mask.astype(gathered.dtype)
+    return {"Out": out, "OutLengths": new_lens}

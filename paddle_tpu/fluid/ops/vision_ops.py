@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..registry import register_op
 from .common import one
@@ -89,17 +90,19 @@ def pool3d(ctx, ins, attrs):
 
 
 def _max_pool_with_index(x, ksize, strides, paddings):
-    """Returns (pooled, flat-index-into-HxW). Index computed by reducing
+    """Returns (pooled, flat index into the spatial dims). Works for any
+    spatial rank ([N, C, *spatial]); index computed by reducing
     (value, position) pairs — the reference's CPU kernel records the argmax
     position the same way, serially."""
-    N, C, H, W = x.shape
-    pos = jnp.broadcast_to(
-        (jnp.arange(H)[:, None] * W + jnp.arange(W)[None, :]).astype(jnp.int32),
-        (N, C, H, W))
-    window = (1, 1, ksize[0], ksize[1])
-    wstrides = (1, 1, strides[0], strides[1])
-    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]),
-            (paddings[1], paddings[1]))
+    spatial = x.shape[2:]
+    nd = len(spatial)
+    # flat position grid over the spatial dims (row-major)
+    pos = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(spatial)
+    pos = jnp.broadcast_to(pos, x.shape)
+    window = (1, 1) + tuple(ksize[:nd])
+    wstrides = (1, 1) + tuple(strides[:nd])
+    pads = ((0, 0), (0, 0)) + tuple(
+        (paddings[d], paddings[d]) for d in range(nd))
 
     def reducer(a, b):
         av, ai = a
@@ -123,6 +126,23 @@ def max_pool2d_with_index(ctx, ins, attrs):
         ksize = [x.shape[2], x.shape[3]]
         paddings = [0, 0]
         strides = [1, 1]
+    vals, idx = _max_pool_with_index(x, ksize, strides, paddings)
+    return {"Out": vals, "Mask": idx}
+
+
+@register_op("max_pool3d_with_index",
+             ref="paddle/fluid/operators/pool_with_index_op.cc")
+def max_pool3d_with_index(ctx, ins, attrs):
+    """3d argmax pooling (the reference's pool_with_index_op registers both
+    ranks); Mask holds flat D*H*W positions."""
+    x = one(ins, "X")  # [N, C, D, H, W]
+    ksize = _tuple_n(attrs.get("ksize", [2, 2, 2]), 3)
+    strides = _tuple_n(attrs.get("strides", [1, 1, 1]), 3)
+    paddings = _tuple_n(attrs.get("paddings", [0, 0, 0]), 3)
+    if bool(attrs.get("global_pooling", False)):
+        ksize = list(x.shape[2:])
+        paddings = [0, 0, 0]
+        strides = [1, 1, 1]
     vals, idx = _max_pool_with_index(x, ksize, strides, paddings)
     return {"Out": vals, "Mask": idx}
 

@@ -226,3 +226,48 @@ def test_scope_guard_unwinds_orphaned_local_scopes():
     dsf.enter_local_scope()
     dsf.leave_local_scope()
     assert dsf.get_cur_scope() is root
+
+
+def test_in_graph_save_load_ops(tmp_path):
+    """save/load as OPS in a program (reference save_op.cc, load_combine_op
+    .cc): a save program can be emitted, serialized, and run anywhere —
+    including by a second process that never saw the python io.py call."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.framework import Program as P
+    from paddle_tpu.fluid.io import _build_load_program, _build_save_program
+
+    scope = fluid.Scope()
+    scope.set_var("sv.a", jnp.arange(6.0).reshape(2, 3))
+    scope.set_var("sv.b", jnp.ones((4,)) * 7)
+    save_prog = _build_save_program(["sv.a", "sv.b"], str(tmp_path))
+    types = [op.type for op in save_prog.global_block().ops]
+    assert types == ["save", "save"]
+    # desc round-trip: the save program itself is shippable
+    shipped = P.parse_from_bytes(save_prog.to_bytes())
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(shipped)
+    assert (tmp_path / "sv.a.npy").exists()
+
+    scope2 = fluid.Scope()
+    load_prog = _build_load_program(["sv.a", "sv.b"], str(tmp_path))
+    with fluid.scope_guard(scope2):
+        exe.run(load_prog)
+    np.testing.assert_allclose(np.asarray(scope2.find_var("sv.a")),
+                               np.arange(6.0).reshape(2, 3))
+    np.testing.assert_allclose(np.asarray(scope2.find_var("sv.b")),
+                               np.ones((4,)) * 7)
+
+    # combined single-file form (save_combine / load_combine)
+    cp = _build_save_program(["sv.a", "sv.b"], str(tmp_path),
+                             filename="all")
+    assert [op.type for op in cp.global_block().ops] == ["save_combine"]
+    with fluid.scope_guard(scope):
+        exe.run(cp)
+    scope3 = fluid.Scope()
+    with fluid.scope_guard(scope3):
+        exe.run(_build_load_program(["sv.a", "sv.b"], str(tmp_path),
+                                    filename="all"))
+    np.testing.assert_allclose(np.asarray(scope3.find_var("sv.b")),
+                               np.ones((4,)) * 7)

@@ -1,0 +1,91 @@
+"""Op-inventory parity gate (VERDICT r2 item 7): diff the reference's
+REGISTER_OP list (snapshot: tools/reference_op_inventory.txt, extracted from
+/root/reference/paddle/fluid/operators REGISTER_OP* macros, grad ops
+excluded) against this registry. Every gap must be on the explicit,
+justified skip-list below — an unexplained gap fails the suite."""
+import os
+
+from paddle_tpu.fluid.executor import _SKIP_OP_TYPES
+from paddle_tpu.fluid.registry import OPS
+
+SNAPSHOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "reference_op_inventory.txt")
+
+# reference op -> why it has no registry emitter here (each entry names the
+# mechanism that supplies the capability instead)
+JUSTIFIED_SKIPS = {
+    # CSP concurrency runs HOST-side: csrc/channel.cc + concurrency.py
+    # Go/Select (the reference's ops drive the same C++ channel from inside
+    # the C++ executor; our executor is a compiler client, so channel
+    # traffic cannot live inside a jitted XLA program)
+    "channel_create": "host-side csrc/channel.cc via concurrency.Channel",
+    "channel_send": "host-side csrc/channel.cc via concurrency.Channel",
+    "channel_recv": "host-side csrc/channel.cc via concurrency.Channel",
+    "channel_close": "host-side csrc/channel.cc via concurrency.Channel",
+    "go": "host-side concurrency.Go (threads), channel.cc transport",
+    "select": "host-side concurrency.Select over channel.cc",
+    # deprecated in the reference itself (cond_op.cc scatter/gather IfElse,
+    # replaced by conditional_block/ifelse which ARE registered)
+    "cond": "deprecated reference op; ifelse/conditional_block cover it",
+    # pserver service side: an op that never returns doesn't fit a jitted
+    # program — the capability is distributed/param_server.ParameterServer
+    # (start_pserver), which RUNS the pserver program behind RPC
+    "listen_and_serv": "distributed/param_server.ParameterServer service",
+    "prefetch": "sparse params pull via ParameterClient.get_param/recv op",
+    # NCCL bootstrap: XLA GSPMD inserts collectives; no communicator var
+    "nccl": "jax.distributed + GSPMD collectives replace ncclInit",
+    # LoD plumbing the padded+lengths redesign makes structural:
+    "split_lod_tensor": "ifelse emitter masks branches (no scatter/gather)",
+    "merge_lod_tensor": "ifelse emitter masks branches (no scatter/gather)",
+    "rnn_memory_helper": "dynamic_recurrent emitter carries memories",
+    "shrink_rnn_memory": "dynamic_recurrent masks finished sequences",
+    # the C++ fc op exists for MKLDNN fusion; the Python layer decomposes
+    # to mul+sum+activation on both sides (reference layers/nn.py fc:83),
+    # and XLA re-fuses the chain
+    "fc": "layers.fc decomposes to mul/sum ops; XLA fuses",
+    # structural: exec_op_descs drops the var from the trace env directly
+    # (registry.py) — freeing is a property of the lowering, not a kernel
+    "delete_var": "handled structurally in registry.exec_op_descs",
+}
+
+
+def test_reference_op_inventory_covered():
+    with open(SNAPSHOT) as f:
+        ref_ops = {ln.strip() for ln in f if ln.strip()}
+    assert len(ref_ops) > 150  # snapshot sanity
+
+    covered = set(OPS) | set(_SKIP_OP_TYPES)
+    missing = sorted(ref_ops - covered - set(JUSTIFIED_SKIPS))
+    assert not missing, (
+        f"reference ops with neither an emitter, a host-op handler, nor a "
+        f"justified skip: {missing}"
+    )
+    # skip-list hygiene: no stale entries for ops we now implement
+    stale = sorted(n for n in JUSTIFIED_SKIPS if n in OPS)
+    assert not stale, f"skip-list entries now implemented: {stale}"
+
+
+def test_snapshot_matches_reference_when_present():
+    """When the reference tree is available (builder environment), the
+    snapshot must be current."""
+    import glob
+    import re
+    import subprocess  # noqa: F401  (documentation: extraction cmd below)
+
+    ref_dir = "/root/reference/paddle/fluid/operators"
+    if not os.path.isdir(ref_dir):
+        import pytest
+
+        pytest.skip("reference tree not available")
+    pat = re.compile(
+        r"REGISTER_OP(?:ERATOR|_WITHOUT_GRADIENT|_WITH_KERNEL)?\(\s*"
+        r"([a-z0-9_]+)")
+    found = set()
+    for path in glob.glob(ref_dir + "/**/*.cc", recursive=True):
+        with open(path, errors="replace") as f:
+            for m in pat.finditer(f.read()):
+                if not m.group(1).endswith("_grad"):
+                    found.add(m.group(1))
+    with open(SNAPSHOT) as f:
+        snap = {ln.strip() for ln in f if ln.strip()}
+    assert found == snap, (sorted(found - snap), sorted(snap - found))

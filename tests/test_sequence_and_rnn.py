@@ -359,3 +359,102 @@ def test_gru_op_matches_manual_reference():
         # output (consumers rely on zeros for sums), state carries inside
         expect[:, t] = np.where(valid, h, 0.0)
     np.testing.assert_allclose(hidden, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_lod_reset_static_target():
+    """reference lod_reset_op.cc: repartition a dense token stream under a
+    static offset vector (test_lod_reset_op.py semantics, padded form)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data(name="lr_x", shape=[1], dtype="float32",
+                        append_batch_size=False)
+        x.desc.shape = [6, 1]
+        out = layers.lod_reset(x, target_lod=[0, 2, 5, 6])
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xs = np.arange(6, dtype=np.float32).reshape(6, 1)
+        o, lens = exe.run(main, feed={"lr_x": xs},
+                          fetch_list=[out, out.name + "@LEN"])
+    assert lens.tolist() == [2, 3, 1]
+    assert o.shape == (3, 3, 1)
+    np.testing.assert_allclose(o[0, :2, 0], [0, 1])
+    np.testing.assert_allclose(o[1, :3, 0], [2, 3, 4])
+    np.testing.assert_allclose(o[2, :1, 0], [5])
+    assert o[0, 2, 0] == 0  # padding
+
+
+def test_lod_reset_from_y_lengths():
+    """lod_reset taking boundaries from another sequence tensor's lod."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data(name="lr2_x", shape=[2], dtype="float32",
+                        lod_level=1)
+        y = layers.data(name="lr2_y", shape=[2], dtype="float32",
+                        lod_level=1)
+        out = layers.lod_reset(x, y=y)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # x: 2 seqs (3, 1 valid) over padding 4; stream = rows 0..3
+        xs = np.zeros((2, 4, 2), np.float32)
+        xs[0, :3] = np.arange(6).reshape(3, 2)
+        xs[1, :1] = [[6, 7]]
+        x_len = np.array([3, 1], np.int32)
+        # y: 4 seqs of length 1 over padding 2
+        ys = np.zeros((4, 2, 2), np.float32)
+        y_len = np.array([1, 1, 1, 1], np.int32)
+        o, lens = exe.run(
+            main,
+            feed={"lr2_x": xs, "lr2_x@LEN": x_len,
+                  "lr2_y": ys, "lr2_y@LEN": y_len},
+            fetch_list=[out, out.name + "@LEN"])
+    assert lens.tolist() == [1, 1, 1, 1]
+    assert o.shape == (4, 2, 2)
+    np.testing.assert_allclose(o[:, 0], [[0, 1], [2, 3], [4, 5], [6, 7]])
+    assert np.all(o[:, 1] == 0)
+
+
+def test_conv3d_transpose_and_pool3d_with_index():
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.registry import get_op_info
+    from paddle_tpu.fluid.registry import EmitCtx
+
+    ctx = EmitCtx()
+    x = jnp.asarray(np.random.RandomState(0).rand(1, 2, 3, 4, 4),
+                    dtype=jnp.float32)
+    w = jnp.asarray(np.random.RandomState(1).rand(2, 3, 2, 2, 2),
+                    dtype=jnp.float32)
+    out = get_op_info("conv3d_transpose").forward(
+        ctx, {"Input": [x], "Filter": [w]},
+        {"strides": [2, 2, 2], "paddings": [0, 0, 0]})["Output"]
+    # (D-1)*s + k = 2*2+2 = 6, 3*2+2=8
+    assert out.shape == (1, 3, 6, 8, 8)
+    # adjoint check: <conv3d(y, w), x> == <y, conv3d_transpose(x, w)>
+    y = jnp.asarray(np.random.RandomState(2).rand(1, 3, 6, 8, 8),
+                    dtype=jnp.float32)
+    import jax
+
+    # stored filter layout is [in_c, out_c, k...]; the adjoint forward
+    # conv maps out_c -> in_c channels, i.e. O=in_c, I=out_c = w as-is
+    fwd = jax.lax.conv_general_dilated(
+        y, w, (2, 2, 2), "VALID",
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    lhs = float(jnp.vdot(fwd, x))
+    rhs = float(jnp.vdot(y, out))
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-4)
+
+    p = jnp.asarray(np.random.RandomState(3).rand(1, 1, 4, 4, 4),
+                    dtype=jnp.float32)
+    r = get_op_info("max_pool3d_with_index").forward(
+        ctx, {"X": [p]}, {"ksize": [2, 2, 2], "strides": [2, 2, 2]})
+    assert r["Out"].shape == (1, 1, 2, 2, 2)
+    assert r["Mask"].shape == (1, 1, 2, 2, 2)
+    # every mask entry points at the value it selected
+    flat = np.asarray(p).reshape(-1)
+    np.testing.assert_allclose(
+        flat[np.asarray(r["Mask"]).reshape(-1)],
+        np.asarray(r["Out"]).reshape(-1))
