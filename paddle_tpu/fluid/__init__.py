@@ -28,7 +28,7 @@ from .memory_optimization_transpiler import (  # noqa: F401
     release_memory,
 )
 from .backward import append_backward, calc_gradient  # noqa: F401
-from . import debugger  # noqa: F401
+from . import debugger, graphviz, net_drawer  # noqa: F401
 from .clip import (  # noqa: F401
     ErrorClipByValue,
     GradientClipByGlobalNorm,

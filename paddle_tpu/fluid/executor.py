@@ -320,32 +320,45 @@ def _split_io_host_ops(block):
     return cached[1], cached[2]
 
 
-def _run_io_host_ops(ops, scope: Scope):
+def _run_io_host_ops(ops, scope: Scope, extra: Optional[Dict] = None):
     """Execute save/load host ops (reference operators/save_op.cc,
     load_combine_op.cc). Formats match io.py: .npy per var, .npz combined.
-    All save inputs are validated BEFORE any file is written, so a missing
-    var can't leave a partial checkpoint on disk."""
+    Every failure condition (missing var, overwrite conflict) is checked
+    BEFORE any file is written, so an abort can't leave a partial
+    checkpoint on disk. `extra` overlays values not living in scope —
+    trailing saves of non-persistable temps get them fetched out of the
+    jitted step (same mechanism as send ops)."""
     if not ops:
         return
     import os
 
+    extra = extra or {}
+
+    def lookup(n):
+        return extra[n] if n in extra else scope.find_var(n)
+
+    will_load = set()  # vars produced by earlier load ops in this group
     for op in ops:
-        if op.desc.type in ("save", "save_combine"):
-            for n in op.desc.inputs.get("X", []):
-                if scope.find_var(n) is None:
-                    raise RuntimeError(
-                        f"save op: var '{n}' not found in scope — nothing "
-                        "was written")
+        t = op.desc.type
+        if t in ("load", "load_combine"):
+            will_load.update(op.desc.outputs.get("Out", []))
+            continue
+        for n in op.desc.inputs.get("X", []):
+            if lookup(n) is None and n not in will_load:
+                raise RuntimeError(
+                    f"save op: var '{n}' not found in scope — nothing "
+                    "was written")
+        path = _io_path(t, str(op.desc.attrs["file_path"]))
+        if not op.desc.attrs.get("overwrite", True) and \
+                os.path.exists(path):
+            raise RuntimeError(f"save op: '{path}' exists and "
+                               "overwrite=False — nothing was written")
     for op in ops:
         t = op.desc.type
         path = _io_path(t, str(op.desc.attrs["file_path"]))
         if t in ("save", "save_combine"):
             names = op.desc.inputs.get("X", [])
-            arrays = {n: np.asarray(scope.find_var(n)) for n in names}
-            if not op.desc.attrs.get("overwrite", True) and \
-                    os.path.exists(path):
-                raise RuntimeError(f"save op: '{path}' exists and "
-                                   "overwrite=False")
+            arrays = {n: np.asarray(lookup(n)) for n in names}
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             if t == "save":
                 np.save(path, arrays[names[0]])
@@ -464,23 +477,50 @@ class Executor:
         fetch_list = fetch_list or []
         scope = scope or global_scope()
 
-        io_pre, io_post = _split_io_host_ops(program.global_block())
+        block = program.global_block()
+        io_pre, io_post = _split_io_host_ops(block)
         _run_io_host_ops(io_pre, scope)
-        reader_feeds = _run_reader_host_ops(program.global_block(), scope)
+        # host-only program (the io.py save/load flow): nothing to trace —
+        # skip the jit machinery entirely rather than compiling an empty
+        # XLA computation per checkpoint call
+        if not any(op.desc.type not in _SKIP_OP_TYPES for op in block.ops):
+            # readers/io still run; fetches resolve straight from the host
+            # values (a read-only program fetching its minibatch)
+            host_feeds = _run_reader_host_ops(block, scope)
+            _run_io_host_ops(io_post, scope)
+            out = []
+            for v in fetch_list or []:
+                n = _as_name(v)
+                val = host_feeds.get(n, feed.get(n, scope.find_var(n)))
+                if val is None:
+                    raise ValueError(
+                        f"fetch target '{n}' not produced — the program "
+                        "has no device ops")
+                out.append(np.asarray(val) if return_numpy else val)
+            return out
+        reader_feeds = _run_reader_host_ops(block, scope)
         feed_arrays = {
             k: _as_feed(v) for k, v in {**feed, **reader_feeds}.items()
         }
         fetch_names = tuple(_as_name(v) for v in fetch_list)
         # send ops (host-side, reference send_op.cc) transport gradient
-        # values: fetch them out of the jitted step, push after it runs
-        send_ops, recv_ops = _dist_host_ops(program.global_block())
+        # values: fetch them out of the jitted step, push after it runs.
+        # Trailing saves of non-persistable temps ride the same mechanism.
+        send_ops, recv_ops = _dist_host_ops(block)
         if recv_ops:
             _run_recv_ops(recv_ops, scope)
-        extra_fetches: Tuple[str, ...] = ()
+        want: List[str] = []
         if send_ops:
-            want = [n for op in send_ops
-                    for n in op.desc.inputs.get("X", []) if n]
-            extra_fetches = tuple(n for n in want if n not in fetch_names)
+            want += [n for op in send_ops
+                     for n in op.desc.inputs.get("X", []) if n]
+        save_want = [
+            n for op in io_post if op.desc.type in ("save", "save_combine")
+            for n in op.desc.inputs.get("X", [])
+            if n and scope.find_var(n) is None
+        ]
+        want += save_want
+        extra_fetches = tuple(dict.fromkeys(
+            n for n in want if n not in fetch_names))
         jfn, ro_names, rw_names, state_out = self._entry(
             program, feed_arrays, fetch_names + extra_fetches, scope,
             use_program_cache
@@ -497,13 +537,14 @@ class Executor:
             print(f"[benchmark] run took {(_time.perf_counter()-t0)*1000:.3f} ms")
         for n, v in new_state.items():
             scope.set_var(n, v)
+        fetched_vals = dict(zip(fetch_names + extra_fetches, fetches))
         if send_ops:
-            sent_vals = dict(zip(fetch_names + extra_fetches, fetches))
-            _run_send_ops(send_ops, sent_vals)
-            fetches = fetches[:len(fetch_names)]
+            _run_send_ops(send_ops, fetched_vals)
+        fetches = fetches[:len(fetch_names)]
         # trailing save ops see the POST-step scope (reference in-order
-        # save_op semantics: a train+checkpoint program saves updated state)
-        _run_io_host_ops(io_post, scope)
+        # save_op semantics: a train+checkpoint program saves updated
+        # state); non-persistable temps come from the fetched overlay
+        _run_io_host_ops(io_post, scope, extra=fetched_vals)
         if FLAGS["check_nan_inf"]:
             # reference FLAGS_check_nan_inf sweep (executor.cc:352-360)
             from .selected_rows import is_selected_rows

@@ -71,18 +71,19 @@ def conv3d(ctx, ins, attrs):
     return {"Output": out}
 
 
-@register_op("conv2d_transpose", ref="paddle/fluid/operators/conv_transpose_op.cc")
-def conv2d_transpose(ctx, ins, attrs):
+def _conv_transpose_nd(ins, attrs, nd: int):
+    """Shared adjoint construction for conv{2,3}d_transpose (the reference
+    registers both from conv_transpose_op.cc). Filter layout is
+    [in_c, out_c/groups, *k] (reference convention). Transposed conv =
+    dilate the input by `strides`, pad by (k-1)-p, and CORRELATE with the
+    spatially-flipped kernel (the adjoint of correlation flips); the
+    I-first rhs layout already contracts dim0 against x's channels, so no
+    I/O swap is needed."""
     x, w = one(ins, "Input"), one(ins, "Filter")
     x, w, restore = amp_operands(x, w)
-    strides = _pair(attrs.get("strides", [1, 1]))
-    paddings = _pair(attrs.get("paddings", [0, 0]))
-    dilations = _pair(attrs.get("dilations", [1, 1]))
-    # filter layout [in_c, out_c/groups, kh, kw] (reference conv_transpose
-    # convention). Transposed conv = dilate the input by `strides`, pad by
-    # (k-1)-p, and CORRELATE with the spatially-flipped kernel (the adjoint
-    # of correlation flips); IOHW already contracts dim0 against x's
-    # channels, so no I/O swap is needed.
+    strides = _pair(attrs.get("strides", [1] * nd), nd)
+    paddings = _pair(attrs.get("paddings", [0] * nd), nd)
+    dilations = _pair(attrs.get("dilations", [1] * nd), nd)
     groups = int(attrs.get("groups", 1) or 1)
     if groups > 1:
         # XLA grouped-conv rhs layout: I = in_c/groups, O = groups blocks of
@@ -91,59 +92,36 @@ def conv2d_transpose(ctx, ins, attrs):
         in_c = w.shape[0]
         wg = w.reshape(groups, in_c // groups, *w.shape[1:])
         w = jnp.concatenate([wg[i] for i in range(groups)], axis=1)
-    w_flipped = jnp.flip(w, axis=(2, 3))
+    spatial_axes = tuple(range(2, 2 + nd))
+    w_flipped = jnp.flip(w, axis=spatial_axes)
+    sp = "DHW"[-nd:]
     out = jax.lax.conv_general_dilated(
         x, w_flipped,
-        window_strides=[1, 1],
+        window_strides=[1] * nd,
         padding=[
-            (dilations[0] * (w.shape[2] - 1) - paddings[0],
-             dilations[0] * (w.shape[2] - 1) - paddings[0]),
-            (dilations[1] * (w.shape[3] - 1) - paddings[1],
-             dilations[1] * (w.shape[3] - 1) - paddings[1]),
+            (dilations[d] * (w.shape[2 + d] - 1) - paddings[d],
+             dilations[d] * (w.shape[2 + d] - 1) - paddings[d])
+            for d in range(nd)
         ],
         lhs_dilation=strides,
         rhs_dilation=dilations,
         feature_group_count=groups,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        dimension_numbers=(f"NC{sp}", f"IO{sp}", f"NC{sp}"),
     )
     if restore is not None:
         out = out.astype(restore)
     return {"Output": out}
+
+
+@register_op("conv2d_transpose", ref="paddle/fluid/operators/conv_transpose_op.cc")
+def conv2d_transpose(ctx, ins, attrs):
+    return _conv_transpose_nd(ins, attrs, 2)
 
 
 @register_op("conv3d_transpose",
              ref="paddle/fluid/operators/conv_transpose_op.cc")
 def conv3d_transpose(ctx, ins, attrs):
-    """3d transposed conv (the reference registers conv2d_transpose and
-    conv3d_transpose from one file) — same adjoint construction as the 2d
-    emitter, one more spatial dim."""
-    x, w = one(ins, "Input"), one(ins, "Filter")
-    x, w, restore = amp_operands(x, w)
-    strides = _pair(attrs.get("strides", [1, 1, 1]), 3)
-    paddings = _pair(attrs.get("paddings", [0, 0, 0]), 3)
-    dilations = _pair(attrs.get("dilations", [1, 1, 1]), 3)
-    groups = int(attrs.get("groups", 1) or 1)
-    if groups > 1:
-        in_c = w.shape[0]
-        wg = w.reshape(groups, in_c // groups, *w.shape[1:])
-        w = jnp.concatenate([wg[i] for i in range(groups)], axis=1)
-    w_flipped = jnp.flip(w, axis=(2, 3, 4))
-    out = jax.lax.conv_general_dilated(
-        x, w_flipped,
-        window_strides=[1, 1, 1],
-        padding=[
-            (dilations[d] * (w.shape[2 + d] - 1) - paddings[d],
-             dilations[d] * (w.shape[2 + d] - 1) - paddings[d])
-            for d in range(3)
-        ],
-        lhs_dilation=strides,
-        rhs_dilation=dilations,
-        feature_group_count=groups,
-        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
-    )
-    if restore is not None:
-        out = out.astype(restore)
-    return {"Output": out}
+    return _conv_transpose_nd(ins, attrs, 3)
 
 
 def _ceil_extra(dim, k, s, p):

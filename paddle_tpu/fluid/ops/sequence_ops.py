@@ -383,7 +383,15 @@ def lod_reset(ctx, ins, attrs):
     if target is not None:
         import numpy as _np
 
-        lens_np = _np.diff(_np.asarray(target, dtype=_np.int64))
+        offsets = _np.asarray(target, dtype=_np.int64)
+        lens_np = _np.diff(offsets)
+        if in_lens is None and int(offsets[-1]) != int(flat.shape[0]):
+            # static case: the reference enforces last offset == row count
+            # (lod_reset_op.cc InferShape); fabricating zero tokens would
+            # be silent corruption
+            raise ValueError(
+                f"lod_reset: target_lod ends at {int(offsets[-1])} but X "
+                f"has {int(flat.shape[0])} rows")
         new_lens = jnp.asarray(lens_np, jnp.int32)
         out_n, out_t = int(lens_np.shape[0]), int(lens_np.max(initial=1))
     elif y_lens is not None:

@@ -61,3 +61,38 @@ def test_graphviz_dump_writes_dot():
         assert 'label="print"' in dot
         with open(path) as f:
             assert f.read() == dot
+
+
+def test_graphviz_api_and_net_drawer(tmp_path):
+    """reference fluid/graphviz.py + net_drawer.py: a book-model program
+    renders to a structurally valid dot artifact."""
+    from paddle_tpu.fluid import net_drawer
+    from paddle_tpu.fluid.graphviz import Graph, GraphPreviewGenerator
+
+    # low-level API
+    g = Graph("t", rankdir="LR")
+    a = g.node("A", shape="box")
+    b = g.node("B")
+    g.edge(a, b, label="x")
+    code = g.code()
+    assert "digraph" in code and "A" in code and "->" in code
+
+    # program rendering — the recognize_digits model, like the reference's
+    # net_drawer example
+    from paddle_tpu.models import lenet
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        img = layers.data(name="nd_img", shape=[1, 28, 28], dtype="float32")
+        label = layers.data(name="nd_lbl", shape=[1], dtype="int64")
+        cost, _, _ = lenet.build(img, label)
+    dot_path = str(tmp_path / "lenet.dot")
+    gen = net_drawer.draw_graph(startup, main, dot_path=dot_path)
+    assert isinstance(gen, GraphPreviewGenerator)
+    dot = open(dot_path).read()
+    assert dot.startswith("digraph")
+    assert dot.count("->") > 20           # real dataflow, not a stub
+    assert "conv2d" in dot and "nd_img" in dot
+    assert "fillcolor" in dot             # params styled distinctly
+    # parses as balanced dot
+    assert dot.rstrip().endswith("}")
