@@ -484,9 +484,24 @@ class Executor:
         # skip the jit machinery entirely rather than compiling an empty
         # XLA computation per checkpoint call
         if not any(op.desc.type not in _SKIP_OP_TYPES for op in block.ops):
-            # readers/io still run; fetches resolve straight from the host
-            # values (a read-only program fetching its minibatch)
+            # readers/io/transport still run; fetches resolve straight from
+            # host values (a read-only program fetching its minibatch, or a
+            # recv-only parameter pull)
             host_feeds = _run_reader_host_ops(block, scope)
+            send_ops, recv_ops = _dist_host_ops(block)
+            if recv_ops:
+                _run_recv_ops(recv_ops, scope)
+            if send_ops:
+                vals = {}
+                for op in send_ops:
+                    for n in op.desc.inputs.get("X", []):
+                        v = host_feeds.get(n, feed.get(n, scope.find_var(n)))
+                        if v is None:
+                            raise RuntimeError(
+                                f"send op: var '{n}' has no value (no "
+                                "device ops produce it in this program)")
+                        vals[n] = v
+                _run_send_ops(send_ops, vals)
             _run_io_host_ops(io_post, scope)
             out = []
             for v in fetch_list or []:

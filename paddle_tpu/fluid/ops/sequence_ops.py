@@ -411,3 +411,25 @@ def lod_reset(ctx, ins, attrs):
         (out_n, out_t) + (1,) * (gathered.ndim - 2))
     out = gathered * mask.astype(gathered.dtype)
     return {"Out": out, "OutLengths": new_lens}
+
+
+@register_op("context_project",
+             ref="paddle/fluid/operators/math/context_project.h")
+def context_project(ctx, ins, attrs):
+    """Concat each timestep with its neighbours over the time axis
+    (reference math/context_project, the engine under sequence_conv and
+    the legacy context_projection): [N, T, D] -> [N, T, ctx_len*D], zero
+    padding past the ends."""
+    x = one(ins, "X")
+    ctx_len = int(attrs.get("context_length", 3))
+    start = int(attrs.get("context_start", -(ctx_len // 2)))
+    T = x.shape[1]
+    shifted = []
+    # roll+mask (like sequence_conv): correct for ANY offset magnitude,
+    # including |offset| >= T where a slice-then-pad would change T
+    for o in range(start, start + ctx_len):
+        s = jnp.roll(x, -o, axis=1)
+        t_idx = jnp.arange(T) + o
+        valid = ((t_idx >= 0) & (t_idx < T)).astype(x.dtype)[None, :, None]
+        shifted.append(s * valid)
+    return {"Out": jnp.concatenate(shifted, axis=-1)}

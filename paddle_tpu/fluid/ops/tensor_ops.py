@@ -171,7 +171,9 @@ def pad(ctx, ins, attrs):
 def crop(ctx, ins, attrs):
     x = one(ins, "X")
     offsets = [int(v) for v in attrs.get("offsets", [0] * x.ndim)]
-    shape = [int(v) for v in attrs["shape"]]
+    # -1 in a dim keeps the full remaining extent (batch-dim convention)
+    shape = [x.shape[d] - offsets[d] if int(v) == -1 else int(v)
+             for d, v in enumerate(attrs["shape"])]
     return {"Out": jax.lax.dynamic_slice(x, offsets, shape)}
 
 
@@ -394,3 +396,11 @@ def split_selected_rows(ctx, ins, attrs):
         outs.append(SelectedRows(rows=rows, value=vals, height=sec))
         start += sec
     return {"Out": outs}
+
+
+@register_op("reverse", ref="paddle/fluid/operators (reverse capability)")
+def reverse(ctx, ins, attrs):
+    axes = attrs.get("axis", [0])
+    if not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    return {"Out": jnp.flip(one(ins, "X"), axis=tuple(int(a) for a in axes))}

@@ -65,11 +65,6 @@ def embedding_layer(input, size, vocab_size=None, **kwargs):
     return _fl.embedding(input, size=[vocab_size, size], **kwargs)
 
 
-def mixed_layer(input, size, act=None, **kwargs):
-    ins = input if isinstance(input, (list, tuple)) else [input]
-    return _fl.fc(input=list(ins), size=size, act=_act_name(act))
-
-
 def classification_cost(input, label):
     return _fl.mean(_fl.cross_entropy(input=input, label=label))
 
@@ -291,3 +286,391 @@ def crf_decoding_layer(input, param_attr, label=None, **kwargs):
 
 def softmax_layer(input, **kwargs):
     return _fl.softmax(input)
+
+
+# --- helper: append a raw op through the fluid LayerHelper ----------------
+
+
+def _raw_op(op_type, inputs, attrs=None, n_outs=1, dtype=None,
+            out_slots=("Out",)):
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper(op_type)
+    first = next(iter(inputs.values()))
+    ref = first[0] if isinstance(first, (list, tuple)) else first
+    dtype = dtype or ref.dtype
+    outs = [helper.create_variable_for_type_inference(dtype)
+            for _ in range(n_outs)]
+    helper.append_op(
+        type=op_type, inputs=inputs,
+        outputs={slot: [o] for slot, o in zip(out_slots, outs)},
+        attrs=attrs or {},
+    )
+    return outs[0] if n_outs == 1 else tuple(outs)
+
+
+# --- mixed_layer projections / operators (reference
+# trainer_config_helpers/layers.py: full_matrix_projection:...,
+# identity_projection, table_projection, dotmul_projection,
+# context_projection, dotmul_operator). A projection is a deferred spec;
+# mixed_layer realizes each against its own `size` and sums them. --------
+
+
+class _Projection:
+    def __init__(self, realize):
+        self.realize = realize  # size -> Variable
+
+
+def full_matrix_projection(input, size=None, **kwargs):
+    def realize(sz):
+        # sequence inputs ([N, T, D]) project per-timestep
+        flat = 2 if input.shape is not None and len(input.shape) == 3 else 1
+        return _fl.fc(input=input, size=sz, act=None, num_flatten_dims=flat)
+
+    return _Projection(realize)
+
+
+def identity_projection(input, offset=None, **kwargs):
+    def realize(sz):
+        if offset is not None:
+            from ..fluid.layers import tensor as _t  # noqa: F401
+
+            return _raw_op("slice", {"Input": [input]},
+                           {"axes": [input.ndim - 1 if hasattr(input, "ndim")
+                                     else len(input.shape) - 1],
+                            "starts": [offset], "ends": [offset + sz]})
+        return input
+
+    return _Projection(realize)
+
+
+def table_projection(input, size=None, **kwargs):
+    t = getattr(input, "_v2_type", None)
+    vocab = t.dim if t is not None else None
+
+    def realize(sz):
+        if vocab is None:
+            raise ValueError("table_projection input needs a v2 data type")
+        return _fl.embedding(input, size=[vocab, sz])
+
+    return _Projection(realize)
+
+
+def dotmul_projection(input, **kwargs):
+    def realize(sz):
+        from ..fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper("dotmul_projection")
+        w = helper.create_parameter(
+            helper.param_attr, shape=[int(input.shape[-1])],
+            dtype=input.dtype)
+        return _fl.elementwise_mul(input, w)
+
+    return _Projection(realize)
+
+
+def context_projection(input, context_len=3, context_start=None, **kwargs):
+    """Concat each timestep with its neighbours (reference
+    context_projection -> math/context_project)."""
+    def realize(sz):
+        return _raw_op("context_project", {"X": [input]},
+                       {"context_length": context_len,
+                        "context_start": context_start
+                        if context_start is not None
+                        else -(context_len // 2)})
+
+    return _Projection(realize)
+
+
+def dotmul_operator(a, b, scale=1.0, **kwargs):
+    return _Projection(lambda sz: _fl.scale(_fl.elementwise_mul(a, b),
+                                            scale=float(scale)))
+
+
+def mixed_layer(*args, size=None, input=None, act=None, bias_attr=None,
+                **kwargs):
+    """reference mixed_layer: sum of realized projections/operators, then
+    activation. Plain Variables act as full-matrix projections. Accepted
+    call forms: mixed_layer(size=N, input=[...]) (reference kwargs),
+    mixed_layer(inputs, N), and mixed_layer(inputs, size=N) (legacy
+    positional input)."""
+    for a in args:  # positional args: ints are size, everything else input
+        if isinstance(a, int):
+            size = a
+        else:
+            input = a
+    if size is None:
+        raise TypeError("mixed_layer needs an integer size")
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    realized = []
+    for p in ins:
+        if isinstance(p, _Projection):
+            realized.append(p.realize(size))
+        else:
+            realized.append(_fl.fc(input=p, size=size, act=None)
+                            if size else p)
+    out = realized[0]
+    for r in realized[1:]:
+        out = _fl.elementwise_add(out, r)
+    name = _act_name(act)
+    if name:
+        out = getattr(_fl, name)(out)
+    return out
+
+
+# --- elementwise / arithmetic layers (reference layers.py interpolation,
+# power, sum_to_one_norm, row_l2_norm, dot_prod, out_prod, linear_comb,
+# l2_distance, clip, scale_shift, slope_intercept) ------------------------
+
+
+def interpolation_layer(input, weight, **kwargs):
+    """out = w*x + (1-w)*y with input=[x, y], per-row weight in [0,1]."""
+    x, y = input
+    wx = _fl.elementwise_mul(x, weight)
+    one_minus = _fl.scale(weight, scale=-1.0, bias=1.0)
+    wy = _fl.elementwise_mul(y, one_minus)
+    return _fl.elementwise_add(wx, wy)
+
+
+def power_layer(input, weight, **kwargs):
+    return _raw_op("elementwise_pow", {"X": [input], "Y": [weight]})
+
+
+def sum_to_one_norm_layer(input, **kwargs):
+    s = _fl.reduce_sum(input, dim=-1, keep_dim=True)
+    return _raw_op("elementwise_div", {"X": [input], "Y": [s]})
+
+
+def row_l2_norm_layer(input, **kwargs):
+    return _fl.l2_normalize(input, axis=-1)
+
+
+def dot_prod_layer(a, b, **kwargs):
+    return _fl.reduce_sum(_fl.elementwise_mul(a, b), dim=-1, keep_dim=True)
+
+
+def out_prod_layer(a, b, **kwargs):
+    """Per-row outer product flattened to [N, da*db] (reference
+    out_prod_layer)."""
+    da, db = int(a.shape[-1]), int(b.shape[-1])
+    am = _fl.reshape(a, shape=[-1, da, 1])
+    bm = _fl.reshape(b, shape=[-1, 1, db])
+    return _fl.reshape(_fl.matmul(am, bm), shape=[-1, da * db])
+
+
+def linear_comb_layer(weights, vectors, size, **kwargs):
+    """Rowwise weighted sum of `size`-dim sub-vectors (reference
+    linear_comb_layer): vectors [N, m*size] grouped by weights [N, m]."""
+    m = int(weights.shape[-1])
+    v = _fl.reshape(vectors, shape=[-1, m, size])
+    w = _fl.reshape(weights, shape=[-1, m, 1])
+    return _fl.reshape(_fl.reduce_sum(_fl.elementwise_mul(v, w), dim=1),
+                       shape=[-1, size])
+
+
+def l2_distance_layer(x, y, **kwargs):
+    return _raw_op("squared_l2_distance", {"X": [x], "Y": [y]},
+                   n_outs=2, out_slots=("Out", "sub_result"))[0]
+
+
+def clip_layer(input, min, max, **kwargs):
+    return _fl.clip(input, min=float(min), max=float(max))
+
+
+def scale_shift_layer(input, **kwargs):
+    """y = w*x + b with SCALAR learnable w, b (reference
+    scale_shift_layer)."""
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("scale_shift")
+    w = helper.create_parameter(helper.param_attr, shape=[1],
+                                dtype=input.dtype)
+    b = helper.create_parameter(helper.param_attr, shape=[1],
+                                dtype=input.dtype, is_bias=True)
+    return _fl.elementwise_add(_fl.elementwise_mul(input, w), b)
+
+
+def sum_cost(input, **kwargs):
+    return _fl.reduce_sum(input)
+
+
+# --- shape / image manipulation layers (reference repeat_layer, pad,
+# crop, rotate, resize, maxout, spp, img_cmrnorm, roi_pool, bilinear) ------
+
+
+def repeat_layer(input, num_repeats, **kwargs):
+    times = [1] * (len(input.shape) - 1) + [int(num_repeats)]
+    return _raw_op("expand", {"X": [input]}, {"expand_times": times})
+
+
+def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, **kwargs):
+    """NCHW padding (reference pad_layer pads channel/height/width)."""
+    paddings = [0, 0]
+    for p in (pad_c, pad_h, pad_w):
+        p = p or [0, 0]
+        paddings += list(p)
+    return _fl.pad(input, paddings=paddings)
+
+
+def crop_layer(input, shape=None, offsets=None, **kwargs):
+    attrs = {}
+    if shape is not None:
+        attrs["shape"] = list(shape)
+    if offsets is not None:
+        attrs["offsets"] = list(offsets)
+    return _raw_op("crop", {"X": [input]}, attrs)
+
+
+def rotate_layer(input, height, width, **kwargs):
+    """90-degree CCW rotation of each feature map (reference rotate_layer:
+    transpose H/W then reverse the new height axis)."""
+    c = int(input.shape[1]) if len(input.shape) > 3 else 1
+    x = _fl.reshape(input, shape=[-1, c, height, width])
+    t = _fl.transpose(x, perm=[0, 1, 3, 2])
+    return _raw_op("reverse", {"X": [t]}, {"axis": [2]})
+
+
+def resize_layer(input, size, **kwargs):
+    return _fl.reshape(input, shape=[-1, int(size)])
+
+
+def maxout_layer(input, groups, **kwargs):
+    return _raw_op("maxout", {"X": [input]}, {"groups": int(groups)})
+
+
+def spp_layer(input, pyramid_height, pool_type=None, **kwargs):
+    kind = pool_type.kind if isinstance(pool_type, _Pool) else (
+        pool_type or "max")
+    return _raw_op("spp", {"X": [input]},
+                   {"pyramid_height": int(pyramid_height),
+                    "pooling_type": "avg" if kind != "max" else "max"})
+
+
+def img_cmrnorm_layer(input, size=5, scale=0.0128, power=0.75, **kwargs):
+    """Local response norm across channels (reference img_cmrnorm_layer ->
+    lrn op; alpha = scale/size per the config_parser translation)."""
+    return _fl.lrn(input, n=int(size), alpha=float(scale),
+                   beta=float(power))
+
+
+def roi_pool_layer(input, rois, pooled_width, pooled_height,
+                   spatial_scale=1.0, **kwargs):
+    return _raw_op("roi_pool", {"X": [input], "ROIs": [rois]},
+                   {"pooled_height": int(pooled_height),
+                    "pooled_width": int(pooled_width),
+                    "spatial_scale": float(spatial_scale)})
+
+
+def print_layer(input, **kwargs):
+    from ..fluid.layers import tensor as _t
+
+    return _t.Print(input) if hasattr(_t, "Print") else input
+
+
+# --- sequence layers (reference seq_concat, seq_reshape, seq_slice,
+# sub_seq via slice, context window via row_conv) --------------------------
+
+
+def seq_concat_layer(a, b, **kwargs):
+    return _fl.sequence_concat([a, b])
+
+
+def seq_reshape_layer(input, reshape_size, **kwargs):
+    return _fl.sequence_reshape(input, new_dim=int(reshape_size))
+
+
+def seq_slice_layer(input, starts, ends, **kwargs):
+    length = _fl.elementwise_sub(ends, starts)
+    return _raw_op("sequence_slice",
+                   {"X": [input], "Offset": [starts], "Length": [length]})
+
+
+def row_conv_layer(input, context_len, **kwargs):
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("row_conv")
+    w = helper.create_parameter(
+        helper.param_attr, shape=[int(context_len), int(input.shape[-1])],
+        dtype=input.dtype)
+    return _raw_op("row_conv", {"X": [input], "Filter": [w]})
+
+
+# --- recurrent step layers (reference gru_step_layer, lstm_step_layer) ----
+
+
+def gru_step_layer(input, output_mem, size=None, **kwargs):
+    size = size or int(output_mem.shape[-1])
+    from ..fluid.layers import sequence as _seq
+
+    h, _, _ = _seq.gru_unit(input=input, hidden=output_mem, size=size * 3)
+    return h
+
+
+def lstm_step_layer(input, state, size=None, **kwargs):
+    """One LSTM step (reference lstm_step_layer): input carries 4*size
+    gates; state is the previous cell. Returns (hidden, new_cell)."""
+    size = size or int(state.shape[-1])
+    c, h = _raw_op("lstm_unit", {"X": [input], "C_prev": [state]},
+                   n_outs=2, out_slots=("C", "H"))
+    return h, c
+
+
+# --- cost layers ----------------------------------------------------------
+
+
+def rank_cost(left, right, label, **kwargs):
+    return _fl.mean(_raw_op("rank_loss",
+                            {"Left": [left], "Right": [right],
+                             "Label": [label]}))
+
+
+def huber_regression_cost(input, label, delta=1.0, **kwargs):
+    return _fl.mean(_raw_op("huber_loss", {"X": [input], "Y": [label]},
+                            {"delta": float(delta)}, n_outs=2,
+                            out_slots=("Out", "Residual"))[0])
+
+
+def huber_classification_cost(input, label, **kwargs):
+    """reference huber_classification_cost (modified huber on +-1
+    labels)."""
+    return _fl.mean(_raw_op("modified_huber_loss",
+                            {"X": [input], "Y": [label]}, n_outs=2,
+                            out_slots=("Out", "IntermediateVal"))[0])
+
+
+def multi_binary_label_cross_entropy(input, label, **kwargs):
+    return _fl.mean(_fl.sigmoid_cross_entropy_with_logits(x=input,
+                                                          label=label))
+
+
+def smooth_l1_cost(input, label, **kwargs):
+    return _fl.mean(_fl.smooth_l1(x=input, y=label))
+
+
+def nce_layer(input, label, num_classes, num_neg_samples=10, **kwargs):
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("nce_layer")
+    dim = int(input.shape[-1])
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[int(num_classes), dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(helper.param_attr,
+                                shape=[int(num_classes)],
+                                dtype=input.dtype, is_bias=True)
+    return _fl.mean(_raw_op(
+        "nce", {"Input": [input], "Label": [label], "Weight": [w],
+                "Bias": [b]},
+        {"num_total_classes": int(num_classes),
+         "num_neg_samples": int(num_neg_samples)},
+        n_outs=3, out_slots=("Cost", "SampleLogits", "SampleLabels"))[0])
+
+
+def ctc_layer(input, label, blank=0, **kwargs):
+    return _fl.mean(_raw_op("warpctc", {"Logits": [input],
+                                        "Label": [label]},
+                            {"blank": int(blank)},
+                            out_slots=("Loss",)))
+
+
+warp_ctc_layer = ctc_layer
