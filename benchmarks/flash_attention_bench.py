@@ -1,11 +1,19 @@
 """Flash-attention kernel vs plain-XLA attention on TPU at long sequence
-lengths (VERDICT r1 item 7: perf assertion vs the jnp path at S >= 2k).
+lengths (VERDICT r2 item 6: bf16 + tuned blocks, target >=1.5x XLA at
+S>=4096 and >=1.1x at 2048).
 
 Run on a TPU host: python benchmarks/flash_attention_bench.py
-Prints one JSON line per config with times and the speedup; exits non-zero
-if the Pallas path is slower than XLA at S >= 2048 or the grads diverge.
+For each (dtype, seq): sweeps kernel block sizes, reports the best config
+against the XLA dense path in the SAME dtype, one JSON line per (dtype,
+seq). Exits non-zero if the bf16 Pallas path loses to XLA at S >= 2048 or
+grads diverge beyond dtype tolerance.
+
+Env knobs: FLASH_SEQS (default "2048,4096"), FLASH_BLOCKS
+(default "128x128,128x256,256x128,256x256,512x256"), FLASH_DTYPES
+(default "bfloat16,float32").
 """
 import json
+import os
 import sys
 import time
 
@@ -21,13 +29,13 @@ def dense_attention_loss(q, k, v, causal):
     if causal:
         m = (jnp.arange(s.shape[2])[:, None] >= jnp.arange(s.shape[3])[None])
         s = jnp.where(m[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, v))
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, v)
+                   .astype(jnp.float32))
 
 
 def bench(fn, args, iters=20):
-    fn(*args)  # compile
-    jax.block_until_ready(fn(*args))
+    jax.block_until_ready(fn(*args))  # compile
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
@@ -44,36 +52,76 @@ def main():
         print(json.dumps({"skipped": "not on tpu"}))
         return 0
 
+    seqs = [int(s) for s in os.environ.get(
+        "FLASH_SEQS", "2048,4096").split(",")]
+    blocks = [tuple(int(x) for x in b.split("x")) for b in os.environ.get(
+        "FLASH_BLOCKS", "128x128,128x256,256x128,256x256,512x256"
+    ).split(",")]
+    dtypes = os.environ.get("FLASH_DTYPES", "bfloat16,float32").split(",")
+
     rc = 0
-    for seq in (2048, 4096):
-        b, h, d = 1, 8, 64
-        rng = np.random.RandomState(0)
-        q = jnp.asarray(rng.randn(b, seq, h, d).astype(np.float32))
+    for dtype_name in dtypes:
+        dtype = jnp.dtype(dtype_name)
+        for seq in seqs:
+            b, h, d = 1, 8, 64
+            rng = np.random.RandomState(0)
+            q = jnp.asarray(rng.randn(b, seq, h, d), dtype)
 
-        def flash_loss(q, k, v):
-            return jnp.sum(flash_attention(q, k, v, causal=True))
+            dense_g = jax.jit(jax.grad(
+                lambda q, k, v: dense_attention_loss(q, k, v, True),
+                argnums=(0, 1, 2)))
+            t_dense = bench(dense_g, (q, q, q))
 
-        flash_g = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))
-        dense_g = jax.jit(jax.grad(
-            lambda q, k, v: dense_attention_loss(q, k, v, True),
-            argnums=(0, 1, 2)))
+            best = None
+            for bq, bk in blocks:
+                def flash_loss(q, k, v, bq=bq, bk=bk):
+                    return jnp.sum(flash_attention(
+                        q, k, v, causal=True, block_q=bq, block_k=bk
+                    ).astype(jnp.float32))
 
-        t_flash = bench(flash_g, (q, q, q))
-        t_dense = bench(dense_g, (q, q, q))
-        gf = flash_g(q, q, q)
-        gd = dense_g(q, q, q)
-        max_err = max(float(jnp.max(jnp.abs(a - b_))) for a, b_ in zip(gf, gd))
-        speedup = t_dense / t_flash
-        print(json.dumps({
-            "seq": seq, "flash_ms": round(t_flash * 1e3, 3),
-            "xla_ms": round(t_dense * 1e3, 3),
-            "speedup": round(speedup, 3), "grad_max_err": max_err,
-        }))
-        if seq >= 2048 and speedup < 1.0:
-            rc = 1
+                flash_g = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))
+                try:
+                    t = bench(flash_g, (q, q, q))
+                except Exception as e:  # block too large for VMEM etc.
+                    print(f"# {dtype_name} S={seq} block {bq}x{bk}: {e}",
+                          file=sys.stderr)
+                    continue
+                if best is None or t < best[0]:
+                    best = (t, bq, bk, flash_g)
+            if best is None:
+                print(json.dumps({"dtype": dtype_name, "seq": seq,
+                                  "error": "no block config compiled"}))
+                rc = 1
+                continue
+            t_flash, bq, bk, flash_g = best
+
+            gf = flash_g(q, q, q)
+            gd = dense_g(q, q, q)
+            denom = max(float(jnp.max(jnp.abs(g.astype(jnp.float32))))
+                        for g in gd) + 1e-6
+            max_rel = max(
+                float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                      - b_.astype(jnp.float32))))
+                for a, b_ in zip(gf, gd)) / denom
+            speedup = t_dense / t_flash
+            print(json.dumps({
+                "dtype": dtype_name, "seq": seq,
+                "best_block": f"{bq}x{bk}",
+                "flash_ms": round(t_flash * 1e3, 3),
+                "xla_ms": round(t_dense * 1e3, 3),
+                "speedup": round(speedup, 3),
+                "grad_max_rel_err": round(max_rel, 5),
+                "target": 1.5 if seq >= 4096 else 1.1,
+            }))
+            tol = 0.05 if dtype == jnp.bfloat16 else 0.01
+            if max_rel > tol:
+                rc = 1
+            if dtype == jnp.bfloat16 and seq >= 2048 and speedup < 1.0:
+                rc = 1
     return rc
 
 
 if __name__ == "__main__":
-    sys.path.insert(0, ".")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     sys.exit(main())

@@ -28,7 +28,12 @@ def _round_up(x: int, m: int) -> int:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                 block_k, sk_real, precision):
-    q = q_ref[0].astype(jnp.float32)  # [bq, D]
+    # bf16 inputs stay bf16 INTO the MXU dots (f32 accumulation via
+    # preferred_element_type): native one-pass bf16 matmuls, half the VMEM
+    # per block, and half the HBM traffic for Q/K/V. Only the softmax
+    # arithmetic runs in f32. f32 inputs keep the old upcast path.
+    lowp = q_ref.dtype == jnp.bfloat16
+    q = q_ref[0] if lowp else q_ref[0].astype(jnp.float32)  # [bq, D]
     bq = q.shape[0]
     sk_pad = k_ref.shape[1]
     nk = sk_pad // block_k
@@ -37,8 +42,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     def body(kb, carry):
         m, l, acc = carry  # [bq,1], [bq,1], [bq,D]
-        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        if not lowp:
+            kblk = kblk.astype(jnp.float32)
+            vblk = vblk.astype(jnp.float32)
         s = lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -60,7 +68,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
             p = jnp.where(keep, p, 0.0)
         l_new = l * alpha + p.sum(axis=1, keepdims=True)
         pv = lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
+            # bf16 path: round P to bf16 for the second MXU pass (standard
+            # flash-attention practice; the accumulator stays f32)
+            p.astype(vblk.dtype) if lowp else p,
+            vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=precision,
         )
@@ -138,8 +149,9 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                      sk_real, precision):
     """Grid (bh, Sk/block_k): this program owns one K/V block and streams
     Q/dO/LSE/delta blocks, recomputing P per block from the saved LSE."""
-    k = k_ref[0].astype(jnp.float32)   # [bk, D]
-    v = v_ref[0].astype(jnp.float32)
+    lowp = q_ref.dtype == jnp.bfloat16  # see _fwd_kernel: bf16-native MXU
+    k = k_ref[0] if lowp else k_ref[0].astype(jnp.float32)   # [bk, D]
+    v = v_ref[0] if lowp else v_ref[0].astype(jnp.float32)
     bk = k.shape[0]
     ik = pl.program_id(1)
     sq_pad = q_ref.shape[1]
@@ -147,8 +159,11 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
     def body(qb, carry):
         dk, dv = carry  # [bk, D] each
-        qblk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        doblk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        qblk = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        doblk = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        if not lowp:
+            qblk = qblk.astype(jnp.float32)
+            doblk = doblk.astype(jnp.float32)
         lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0]      # [bq]
         delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0]  # [bq]
         s = lax.dot_general(
@@ -166,7 +181,8 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
             keep = jnp.logical_and(keep, qpos >= kpos)
         p = jnp.where(keep, p, 0.0)
         dv = dv + lax.dot_general(
-            p, doblk, (((0,), (0,)), ((), ())),
+            p.astype(doblk.dtype) if lowp else p,
+            doblk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=precision,
         )  # [bk, D]
@@ -177,7 +193,8 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         )  # [bq, bk]
         ds = p * (dp - delta[:, None])
         dk = dk + lax.dot_general(
-            ds, qblk, (((0,), (0,)), ((), ())),
+            ds.astype(qblk.dtype) if lowp else ds,
+            qblk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=precision,
         )  # [bk, D]
@@ -200,8 +217,9 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    *, scale, causal, block_k, sq_real, sk_real, precision):
     """Grid (bh, Sq/block_q): this program owns one Q block and streams
     K/V blocks (mirror of the forward's loop)."""
-    q = q_ref[0].astype(jnp.float32)    # [bq, D]
-    do = do_ref[0].astype(jnp.float32)
+    lowp = q_ref.dtype == jnp.bfloat16  # see _fwd_kernel: bf16-native MXU
+    q = q_ref[0] if lowp else q_ref[0].astype(jnp.float32)    # [bq, D]
+    do = do_ref[0] if lowp else do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, :, 0]              # [bq]
     delta = delta_ref[0, :, 0]          # [bq]
     bq = q.shape[0]
@@ -210,8 +228,11 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dq_ref,
     nk = sk_pad // block_k
 
     def body(kb, dq):
-        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        if not lowp:
+            kblk = kblk.astype(jnp.float32)
+            vblk = vblk.astype(jnp.float32)
         s = lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -231,7 +252,8 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dq_ref,
         )  # [bq, bk]
         ds = p * (dp - delta[:, None])
         return dq + lax.dot_general(
-            ds, kblk, (((1,), (0,)), ((), ())),
+            ds.astype(kblk.dtype) if lowp else ds,
+            kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=precision,
         )

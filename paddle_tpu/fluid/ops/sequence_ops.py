@@ -25,6 +25,24 @@ def _mask(lengths, T, dtype=jnp.float32):
     return (jnp.arange(T)[None, :] < lengths[:, None]).astype(dtype)
 
 
+def _context_windows(x, ctx_len, ctx_start, lengths):
+    """[N, T, D] -> [N, T, ctx_len*D]: concat each timestep with its
+    neighbours, zero past the tensor AND past each sequence's real length
+    (reference math/context_project.*). The one implementation under both
+    sequence_conv and the standalone context_project op."""
+    T = x.shape[1]
+    if lengths is not None:
+        x = x * _mask(lengths, T, x.dtype)[:, :, None]
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        shifted = jnp.roll(x, -off, axis=1)
+        t_idx = jnp.arange(T) + off
+        valid = ((t_idx >= 0) & (t_idx < T)).astype(x.dtype)[None, :, None]
+        cols.append(shifted * valid)
+    return jnp.concatenate(cols, axis=-1)
+
+
 @register_op("sequence_pool", no_grad=("Lengths",),
              ref="paddle/fluid/operators/sequence_pool_op.cc")
 def sequence_pool(ctx, ins, attrs):
@@ -67,17 +85,7 @@ def sequence_conv(ctx, ins, attrs):
     lengths = one(ins, "Lengths")
     ctx_len = int(attrs.get("contextLength", 3))
     ctx_start = int(attrs.get("contextStart", -((ctx_len - 1) // 2)))
-    N, T, D = x.shape
-    if lengths is not None:
-        x = x * _mask(lengths, T, x.dtype)[:, :, None]
-    cols = []
-    for k in range(ctx_len):
-        off = ctx_start + k
-        shifted = jnp.roll(x, -off, axis=1)
-        t_idx = jnp.arange(T) + off
-        valid = ((t_idx >= 0) & (t_idx < T)).astype(x.dtype)[None, :, None]
-        cols.append(shifted * valid)
-    ctx_mat = jnp.concatenate(cols, axis=-1)  # [N, T, ctx_len*D]
+    ctx_mat = _context_windows(x, ctx_len, ctx_start, lengths)
     out = jnp.einsum("ntd,do->nto", ctx_mat, w)
     return {"Out": out}
 
@@ -413,7 +421,7 @@ def lod_reset(ctx, ins, attrs):
     return {"Out": out, "OutLengths": new_lens}
 
 
-@register_op("context_project",
+@register_op("context_project", no_grad=("Lengths",),
              ref="paddle/fluid/operators/math/context_project.h")
 def context_project(ctx, ins, attrs):
     """Concat each timestep with its neighbours over the time axis
@@ -421,15 +429,8 @@ def context_project(ctx, ins, attrs):
     the legacy context_projection): [N, T, D] -> [N, T, ctx_len*D], zero
     padding past the ends."""
     x = one(ins, "X")
+    lengths = (ins.get("Lengths") or [None])[0]
     ctx_len = int(attrs.get("context_length", 3))
-    start = int(attrs.get("context_start", -(ctx_len // 2)))
-    T = x.shape[1]
-    shifted = []
-    # roll+mask (like sequence_conv): correct for ANY offset magnitude,
-    # including |offset| >= T where a slice-then-pad would change T
-    for o in range(start, start + ctx_len):
-        s = jnp.roll(x, -o, axis=1)
-        t_idx = jnp.arange(T) + o
-        valid = ((t_idx >= 0) & (t_idx < T)).astype(x.dtype)[None, :, None]
-        shifted.append(s * valid)
-    return {"Out": jnp.concatenate(shifted, axis=-1)}
+    # same default start as sequence_conv (one reference, one convention)
+    start = int(attrs.get("context_start", -((ctx_len - 1) // 2)))
+    return {"Out": _context_windows(x, ctx_len, start, lengths)}

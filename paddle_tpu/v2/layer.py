@@ -333,8 +333,6 @@ def full_matrix_projection(input, size=None, **kwargs):
 def identity_projection(input, offset=None, **kwargs):
     def realize(sz):
         if offset is not None:
-            from ..fluid.layers import tensor as _t  # noqa: F401
-
             return _raw_op("slice", {"Input": [input]},
                            {"axes": [input.ndim - 1 if hasattr(input, "ndim")
                                      else len(input.shape) - 1],
@@ -373,11 +371,16 @@ def context_projection(input, context_len=3, context_start=None, **kwargs):
     """Concat each timestep with its neighbours (reference
     context_projection -> math/context_project)."""
     def realize(sz):
-        return _raw_op("context_project", {"X": [input]},
-                       {"context_length": context_len,
-                        "context_start": context_start
-                        if context_start is not None
-                        else -(context_len // 2)})
+        from ..fluid.layers.sequence import seq_lengths_of
+
+        inputs = {"X": [input]}
+        lens = seq_lengths_of(input)
+        if lens is not None:
+            inputs["Lengths"] = [lens]
+        attrs = {"context_length": context_len}
+        if context_start is not None:
+            attrs["context_start"] = context_start
+        return _raw_op("context_project", inputs, attrs)
 
     return _Projection(realize)
 
@@ -549,7 +552,7 @@ def spp_layer(input, pyramid_height, pool_type=None, **kwargs):
 def img_cmrnorm_layer(input, size=5, scale=0.0128, power=0.75, **kwargs):
     """Local response norm across channels (reference img_cmrnorm_layer ->
     lrn op; alpha = scale/size per the config_parser translation)."""
-    return _fl.lrn(input, n=int(size), alpha=float(scale),
+    return _fl.lrn(input, n=int(size), alpha=float(scale) / int(size),
                    beta=float(power))
 
 

@@ -236,3 +236,51 @@ def test_flash_attention_reachable_under_parallel_executor():
             set_flags({"use_pallas_kernels": "auto"})
     np.testing.assert_allclose(np.asarray(pl_att), np.asarray(xla_att),
                                atol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_bf16_fwd_and_grads(causal):
+    """bf16-native kernel path (r3 perf pass: operands stay bf16 into the
+    MXU dots, f32 accumulation): matches the dense f32 reference to bf16
+    tolerance, forward and backward."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(11)
+    b, s, h, d = 2, 24, 2, 16
+    qf = rng.randn(b, s, h, d).astype(np.float32)
+    kf = rng.randn(b, s, h, d).astype(np.float32)
+    vf = rng.randn(b, s, h, d).astype(np.float32)
+    q, k, v = (jnp.asarray(x, jnp.bfloat16) for x in (qf, kf, vf))
+
+    out = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8,
+                          interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = dense_attention(qf, kf, vf, causal)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=0.1, atol=0.05)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=8,
+                                       block_k=8, interpret=True)
+                       .astype(jnp.float32) ** 2)
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert gq.dtype == gk.dtype == gv.dtype == jnp.bfloat16
+
+    def dense_loss(q, k, v):
+        scale = q.shape[-1] ** -0.5
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            sq = sc.shape[2]
+            m = jnp.arange(sq)[:, None] >= jnp.arange(sq)[None, :]
+            sc = jnp.where(m[None, None], sc, -jnp.inf)
+        pr = jax.nn.softmax(sc, axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", pr, v) ** 2)
+
+    rq, rk, rv = jax.grad(dense_loss, argnums=(0, 1, 2))(
+        jnp.asarray(qf), jnp.asarray(kf), jnp.asarray(vf))
+    for g, r in ((gq, rq), (gk, rk), (gv, rv)):
+        g32, r32 = np.asarray(g, np.float32), np.asarray(r)
+        denom = np.abs(r32).max() + 1e-6
+        assert np.abs(g32 - r32).max() / denom < 0.15
