@@ -1,8 +1,12 @@
 """Dataset reader creators: every reference dataset module present with the
 right sample shapes (reference python/paddle/dataset/)."""
+import os
+
 import numpy as np
 
 import paddle_tpu.dataset as ds
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _first(reader):
@@ -59,3 +63,45 @@ def test_determinism():
     a = list(ds.sentiment.train()())[:5]
     b = list(ds.sentiment.train()())[:5]
     assert a == b
+
+
+def test_synthetic_rng_is_process_stable():
+    """Synthetic fallbacks must be deterministic ACROSS processes (python's
+    salted hash() was not) — two fresh interpreters draw identical data."""
+    import subprocess
+    import sys
+
+    src = ("import sys; sys.path.insert(0, %r); "
+           "from paddle_tpu.dataset import common; "
+           "g = common.rng('mnist', 'train'); "
+           "print(g.integers(0, 1 << 30, size=4).tolist())" % REPO)
+    outs = {
+        subprocess.run([sys.executable, "-c", src], capture_output=True,
+                       text=True, check=True).stdout
+        for _ in range(2)
+    }
+    assert len(outs) == 1, outs
+
+
+def test_data_source_reports_provenance(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    assert common.data_source("mnist") == "synthetic"
+    d = tmp_path / "mnist"
+    d.mkdir()
+    # PARTIAL drop (images but no labels): the reader would still fall
+    # back to synthetic, so the report must too
+    (d / "train-images-idx3-ubyte.gz").write_bytes(b"x")
+    assert common.data_source("mnist") == "synthetic"
+    for f in ("train-labels-idx1-ubyte.gz", "t10k-images-idx3-ubyte.gz",
+              "t10k-labels-idx1-ubyte.gz"):
+        (d / f).write_bytes(b"x")
+    assert common.data_source("mnist") == "real"
+    assert common.data_source(
+        "mnist", "train-images-idx3-ubyte.gz") == "real"
+    assert common.data_source("mnist", "missing.gz") == "synthetic"
+    # unknown dataset with no declared file list: never claim real
+    (tmp_path / "mystery").mkdir()
+    (tmp_path / "mystery" / "blob").write_bytes(b"x")
+    assert common.data_source("mystery") == "synthetic"
