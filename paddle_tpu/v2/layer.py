@@ -677,3 +677,114 @@ def ctc_layer(input, label, blank=0, **kwargs):
 
 
 warp_ctc_layer = ctc_layer
+
+
+# --- recurrent_group (reference trainer_config_helpers recurrent_group +
+# memory + StaticInput — the legacy DSL's custom-RNN API, backed here by
+# fluid's DynamicRNN masked-scan lowering) ---------------------------------
+
+
+class StaticInput:
+    """Non-sequence input visible at every step (reference
+    paddle.layer.StaticInput)."""
+
+    def __init__(self, input, **kwargs):
+        self.input = input
+
+
+_current_group = None
+
+
+def memory(name=None, size=None, boot_layer=None, **kwargs):
+    """Declare a recurrent state inside a recurrent_group step (reference
+    paddle.layer.memory): returns the PREVIOUS step's value. The state the
+    step returns (single-memory form) or the returned output whose
+    position matches the memory's declaration order feeds the next step."""
+    if _current_group is None:
+        raise RuntimeError("memory() is only valid inside a "
+                           "recurrent_group step function")
+    return _current_group._declare_memory(name, size, boot_layer)
+
+
+class _GroupCtx:
+    def __init__(self, drnn):
+        self.drnn = drnn
+        self.declared = []  # pre-mem vars, in declaration order
+
+    def _declare_memory(self, name, size, boot_layer):
+        if boot_layer is not None:
+            pre = self.drnn.memory(init=boot_layer)
+        else:
+            pre = self.drnn.memory(shape=[int(size)], value=0.0)
+        self.declared.append(pre)
+        return pre
+
+
+def recurrent_group(step, input, reverse=False, **kwargs):
+    """reference recurrent_group: run `step` once per timestep over the
+    sequence input(s); memories declared via layer.memory carry state.
+    The step's outputs update the memories in declaration order (the
+    single-memory/single-output form is the reference's dominant usage);
+    extra outputs beyond the declared memories are emitted only.
+    reverse=True is not supported by the masked-scan lowering — reverse
+    the sequence with the `reverse` op (or use simple_lstm(reverse=True))
+    instead."""
+    global _current_group
+
+    if reverse:
+        raise NotImplementedError(
+            "recurrent_group(reverse=True): reverse the input sequence "
+            "instead (layers.reverse / simple_lstm(reverse=True))")
+    from ..fluid.layers.control_flow import DynamicRNN
+
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    drnn = DynamicRNN()
+    prev = _current_group
+    mismatch = None
+    with drnn.block():
+        step_args = []
+        for x in ins:
+            if isinstance(x, StaticInput):
+                step_args.append(drnn.static_input(x.input))
+            else:
+                step_args.append(drnn.step_input(x))
+        _current_group = _GroupCtx(drnn)
+        try:
+            outs = step(*step_args)
+        finally:
+            ctx, _current_group = _current_group, prev
+        outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        if ctx.declared and len(outs) < len(ctx.declared):
+            # raising here would be shadowed by DynamicRNN._complete()'s
+            # own invariant (block()'s finally) — still update what we can
+            # so the clearer error below is the one the user sees
+            mismatch = (len(outs), len(ctx.declared))
+        for mem, out in zip(ctx.declared, outs):
+            drnn.update_memory(mem, out)
+        for mem in ctx.declared[len(outs):]:
+            drnn.update_memory(mem, mem)  # satisfy the block invariant;
+            # the ValueError below is the error the user actually sees
+        drnn.output(*outs)
+    if mismatch is not None:
+        raise ValueError(
+            f"step returned {mismatch[0]} outputs but declared "
+            f"{mismatch[1]} memories — each memory updates from the "
+            "same-position output")
+    return drnn()  # DynamicRNN() unwraps a single output itself
+
+
+def recurrent_layer(input, act=None, reverse=False, **kwargs):
+    """Simple Elman recurrence (reference recurrent_layer):
+    h_t = act(x_t + W h_{t-1}) — the input carries the ALREADY-projected
+    x, so only the recurrent weight W is learned here (pair with fc_layer
+    for the input projection, as the legacy configs do)."""
+    size = int(input.shape[-1])
+    act_name = _act_name(act) or "tanh"
+
+    def step(x_t):
+        h_prev = memory(size=size)
+        rec = _fl.fc(input=h_prev, size=size, act=None)
+        h = getattr(_fl, act_name)(_fl.elementwise_add(x_t, rec))
+        return h
+
+    return recurrent_group(step=step, input=input, reverse=reverse)

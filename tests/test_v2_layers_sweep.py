@@ -302,3 +302,76 @@ def test_golden_seq_lstm_config():
     out = v2.layer.fc_layer(v2.layer.last_seq(h), size=2,
                             act=v2.layer.activation.Softmax())
     _golden_check("seq_lstm", v2.topology.Topology(out))
+
+
+def test_recurrent_group_matches_manual_rnn():
+    """recurrent_group + memory (the legacy custom-RNN API) computes the
+    same recurrence as hand-rolled numpy, with masking past each
+    sequence's length."""
+    seq = v2.layer.data(
+        name="rg_seq", type=v2.layer.data_type.dense_vector_sequence(3),
+        lod_level=1)
+
+    H = 3
+
+    def step(x_t):
+        h_prev = v2.layer.memory(size=H)
+        h = v2.layer.fc_layer(
+            [x_t, h_prev], size=H, act=v2.layer.activation.Tanh())
+        return h
+
+    out = v2.layer.recurrent_group(step=step, input=seq)
+    rng = np.random.RandomState(8)
+    xs = rng.rand(2, 4, 3).astype(np.float32)
+    lens = np.array([4, 2], np.int32)
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+        (o,) = exe.run(fluid.default_main_program(),
+                       feed={"rg_seq": xs, "rg_seq@LEN": lens},
+                       fetch_list=[out])
+        # reproduce with the trained weights: fc over [x_t, h_prev]
+        params = [np.asarray(scope.find_var(p.name))
+                  for p in fluid.default_main_program().global_block()
+                  .all_parameters()]
+    mats = [p for p in params if p.ndim == 2]
+    vecs = [p for p in params if p.ndim == 1]
+    w_x, w_h = mats[0], mats[1]
+    b = vecs[0] if vecs else 0.0
+    for n in range(2):
+        h = np.zeros(H, np.float32)
+        for t in range(4):
+            h_new = np.tanh(xs[n, t] @ w_x + h @ w_h + b)
+            if t < lens[n]:
+                h = h_new
+                np.testing.assert_allclose(o[n, t], h, rtol=1e-4,
+                                           atol=1e-5)
+            else:
+                np.testing.assert_allclose(o[n, t], 0.0, atol=1e-6)
+
+
+def test_recurrent_layer_and_static_input():
+    seq = v2.layer.data(
+        name="rl_seq", type=v2.layer.data_type.dense_vector_sequence(4),
+        lod_level=1)
+    ctxv = v2.layer.data(name="rl_ctx",
+                         type=v2.layer.data_type.dense_vector(4))
+    rl = v2.layer.recurrent_layer(seq)
+
+    def step(x_t, c):
+        h_prev = v2.layer.memory(size=4)
+        h = v2.layer.fc_layer([x_t, h_prev, c], size=4,
+                              act=v2.layer.activation.Tanh())
+        return h
+
+    rg = v2.layer.recurrent_group(
+        step=step, input=[seq, v2.layer.StaticInput(ctxv)])
+    rng = np.random.RandomState(9)
+    feeds = {"rl_seq": rng.rand(2, 3, 4).astype(np.float32),
+             "rl_seq@LEN": np.array([3, 1], np.int32),
+             "rl_ctx": rng.rand(2, 4).astype(np.float32)}
+    vals = _run([rl, rg], feeds)
+    assert vals[0].shape == (2, 3, 4)
+    assert vals[1].shape == (2, 3, 4)
+    assert all(np.isfinite(v).all() for v in vals)
