@@ -714,8 +714,13 @@ class _GroupCtx:
     def _declare_memory(self, name, size, boot_layer):
         if boot_layer is not None:
             pre = self.drnn.memory(init=boot_layer)
-        else:
+        elif size is not None:
             pre = self.drnn.memory(shape=[int(size)], value=0.0)
+        else:
+            raise ValueError(
+                "memory() requires size= or boot_layer= (the reference's "
+                "link-by-name form resolves sizes from the parsed config; "
+                "here the state width must be explicit)")
         self.declared.append(pre)
         return pre
 
@@ -749,22 +754,36 @@ def recurrent_group(step, input, reverse=False, **kwargs):
             else:
                 step_args.append(drnn.step_input(x))
         _current_group = _GroupCtx(drnn)
+        step_exc = None
         try:
             outs = step(*step_args)
+        except Exception as e:
+            # a raw raise here would be shadowed by DynamicRNN._complete()
+            # (block()'s finally asserts every memory updated) — self-link
+            # the declared state so the USER's error survives the exit
+            step_exc = e
+            outs = []
         finally:
             ctx, _current_group = _current_group, prev
+        if step_exc is not None:
+            for mem in ctx.declared:
+                drnn.update_memory(mem, mem)
+            drnn.output(*(ctx.declared or step_args[:1]))
         outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
         if ctx.declared and len(outs) < len(ctx.declared):
             # raising here would be shadowed by DynamicRNN._complete()'s
             # own invariant (block()'s finally) — still update what we can
             # so the clearer error below is the one the user sees
             mismatch = (len(outs), len(ctx.declared))
-        for mem, out in zip(ctx.declared, outs):
-            drnn.update_memory(mem, out)
-        for mem in ctx.declared[len(outs):]:
-            drnn.update_memory(mem, mem)  # satisfy the block invariant;
-            # the ValueError below is the error the user actually sees
-        drnn.output(*outs)
+        if step_exc is None:
+            for mem, out in zip(ctx.declared, outs):
+                drnn.update_memory(mem, out)
+            for mem in ctx.declared[len(outs):]:
+                drnn.update_memory(mem, mem)  # satisfy the block invariant;
+                # the ValueError below is the error the user actually sees
+            drnn.output(*outs)
+    if step_exc is not None:
+        raise step_exc
     if mismatch is not None:
         raise ValueError(
             f"step returned {mismatch[0]} outputs but declared "
@@ -779,12 +798,16 @@ def recurrent_layer(input, act=None, reverse=False, **kwargs):
     x, so only the recurrent weight W is learned here (pair with fc_layer
     for the input projection, as the legacy configs do)."""
     size = int(input.shape[-1])
-    act_name = _act_name(act) or "tanh"
+    # default act is tanh (reference recurrent_layer); an EXPLICIT
+    # Linear()/Identity() activation means no nonlinearity, not tanh
+    act_name = "tanh" if act is None else _act_name(act)
 
     def step(x_t):
         h_prev = memory(size=size)
         rec = _fl.fc(input=h_prev, size=size, act=None)
-        h = getattr(_fl, act_name)(_fl.elementwise_add(x_t, rec))
+        h = _fl.elementwise_add(x_t, rec)
+        if act_name:
+            h = getattr(_fl, act_name)(h)
         return h
 
     return recurrent_group(step=step, input=input, reverse=reverse)

@@ -319,3 +319,62 @@ def test_pserver_lr_decay_advances_once_per_round():
             assert np.abs(client.get_param(p) - before[p]).max() > 1e-6
     finally:
         ps.shutdown()
+
+
+def test_sync_two_trainers_through_executor_ops():
+    """Two trainer THREADS run sync-mode send/recv/send_barrier programs
+    (get_trainer_program(send_recv=True)) against one pserver: rounds
+    complete, barriers release (no deadlock via the round-number wait +
+    dedicated barrier channel), and both trainers see identical params."""
+    port = _free_ports(1)[0]
+    ep = f"127.0.0.1:{port}"
+    main, startup, cost = _linear_model(seed=21)
+    t0 = DistributeTranspiler()
+    t0.transpile(trainer_id=0, program=main, startup_program=startup,
+                 pservers=ep, trainers=2, sync_mode=True)
+    ps = t0.start_pserver(ep, port=port)
+    try:
+        progs = []
+        for tid in range(2):
+            t = DistributeTranspiler()
+            t.transpile(trainer_id=tid, program=main,
+                        startup_program=startup, pservers=ep, trainers=2,
+                        sync_mode=True)
+            progs.append(t.get_trainer_program(send_recv=True))
+        types = [op.type for op in progs[0].global_block().ops]
+        assert types[-1] == "send_barrier" and types[-2] == "send"
+
+        results = {}
+
+        def trainer(tid):
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor()
+                exe.run(startup)
+                losses = []
+                for i in range(6):
+                    (l,) = exe.run(progs[tid], feed=_feed(i),
+                                   fetch_list=[cost])
+                    losses.append(float(l.ravel()[0]))
+                results[tid] = (losses, {
+                    p: np.asarray(scope.find_var(p)).copy()
+                    for p in t0.param_assignment})
+
+        threads = [threading.Thread(target=trainer, args=(i,))
+                   for i in range(2)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+        assert set(results) == {0, 1}, "a trainer thread died or hung"
+        stats = ps.stats()
+        # 6 lockstep rounds, one merged apply per param per round
+        assert stats["round"] == 6, stats
+        assert stats["steps"] == 6 * len(t0.param_assignment), stats
+        # sync SGD: both trainers recv'd identical params each round
+        for p in t0.param_assignment:
+            np.testing.assert_allclose(results[0][1][p], results[1][1][p],
+                                       rtol=1e-6)
+        assert results[0][0][-1] < results[0][0][0], results[0][0]
+    finally:
+        ps.shutdown()
