@@ -21,7 +21,8 @@ __all__ = [
     "sigmoid_cross_entropy_with_logits", "smooth_l1", "lrn", "expand", "pad",
     "im2sequence", "prelu", "autoincreased_step_counter", "cos_sim",
     "dot_product_attention", "edit_distance", "chunk_eval",
-    "ring_attention", "moe",
+    "ring_attention", "moe", "warpctc", "nce", "row_conv", "multiplex",
+    "lstm_unit",
 ]
 
 
@@ -886,3 +887,114 @@ def moe(input, num_experts, d_ff, capacity_factor=1.25, ep_axis="ep",
         attrs={"capacity_factor": capacity_factor, "ep_axis": ep_axis},
     )
     return out, aux
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss (reference layers/nn.py:2726 -> warpctc op, which links
+    warp-ctc; here the emitter computes the exact CTC forward in log
+    space). input: [N, T, C] raw logits; label: [N, L] padded. Returns
+    per-example loss [N, 1]."""
+    from .sequence import seq_lengths_of
+
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    grad = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Logits": [input], "Label": [label]}
+    in_len = seq_lengths_of(input)
+    if in_len is not None:
+        inputs["LogitsLength"] = [in_len]
+    lab_len = seq_lengths_of(label)
+    if lab_len is not None:
+        inputs["LabelLength"] = [lab_len]
+    helper.append_op(
+        type="warpctc", inputs=inputs,
+        outputs={"Loss": [loss], "WarpCTCGrad": [grad]},
+        attrs={"blank": int(blank), "norm_by_times": bool(norm_by_times)},
+    )
+    return loss
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None):
+    """Noise-contrastive estimation loss (reference layers/nn.py:2836 ->
+    nce op). Returns per-example cost [N, 1]."""
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr)
+    dim = int(input.shape[-1])
+    weight = helper.create_parameter(
+        helper.param_attr, shape=[int(num_total_classes), dim],
+        dtype=input.dtype)
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sample_logits = helper.create_variable_for_type_inference(input.dtype)
+    sample_labels = helper.create_variable_for_type_inference("int64")
+    inputs = {"Input": [input], "Label": [label], "Weight": [weight]}
+    if helper.bias_attr is not False:  # bias_attr=False opts out
+        inputs["Bias"] = [helper.create_parameter(
+            helper.bias_attr, shape=[int(num_total_classes)],
+            dtype=input.dtype, is_bias=True)]
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    n_neg = 10 if num_neg_samples is None else int(num_neg_samples)
+    if n_neg < 1:
+        raise ValueError(f"num_neg_samples must be >= 1, got {n_neg}")
+    helper.append_op(
+        type="nce", inputs=inputs,
+        outputs={"Cost": [cost], "SampleLogits": [sample_logits],
+                 "SampleLabels": [sample_labels]},
+        attrs={"num_total_classes": int(num_total_classes),
+               "num_neg_samples": n_neg},
+    )
+    return cost
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference layers/nn.py row_conv, the
+    DeepSpeech2 streaming op): out[t] = sum_k x[t+k] w[k]."""
+    from .sequence import _propagate_lengths, seq_lengths_of
+
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    filter_shape = [int(future_context_size) + 1, int(input.shape[-1])]
+    w = helper.create_parameter(helper.param_attr, shape=filter_shape,
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "Filter": [w]}
+    lens = seq_lengths_of(input)
+    if lens is not None:
+        inputs["Lengths"] = [lens]
+    helper.append_op(type="row_conv", inputs=inputs,
+                     outputs={"Out": [out]})
+    _propagate_lengths(input, out)
+    return helper.append_activation(out)
+
+
+def multiplex(inputs, index):
+    """Row-wise select among candidate tensors by per-row index (reference
+    layers/nn.py multiplex -> multiplex op)."""
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op(
+        type="multiplex",
+        inputs={"X": list(inputs), "Ids": [index]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step (reference layers/nn.py lstm_unit): projects
+    [x_t, h_prev] to the 4H gates with an fc, then applies the fused cell.
+    Returns (hidden_t, cell_t)."""
+    helper = LayerHelper("lstm_unit_layer", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    size = int(cell_t_prev.shape[-1])
+    gates = fc(input=[x_t, hidden_t_prev], size=4 * size,
+               param_attr=param_attr, bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(
+        type="lstm_unit",
+        inputs={"X": [gates], "C_prev": [cell_t_prev]},
+        outputs={"C": [c], "H": [h]},
+        attrs={"forget_bias": float(forget_bias)},
+    )
+    return h, c

@@ -21,6 +21,7 @@ __all__ = [
     "sequence_expand", "sequence_first_step", "sequence_last_step",
     "sequence_softmax", "sequence_reshape", "sequence_concat", "seq_lengths_of",
     "linear_chain_crf", "crf_decoding", "lod_reset",
+    "dynamic_lstmp", "ctc_greedy_decoder",
     "gru_unit", "sequence_mask", "batch_gather", "beam_search",
     "beam_search_decode",
 ]
@@ -416,4 +417,85 @@ def lod_reset(x, y=None, target_lod=None):
         outputs={"Out": [out], "OutLengths": [out_lens]}, attrs=attrs,
     )
     out._seq_lengths = out_lens
+    return out
+
+
+def dynamic_lstmp(input, size, proj_size, use_peepholes=True,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", param_attr=None, bias_attr=None,
+                  dtype="float32", name=None):
+    """LSTM with recurrent projection (reference layers/nn.py:423
+    dynamic_lstmp -> lstmp_op): input carries the x-projection
+    [N, T, 4*size]; the recurrent state fed back is proj(h_t) of width
+    proj_size. Returns (projection, cell)."""
+    helper = LayerHelper("lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    size = size // 4
+    weight = helper.create_parameter(
+        helper.param_attr, shape=[proj_size, 4 * size], dtype=dtype)
+    proj_weight = helper.create_parameter(
+        helper.param_attr, shape=[size, proj_size], dtype=dtype)
+    inputs_bias = {}
+    if helper.bias_attr is not False:  # bias_attr=False opts out
+        bias_size = 7 * size if use_peepholes else 4 * size
+        inputs_bias["Bias"] = [helper.create_parameter(
+            helper.bias_attr, shape=[1, bias_size], dtype=dtype,
+            is_bias=True)]
+    projection = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    extras = [helper.create_variable_for_type_inference(dtype)
+              for _ in range(5)]
+    inputs = {"Input": [input], "Weight": [weight],
+              "ProjWeight": [proj_weight], **inputs_bias}
+    lens = seq_lengths_of(input)
+    if lens is not None:
+        inputs["Lengths"] = [lens]
+    helper.append_op(
+        type="lstmp", inputs=inputs,
+        outputs={"Projection": [projection], "Cell": [cell],
+                 "BatchedProjection": [extras[0]],
+                 "BatchedCell": [extras[1]], "BatchedInput": [extras[2]],
+                 "BatchedHidden": [extras[3]], "OrderedP0": [extras[4]]},
+        attrs={
+            "use_peepholes": use_peepholes, "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+            "proj_activation": proj_activation,
+        },
+    )
+    _propagate_lengths(input, projection)
+    _propagate_lengths(input, cell)
+    return projection, cell
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """Greedy CTC decoding (reference layers/nn.py ctc_greedy_decoder ->
+    ctc_align): argmax per step, merge repeats, drop blanks. Returns the
+    left-packed token tensor ([N, T], -1 padded — the dense equivalent of
+    the reference's variable-length LoD output)."""
+    from .tensor import argmax
+
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    ids = argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference("int64")
+    out_len = helper.create_variable_for_type_inference("int32")
+    inputs = {"Input": [ids]}
+    lens = seq_lengths_of(input)
+    if lens is not None:
+        inputs["InputLength"] = [lens]
+    helper.append_op(
+        type="ctc_align", inputs=inputs,
+        outputs={"Output": [out], "OutputLength": [out_len]},
+        attrs={"blank": int(blank), "merge_repeated": True},
+    )
+    # ctc_align emits [N, 1] (reference padding-mode shape); the repo's
+    # lengths convention is flat [N] — reshape before attaching
+    flat_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="reshape", inputs={"X": [out_len]},
+        outputs={"Out": [flat_len]}, attrs={"shape": [-1]},
+    )
+    out._seq_lengths = flat_len
     return out

@@ -231,15 +231,21 @@ def roi_pool(ctx, ins, attrs):
                                 jnp.int32)}
 
 
-@register_op("row_conv", ref="paddle/fluid/operators/row_conv_op.cc")
+@register_op("row_conv", no_grad=("Lengths",),
+             ref="paddle/fluid/operators/row_conv_op.cc")
 def row_conv(ctx, ins, attrs):
     """Lookahead row convolution (DeepSpeech2): out[t] = sum_{k<ctx}
-    x[t+k] * w[k]. X [N, T, D] dense (reference is LoD), Filter [ctx, D]."""
+    x[t+k] * w[k]. X [N, T, D] padded (reference is LoD); the window stops
+    at each sequence's REAL end — lookahead must not read pad frames."""
     x = one(ins, "X")
     w = one(ins, "Filter")
+    lengths = (ins.get("Lengths") or [None])[0]
     ctx_len = w.shape[0]
-    outs = jnp.zeros_like(x)
     T = x.shape[1]
+    if lengths is not None:
+        x = x * (jnp.arange(T)[None, :]
+                 < lengths[:, None]).astype(x.dtype)[:, :, None]
+    outs = jnp.zeros_like(x)
     for k in range(ctx_len):
         shifted = jnp.pad(x[:, k:], ((0, 0), (0, k), (0, 0)))
         outs = outs + shifted * w[k][None, None, :]
@@ -286,6 +292,14 @@ def lstmp(ctx, ins, attrs):
     h0, c0 = one(ins, "H0"), one(ins, "C0")
     lengths = one(ins, "Lengths")
     use_peepholes = bool(attrs.get("use_peepholes", False))
+    is_reverse = bool(attrs.get("is_reverse", False))
+    acts = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}
+    g_act = acts[attrs.get("gate_activation", "sigmoid")]
+    c_act = acts[attrs.get("cell_activation", "tanh")]
+    cand_act = acts[attrs.get("candidate_activation", "tanh")]
+    # reference lstmp_op.h applies proj_activation to r_t BEFORE feedback
+    p_act = acts[attrs.get("proj_activation", "tanh")]
 
     N, T, H4 = x.shape
     H = H4 // 4
@@ -301,22 +315,29 @@ def lstmp(ctx, ins, attrs):
             w_ic = w_fc = w_oc = jnp.zeros((1, H), x.dtype)
     else:
         w_ic = w_fc = w_oc = jnp.zeros((1, H), x.dtype)
-    r0 = jnp.zeros((N, P), x.dtype) if h0 is None else h0 @ proj_w
+    r0 = jnp.zeros((N, P), x.dtype) if h0 is None else p_act(h0 @ proj_w)
     c0 = jnp.zeros((N, H), x.dtype) if c0 is None else c0
     if lengths is None:
         lengths = jnp.full((N,), T, jnp.int32)
+    if is_reverse:
+        # reverse each sequence's VALID prefix (like the lstm op): index
+        # len-1-t for t < len so padding stays at the tail
+        t_idx = jnp.arange(T)[None, :]
+        rev_idx = jnp.where(t_idx < lengths[:, None],
+                            lengths[:, None] - 1 - t_idx, t_idx)
+        x = jnp.take_along_axis(x, rev_idx[:, :, None], axis=1)
 
     def step(carry, xs):
         r, c = carry
         g, t = xs  # [N, 4H]
         g = g + r @ w
-        i = jax.nn.sigmoid(g[:, :H] + w_ic * c)
-        f = jax.nn.sigmoid(g[:, H:2 * H] + w_fc * c)
-        cand = jnp.tanh(g[:, 2 * H:3 * H])
+        i = g_act(g[:, :H] + w_ic * c)
+        f = g_act(g[:, H:2 * H] + w_fc * c)
+        cand = cand_act(g[:, 2 * H:3 * H])
         c_new = f * c + i * cand
-        o = jax.nn.sigmoid(g[:, 3 * H:] + w_oc * c_new)
-        h_new = o * jnp.tanh(c_new)
-        r_new = h_new @ proj_w
+        o = g_act(g[:, 3 * H:] + w_oc * c_new)
+        h_new = o * c_act(c_new)
+        r_new = p_act(h_new @ proj_w)
         valid = (t < lengths)[:, None]
         r_new = jnp.where(valid, r_new, r)
         c_new = jnp.where(valid, c_new, c)
@@ -326,6 +347,9 @@ def lstmp(ctx, ins, attrs):
         step, (r0, c0), (jnp.swapaxes(x, 0, 1), jnp.arange(T)))
     proj = jnp.swapaxes(rs, 0, 1)  # [N, T, P]
     cell = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        proj = jnp.take_along_axis(proj, rev_idx[:, :, None], axis=1)
+        cell = jnp.take_along_axis(cell, rev_idx[:, :, None], axis=1)
     mask = (jnp.arange(T)[None, :] < lengths[:, None])[:, :, None]
     return {"Projection": jnp.where(mask, proj, 0.0),
             "Cell": jnp.where(mask, cell, 0.0),

@@ -283,7 +283,9 @@ class TestLSTMP(OpTest):
             c = f * c + i * cand
             o = sigmoid(g[:, 3 * H:])
             h = o * np.tanh(c)
-            r = h @ proj_w
+            # reference lstmp_op.cc: proj_activation defaults to tanh and
+            # the ACTIVATED projection feeds back
+            r = np.tanh(h @ proj_w)
             expected[:, t] = r
 
         ctx = EmitCtx()
@@ -292,3 +294,22 @@ class TestLSTMP(OpTest):
                            "ProjWeight": [proj_w]}, {})
         got = np.asarray(out["Projection"][0])
         np.testing.assert_allclose(got, expected, atol=1e-5, rtol=1e-4)
+
+        # identity proj_activation reproduces the linear form
+        r = np.zeros((N, P), np.float32)
+        c = np.zeros((N, H), np.float32)
+        lin = np.zeros((N, T, P), np.float32)
+        for t in range(T):
+            g = x[:, t] + r @ w
+            i = sigmoid(g[:, :H])
+            f = sigmoid(g[:, H:2 * H])
+            c = f * c + i * np.tanh(g[:, 2 * H:3 * H])
+            h = sigmoid(g[:, 3 * H:]) * np.tanh(c)
+            r = h @ proj_w
+            lin[:, t] = r
+        out2 = run_forward(ctx, "lstmp",
+                           {"Input": [x], "Weight": [w],
+                            "ProjWeight": [proj_w]},
+                           {"proj_activation": "identity"})
+        np.testing.assert_allclose(np.asarray(out2["Projection"][0]), lin,
+                                   atol=1e-5, rtol=1e-4)
