@@ -37,13 +37,18 @@ __all__ = ["ParameterServer", "ParameterClient", "get_client"]
 class ParameterServer:
     """Runs the optimize slice of a pserver program behind RPC."""
 
-    def __init__(self, pserver_program, startup_program, trainers: int = 1,
-                 sync_mode: bool = False):
+    def __init__(self, pserver_program, startup_program=None,
+                 trainers: int = 1, sync_mode: bool = False, scope=None):
+        """startup_program initializes a fresh scope; alternatively pass an
+        already-populated `scope` (the ListenAndServ in-process form, where
+        the server shares the builder's state)."""
         import paddle_tpu.fluid as fluid
 
+        if startup_program is None and scope is None:
+            raise ValueError("need startup_program or a populated scope")
         self._trainers = max(1, int(trainers))
         self._sync = bool(sync_mode)
-        self._scope = fluid.Scope()
+        self._scope = scope if scope is not None else fluid.Scope()
         self._exe = fluid.Executor()
         self._program = pserver_program
         self._mu = threading.Lock()
@@ -116,8 +121,9 @@ class ParameterServer:
                     break
             self._grad_name[p] = gname
 
-        with fluid.scope_guard(self._scope):
-            self._exe.run(startup_program)
+        if startup_program is not None:
+            with fluid.scope_guard(self._scope):
+                self._exe.run(startup_program)
 
         self._server = RpcServer({
             "get_param": self.get_param,

@@ -241,7 +241,8 @@ def _run_recv_ops(recv_ops, scope: Scope):
                 "get_param", name)))
 
 
-def _run_send_ops(send_ops, values: Dict[str, Any]):
+def _run_send_ops(send_ops, values: Dict[str, Any],
+                  scope: Optional[Scope] = None):
     """Push computed gradients to their pservers AFTER the step (reference
     send_op.cc AsyncSendVariable; send_barrier_op for sync rounds). The
     barrier waits on the round number the pushes were assigned to, over a
@@ -270,6 +271,21 @@ def _run_send_ops(send_ops, values: Dict[str, Any]):
             ep = eps[gname]
             if ep not in push_round and isinstance(resp, dict):
                 push_round[ep] = resp.get("round")
+        # the reference send op's get_vars: pull AFTER this op's pushes —
+        # and after the round they joined has APPLIED (a sync server only
+        # merges once every trainer pushed; barrier is a no-op on async)
+        recv_eps = attrs.get("recv_endpoints", {})
+        out_names = op.desc.outputs.get("Out", [])
+        if out_names:
+            if scope is None:
+                raise RuntimeError("send op with get_vars needs a scope")
+            for ep in {recv_eps[n] for n in out_names}:
+                if ep in push_round:
+                    get_client(ep, channel="barrier").call(
+                        "barrier", push_round[ep])
+            for name in out_names:
+                scope.set_var(name, jnp.asarray(
+                    get_client(recv_eps[name]).call("get_param", name)))
 
 
 _IO_OP_TYPES = frozenset({"save", "save_combine", "load", "load_combine"})
@@ -501,7 +517,7 @@ class Executor:
                                 f"send op: var '{n}' has no value (no "
                                 "device ops produce it in this program)")
                         vals[n] = v
-                _run_send_ops(send_ops, vals)
+                _run_send_ops(send_ops, vals, scope)
             _run_io_host_ops(io_post, scope)
             out = []
             for v in fetch_list or []:
@@ -554,7 +570,7 @@ class Executor:
             scope.set_var(n, v)
         fetched_vals = dict(zip(fetch_names + extra_fetches, fetches))
         if send_ops:
-            _run_send_ops(send_ops, fetched_vals)
+            _run_send_ops(send_ops, fetched_vals, scope)
         fetches = fetches[:len(fetch_names)]
         # trailing save ops see the POST-step scope (reference in-order
         # save_op semantics: a train+checkpoint program saves updated

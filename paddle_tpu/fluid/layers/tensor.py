@@ -10,6 +10,7 @@ __all__ = [
     "create_tensor", "create_parameter", "create_global_var", "cast", "concat",
     "sums", "assign", "fill_constant", "fill_constant_batch_size_like",
     "ones", "zeros", "argmin", "argmax",
+    "save", "save_combine", "load", "load_combine",
 ]
 
 
@@ -156,3 +157,40 @@ def _arg_min_max(op_type, x, axis):
         attrs={"axis": axis},
     )
     return out
+
+
+def save(x, file_path, overwrite=True):
+    """Emit an in-graph save op for one var (reference layers/tensor.py
+    save -> save_op.cc; runs host-side at the program's edge)."""
+    helper = LayerHelper("save")
+    helper.main_program.current_block().append_op(
+        "save", inputs={"X": [x]}, outputs={},
+        attrs={"file_path": str(file_path), "overwrite": bool(overwrite)},
+    )
+
+
+def save_combine(x, file_path, overwrite=True):
+    """Save several vars into one file (reference save_combine_op.cc)."""
+    helper = LayerHelper("save_combine")
+    helper.main_program.current_block().append_op(
+        "save_combine", inputs={"X": list(x)}, outputs={},
+        attrs={"file_path": str(file_path), "overwrite": bool(overwrite)},
+    )
+
+
+def load(out, file_path):
+    """Emit an in-graph load op into `out` (reference load_op.cc)."""
+    helper = LayerHelper("load")
+    helper.main_program.current_block().append_op(
+        "load", inputs={}, outputs={"Out": [out]},
+        attrs={"file_path": str(file_path)},
+    )
+
+
+def load_combine(out, file_path):
+    """Load several vars from one file (reference load_combine_op.cc)."""
+    helper = LayerHelper("load_combine")
+    helper.main_program.current_block().append_op(
+        "load_combine", inputs={}, outputs={"Out": list(out)},
+        attrs={"file_path": str(file_path)},
+    )

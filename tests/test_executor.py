@@ -271,3 +271,32 @@ def test_in_graph_save_load_ops(tmp_path):
                                     filename="all"))
     np.testing.assert_allclose(np.asarray(scope3.find_var("sv.b")),
                                np.ones((4,)) * 7)
+
+
+def test_tensor_save_load_layer_api(tmp_path):
+    """layers.save/load emit the in-graph io ops (reference
+    layers/tensor.py save/load)."""
+    import jax.numpy as jnp
+
+    main, startup, scope = _fresh()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            w = layers.create_parameter(shape=[3], dtype="float32",
+                                        name="tsl.w")
+            layers.save(w, str(tmp_path / "w"))
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main)
+    assert (tmp_path / "w.npy").exists()
+
+    main2 = Program()
+    with program_guard(main2, Program()):
+        out = main2.global_block().create_var(name="tsl.w2", shape=[3],
+                                              dtype="float32",
+                                              persistable=True)
+        layers.load(out, str(tmp_path / "w"))
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        fluid.Executor().run(main2)
+    np.testing.assert_allclose(np.asarray(scope2.find_var("tsl.w2")),
+                               np.asarray(scope.find_var("tsl.w")))

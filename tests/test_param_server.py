@@ -378,3 +378,56 @@ def test_sync_two_trainers_through_executor_ops():
         assert results[0][0][-1] < results[0][0][0], results[0][0]
     finally:
         ps.shutdown()
+
+
+def test_listen_and_serv_send_recv_layers():
+    """The reference's Send/Recv/ListenAndServ layer API (layers/io.py:107,
+    173, 205; test_recv_op.py:26 pattern): a server block captured with
+    do() serves behind RPC; the client program's Send pushes a grad and
+    pulls the updated param back."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.framework import Program, program_guard
+
+    port = _free_ports(1)[0]
+    ep = f"127.0.0.1:{port}"
+
+    server_prog, server_startup = Program(), Program()
+    with program_guard(server_prog, server_startup):
+        w = layers.create_parameter(shape=[4], dtype="float32", name="ls.w")
+        g = server_prog.global_block().create_var(
+            name="ls.w@GRAD", shape=[4], dtype="float32")
+        serv = layers.ListenAndServ(ep, inputs=[g], fan_in=1)
+        with serv.do():
+            server_prog.current_block().append_op(
+                "sgd",
+                inputs={"Param": ["ls.w"], "Grad": ["ls.w@GRAD"],
+                        "LearningRate": ["ls.lr"]},
+                outputs={"ParamOut": ["ls.w"]},
+            )
+        assert serv.get_params_and_grads() == (["ls.w"], ["ls.w@GRAD"])
+
+    scope = fluid.Scope()
+    scope.set_var("ls.w", jnp.asarray(np.ones(4, np.float32)))
+    scope.set_var("ls.lr", jnp.asarray(np.float32(0.5)))
+    ps = serv.run(scope=scope, port=port)
+    try:
+        client_prog, _ = Program(), Program()
+        with program_guard(client_prog, Program()):
+            gvar = client_prog.global_block().create_var(
+                name="ls.w@GRAD", shape=[4], dtype="float32")
+            wvar = client_prog.global_block().create_var(
+                name="ls.w", shape=[4], dtype="float32", persistable=True)
+            layers.Send(ep, [gvar], get_vars=[wvar])
+        cscope = fluid.Scope()
+        with fluid.scope_guard(cscope):
+            exe = fluid.Executor()
+            exe.run(client_prog,
+                    feed={"ls.w@GRAD": np.full((4,), 2.0, np.float32)})
+        # server applied w -= 0.5 * 2.0; Send's get_vars pulled it back
+        np.testing.assert_allclose(
+            np.asarray(cscope.find_var("ls.w")), np.zeros(4), atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(scope.find_var("ls.w")), np.zeros(4), atol=1e-6)
+    finally:
+        ps.shutdown()
