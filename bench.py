@@ -8,17 +8,29 @@ one TPU chip. Baseline denominator: V100-class fluid-era ResNet-50 throughput
 (ResNet-50 81.69 imgs/s on Xeon 6148, BASELINE.md), so vs_baseline > 1.0 means
 faster than a V100 would have been.
 
-Design (round-3 rework):
+Design (round-4 rework — INDESTRUCTIBLE artifact):
 
 1. SUPERVISOR: the TPU attach (PJRT plugin over a tunnel) has been observed
    to fail fast, hang forever, or die mid-compile of a large graph. Every
    stage runs in its OWN subprocess with a hard timeout (tools/tpu_smoke.py
-   design). The supervisor keeps retrying the attach on a backoff schedule
-   for BENCH_RETRY_WINDOW_S before giving up, and precompiles small->large
-   (lenet -> resnet bs8 -> bs32) so a mid-ladder tunnel death still leaves
-   a real TPU number from an earlier rung.
+   design). The supervisor retries the attach on a backoff schedule inside
+   a bounded window and precompiles small->large (resnet bs8 -> bs32) so a
+   mid-ladder tunnel death still leaves a real TPU number from an earlier
+   rung.
 
-2. SELF-VALIDATION: a throughput number nobody can check is worthless
+2. INDESTRUCTIBILITY (round-3 lesson: rc=124 mid-retry left an EMPTY
+   artifact, `parsed: null`):
+   - a single current-best status dict exists from the FIRST millisecond
+     and is atomically mirrored to bench_status.json at every state change;
+   - SIGTERM/SIGINT handlers print that status as the contract JSON line
+     and exit, so the driver's `timeout` kill still yields a parseable
+     artifact;
+   - a hard SELF-deadline (BENCH_TOTAL_BUDGET_S, default 1380s) sized well
+     inside the driver's observed ~27-minute budget guarantees the normal
+     exit path is reached even if no signal arrives: every child subprocess
+     timeout and probe sleep is clamped to the time remaining.
+
+3. SELF-VALIDATION: a throughput number nobody can check is worthless
    (round-2 lesson: a recorded 19.4k imgs/s implied >= 95% MFU — physically
    implausible). The child records device_kind + device count, computes
    MFU = imgs/s x FLOP/img / chip peak from BOTH the XLA cost analysis and
@@ -26,13 +38,18 @@ Design (round-3 rework):
    error=mfu_exceeds_plausible_peak) when MFU > 0.85 — a bug indicator,
    not a result.
 
-3. HONESTY: if the TPU is truly unreachable, the output is
+4. HONESTY: if the TPU is truly unreachable, the output is
    {"error": "tpu_unreachable", value 0.0} plus a tiny labelled CPU sanity
    run proving the stack itself still works — NOT an rc=0 CPU number
    masquerading as the metric (round-2's 0.4 imgs/s artifact).
+
+5. EXTRAS: when a TPU rung lands with time to spare, the same session also
+   runs the flash-attention bf16 micro-bench and attaches its table under
+   "flash_bf16" (round-3 verdict: those gates had never produced a number).
 """
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -64,18 +81,97 @@ BATCH = int(os.environ.get("BENCH_BATCH", "32"))
 ITERS = int(os.environ.get("BENCH_ITERS", "30"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "5"))
 
-PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
-CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT", "2400"))
-CPU_CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CPU_CHILD_TIMEOUT", "1200"))
-# Total wall-clock budget for getting a TPU attach before declaring it
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "75"))
+CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT", "600"))
+CPU_CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CPU_CHILD_TIMEOUT", "420"))
+# Wall-clock budget for getting a TPU attach before declaring it
 # unreachable. Backoff schedule retries the probe across this window.
-RETRY_WINDOW_S = int(os.environ.get("BENCH_RETRY_WINDOW_S", "1800"))
+RETRY_WINDOW_S = int(os.environ.get("BENCH_RETRY_WINDOW_S", "300"))
+# Hard self-deadline for the WHOLE bench run. Round-3 evidence puts the
+# driver's kill at ~27 min (rc=124 with 194s of a 1800s window left); 23
+# minutes leaves a wide margin, and every stage below clamps to what
+# remains of it.
+TOTAL_BUDGET_S = int(os.environ.get("BENCH_TOTAL_BUDGET_S", "1380"))
+# Seconds reserved at the end of the budget for the epilogue (cpu sanity
+# decision + final print).
+EPILOGUE_RESERVE_S = 45
+
+STATUS_PATH = os.environ.get(
+    "BENCH_STATUS_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_status.json"),
+)
 
 _PROBE_SRC = (
     "import jax, jax.numpy as jnp; d = jax.devices();"
     "x = jnp.ones((256, 256)); jax.block_until_ready(x @ x);"
     "print('PROBE_OK', d[0].platform)"
 )
+
+
+# ---------------------------------------------------------------------------
+# Indestructible status: one dict, alive from the first millisecond, printed
+# by the signal handler if the driver kills us and by the normal epilogue
+# otherwise. Mirrored atomically to bench_status.json at every change.
+# ---------------------------------------------------------------------------
+
+# Single-threaded by design: no lock. The signal handler must never block,
+# so it consumes a PRE-SERIALIZED json line (_SNAPSHOT_JSON, str assignment
+# is atomic) rather than touching the dict or any lock.
+_STATUS = {
+    "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+    "value": 0.0,
+    "unit": "images/sec",
+    "vs_baseline": 0.0,
+    "backend": "none",
+    "error": "tpu_unreachable",
+    "stage": "starting",
+    "probes": 0,
+}
+_SNAPSHOT_JSON = json.dumps(_STATUS)
+_PRINTED = False
+
+
+def _update_status(updates=None, replace=None):
+    """Merge `updates` (or swap in `replace`), re-serialize the snapshot
+    the signal handler prints, and atomically mirror it to STATUS_PATH."""
+    global _SNAPSHOT_JSON
+    if replace is not None:
+        _STATUS.clear()
+        _STATUS.update(replace)
+    if updates:
+        _STATUS.update(updates)
+    _SNAPSHOT_JSON = json.dumps(_STATUS)
+    try:
+        tmp = STATUS_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(_SNAPSHOT_JSON)
+        os.replace(tmp, STATUS_PATH)
+    except OSError:
+        pass  # the file mirror is insurance, not the contract
+    return _STATUS
+
+
+def _print_status_once():
+    """Print the contract JSON line exactly once per process."""
+    global _PRINTED
+    if _PRINTED:
+        return
+    _PRINTED = True
+    sys.stdout.write(_SNAPSHOT_JSON + "\n")
+    sys.stdout.flush()
+
+
+def _on_kill_signal(signum, frame):
+    """Driver timeout sends SIGTERM (round-3 artifact: rc=124, parsed:null
+    because nothing had been printed). Write the pre-serialized best status
+    straight to fd 1 and leave — no locks, no allocation-heavy json.dumps,
+    no child-process cleanup to block on."""
+    global _PRINTED
+    if not _PRINTED:
+        _PRINTED = True
+        os.write(1, (_SNAPSHOT_JSON + "\n").encode())
+    os._exit(0)
 
 
 def chip_peak_flops(device_kind: str):
@@ -162,9 +258,16 @@ def _probe_once():
 def _probe_within_window(deadline):
     """Retry the attach probe with backoff until it answers or the retry
     window closes. Returns 'tpu' / 'cpu' / None (window exhausted)."""
-    backoff = 15
+    backoff = 10
+    first = True
     while True:
+        # never START a follow-up probe whose own timeout would cross the
+        # deadline — but always attempt at least one
+        if not first and time.time() + PROBE_TIMEOUT_S > deadline + 30:
+            return None
+        first = False
         platform = _probe_once()
+        _update_status({"probes": _STATUS.get("probes", 0) + 1})
         if platform is not None:
             return platform
         remaining = deadline - time.time()
@@ -174,12 +277,14 @@ def _probe_within_window(deadline):
         print(f"# probe retry in {wait:.0f}s "
               f"({remaining:.0f}s left in retry window)", file=sys.stderr)
         time.sleep(wait)
-        backoff = min(backoff * 2, 300)
+        backoff = min(backoff * 2, 120)
 
 
 def _tpu_ladder(deadline):
     """Small->large benchmark rungs. Returns the best (largest-batch valid)
-    result dict, or None. A mid-ladder tunnel death keeps earlier rungs."""
+    result dict, or None. A mid-ladder tunnel death keeps earlier rungs,
+    and every completed rung is committed to the status immediately so a
+    driver kill between rungs still reports the best number so far."""
     small = min(8, BATCH)
     mid = min(16, BATCH)
     rungs = []
@@ -196,34 +301,77 @@ def _tpu_ladder(deadline):
             rungs.append((overrides, f"tpu-bs{bs}"))
     best = None
     for i, (overrides, label) in enumerate(rungs):
+        remaining = deadline - time.time()
+        if remaining < 120:
+            print(f"# skipping {label}: {remaining:.0f}s left in budget",
+                  file=sys.stderr)
+            break
         env = dict(os.environ)
         env.update(overrides)
-        result = _run_child(env, CHILD_TIMEOUT_S, label)
+        _update_status({"stage": f"running:{label}"})
+        result = _run_child(env, min(CHILD_TIMEOUT_S, int(remaining)), label)
         if result is not None and result.get("backend") not in (None, "cpu"):
             result["ladder_rung"] = label
             if result.get("valid", False):
                 best = result  # later rungs are larger batches
             elif best is None:
                 best = result
+            _update_status(replace=dict(best))
         else:
             print(f"# {label} failed", file=sys.stderr)
             if i < len(rungs) - 1:
                 # a failed big compile may have wedged the tunnel; re-probe
-                # (bounded by whatever remains of the retry window)
+                # briefly before burning budget on the next rung
                 print("# re-probing tunnel before next rung", file=sys.stderr)
                 if _probe_within_window(
-                        min(deadline, time.time() + 300)) != "tpu":
+                        min(deadline, time.time() + 120)) != "tpu":
                     break
     return best
 
 
-def _cpu_sanity():
+def _flash_extra(deadline):
+    """Optional same-session extra: the flash-attention bf16 micro-bench
+    (quick mode). Attached as evidence under "flash_bf16"; never allowed
+    to endanger the main artifact (own subprocess, clamped timeout)."""
+    remaining = deadline - time.time()
+    if remaining < 240:
+        return None
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "flash_attention_bench.py")
+    if not os.path.exists(script):
+        return None
+    env = dict(os.environ)
+    # quick mode: bf16 only, pruned block sweep (the full sweep is the
+    # standalone bench's job; here we just want a first real number)
+    env.setdefault("FLASH_DTYPES", "bfloat16")
+    env.setdefault("FLASH_BLOCKS", "128x128,256x256,512x256")
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], env=env,
+            timeout=min(int(remaining) - 60, 480),
+            capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        print("# flash extra timed out", file=sys.stderr)
+        return None
+    rows = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return rows or None
+
+
+def _cpu_sanity(max_s=CPU_CHILD_TIMEOUT_S):
     """Tiny CPU run proving the stack works end-to-end. Its throughput is
     NOT the metric — it is evidence attached to a tpu_unreachable report."""
     env = _scrubbed_cpu_env()
     env.update({"BENCH_ITERS": "3", "BENCH_WARMUP": "1",
                 "BENCH_BATCH": "4"})
-    result = _run_child(env, CPU_CHILD_TIMEOUT_S, "cpu-sanity")
+    result = _run_child(env, min(CPU_CHILD_TIMEOUT_S, max_s), "cpu-sanity")
     if result is None:
         return None
     return {
@@ -238,14 +386,30 @@ def _cpu_sanity():
 
 
 def supervise():
-    deadline = time.time() + RETRY_WINDOW_S
-    platform = _probe_within_window(deadline)
+    t_start = time.time()
+    hard_deadline = t_start + TOTAL_BUDGET_S
+    work_deadline = hard_deadline - EPILOGUE_RESERVE_S
+    signal.signal(signal.SIGTERM, _on_kill_signal)
+    signal.signal(signal.SIGINT, _on_kill_signal)
+    _update_status({"stage": "probing", "total_budget_s": TOTAL_BUDGET_S})
+
+    probe_deadline = min(t_start + RETRY_WINDOW_S, work_deadline)
+    platform = _probe_within_window(probe_deadline)
 
     attached = platform == "tpu"
     if attached:
-        result = _tpu_ladder(deadline)
+        # from here on a kill no longer means "unreachable": the attach
+        # worked; until a rung completes the honest label is "incomplete"
+        _update_status({"error": "tpu_bench_incomplete", "backend": "tpu",
+                        "stage": "ladder"})
+        result = _tpu_ladder(work_deadline)
         if result is not None:
-            print(json.dumps(result))
+            extra = _flash_extra(work_deadline)
+            if extra is not None:
+                result["flash_bf16"] = extra
+            result["elapsed_s"] = round(time.time() - t_start, 1)
+            _update_status(replace=result)
+            _print_status_once()
             return 0
         print("# tpu rungs all failed", file=sys.stderr)
 
@@ -253,7 +417,6 @@ def supervise():
     # contract line still carries metric/value/unit/vs_baseline so the
     # driver artifact is well-formed, but value 0.0 + the error field make
     # it unmistakably NOT a performance result.
-    sanity = _cpu_sanity()
     out = {
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
         "value": 0.0,
@@ -265,11 +428,20 @@ def supervise():
         # but every benchmark rung then failed (compile death etc.)
         "error": ("tpu_bench_failed" if attached else "tpu_unreachable"),
         "probe_window_s": RETRY_WINDOW_S,
-        "cpu_sanity": sanity,
+        "probes": _STATUS.get("probes", 0),
     }
     if platform == "cpu":
         out["error"] = "no_tpu_on_host"
-    print(json.dumps(out))
+    _update_status(replace=out)
+    # CPU sanity is optional evidence; run it only if the budget allows and
+    # clamp it so the epilogue is always reached.
+    remaining = work_deadline - time.time()
+    if remaining > 180:
+        _update_status({"stage": "cpu_sanity"})
+        out["cpu_sanity"] = _cpu_sanity(max_s=int(remaining) - 30)
+    out["elapsed_s"] = round(time.time() - t_start, 1)
+    _update_status(replace=out)
+    _print_status_once()
     return 0
 
 
