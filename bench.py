@@ -362,7 +362,13 @@ def _flash_extra(deadline):
                 rows.append(json.loads(line))
             except json.JSONDecodeError:
                 continue
-    return rows or None
+    if not rows:
+        return None
+    if proc.returncode != 0:
+        # partial table from a crashed/failed sweep must not masquerade as
+        # a completed one
+        return {"incomplete": True, "rc": proc.returncode, "rows": rows}
+    return rows
 
 
 def _cpu_sanity(max_s=CPU_CHILD_TIMEOUT_S):

@@ -74,11 +74,20 @@ def ring_attention(ctx, ins, attrs):
                 return fn(q, k, v)
             # no dividable batch/head axis: stay on the XLA path
         return ring_attention_shard(q, k, v, None, causal, scale)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     batch_axis = attrs.get("batch_axis", "") or None
-    if batch_axis is not None and batch_axis not in mesh.axis_names:
+    if batch_axis is not None and (batch_axis not in sizes
+                                   or q.shape[0] % sizes[batch_axis]):
         batch_axis = None
     head_axis = attrs.get("head_axis", "") or None
-    if head_axis is not None and head_axis not in mesh.axis_names:
+    if head_axis is not None and (head_axis not in sizes
+                                  or q.shape[2] % sizes[head_axis]):
+        head_axis = None
+    if (head_axis is not None and impl == "ulysses"
+            and (q.shape[2] // sizes[head_axis]) % sizes[seq_axis]):
+        # ulysses re-splits the LOCAL head count over the sp axis; with
+        # heads already tp-sharded that's H/tp per shard, which must stay
+        # divisible by sp or the all_to_all cannot tile
         head_axis = None
     return sequence_parallel_attention(
         q, k, v, mesh, seq_axis=seq_axis, batch_axis=batch_axis,

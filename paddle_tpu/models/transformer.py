@@ -36,6 +36,13 @@ class TransformerConfig:
     seq_parallel: bool = False
     sp_impl: str = "ring"
     sp_axis: str = "sp"
+    # Megatron TP axis: the q/k/v projections are column-parallel under
+    # plan_transformer_tp, so their [N,L,H,dh] reshape arrives with H
+    # sharded over tp. The fused attention op must keep heads on that axis
+    # inside its shard_map — otherwise GSPMD has to transpose two tiled
+    # dims at the boundary and falls back to full rematerialization
+    # (hybrid dp×tp×sp mesh, spmd_partitioner.cc:652).
+    tp_axis: str = "tp"
 
 
 def _pos_encoding_table(max_len, d_model):
@@ -82,6 +89,7 @@ def _mha(cfg: TransformerConfig, q_in, kv_in, mask=None, causal=False,
         v = layers.reshape(proj(kv_in, "v"), shape=[0, 0, h, dh])
         ctx = layers.ring_attention(
             q, k, v, causal=causal, impl=cfg.sp_impl, seq_axis=cfg.sp_axis,
+            head_axis=cfg.tp_axis,
         )  # [N, L, H, dh]
     else:
         def split_heads(x):

@@ -224,4 +224,13 @@ def sequence_parallel_attention(
     spec = P(batch_axis, seq_axis, head_axis, None)
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)
-    return fn(q, k, v)
+    # Pin the boundary shardings explicitly. Under GSPMD the producers
+    # (e.g. tp column-parallel qkv projections) already carry compatible
+    # shardings when head_axis matches the plan; the constraints make that
+    # contract visible to the partitioner so it reshards with a local
+    # slice/relabel instead of discovering a conflict at the shard_map edge
+    # and falling back to full rematerialization (spmd_partitioner.cc:652).
+    cons = lambda x: jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, spec))
+    out = fn(cons(q), cons(k), cons(v))
+    return cons(out)
