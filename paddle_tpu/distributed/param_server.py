@@ -60,7 +60,12 @@ class ParameterServer:
         self._applied_round: set = set()
         self._steps = 0
         self._apply_mu = threading.Lock()
-        self._pushes_since_shared = 0
+        # params applied since the shared (LR-decay) program last ran: the
+        # shared chain advances once per DISTINCT-PARAM CYCLE — a repeat
+        # push means a new optimization step started — not once per
+        # len(owned) raw pushes, which drifts when a sparse workload skips
+        # params in a step (ADVICE r3)
+        self._applied_since_shared: set = set()
 
         block = pserver_program.global_block()
         self._owned = sorted(
@@ -237,11 +242,14 @@ class ParameterServer:
 
         with fluid.scope_guard(self._scope):
             # shared stateful chain (LR-decay counters) advances once per
-            # round: every len(owned) pushes, not on every param push
-            if self._shared_prog is not None:
-                if self._pushes_since_shared % len(self._owned) == 0:
+            # distinct-param cycle: at the first push ever, and whenever a
+            # param REPEATS (its second push means a new step began)
+            if name in self._applied_since_shared or \
+                    not self._applied_since_shared:
+                if self._shared_prog is not None:
                     self._exe.run(self._shared_prog)
-                self._pushes_since_shared += 1
+                self._applied_since_shared = set()
+            self._applied_since_shared.add(name)
             self._exe.run(self._per_param[name],
                           feed={self._grad_name[name]: grad})
         self._steps += 1

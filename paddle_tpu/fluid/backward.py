@@ -22,9 +22,9 @@ from .registry import FWD_META_ATTR, OPS
 
 # op types that never participate in differentiation. Control flow IS
 # differentiable here: `recurrent`/`dynamic_recurrent` (scan), `ifelse`/
-# `conditional_block` (lax.cond), and `while` WITH max_steps (bounded scan);
-# a while without max_steps on the loss path is a hard error (see below) —
-# never a silently-missing gradient term.
+# `conditional_block` (lax.cond), `while` WITH max_steps (bounded scan,
+# direct reverse-mode) and WITHOUT (custom recompute-replay grad —
+# ops/control_flow.py:_while_grad); never a silently-missing gradient term.
 _NON_DIFF_OPS = {
     "feed", "fetch", "fill_constant", "gaussian_random", "uniform_random",
     "sgd", "momentum", "adam", "adamax", "adagrad", "adadelta", "rmsprop",
@@ -206,14 +206,11 @@ def append_backward(
             # produced its outputs — their contributions die here
             _consume_output_grads(od)
             continue
-        if od.type == "while" and not od.attrs.get("max_steps"):
-            raise RuntimeError(
-                "gradient requested through a While loop built without "
-                "max_steps — an unbounded lax.while_loop has no reverse-mode. "
-                "Construct it as While(cond, max_steps=K) (K = trip-count "
-                "bound) to lower it as a differentiable bounded scan "
-                "(the reference's while grad, while_op.cc:96)."
-            )
+        # `while` without max_steps is differentiable too: its custom grad
+        # emitter (ops/control_flow.py:_while_grad) does a recompute-based
+        # reverse replay — the XLA form of the reference's saved-step-scope
+        # while_grad (while_op.cc:96). With max_steps it lowers to a scan
+        # and reverses directly (cheaper; prefer it when a bound is known).
 
         # materialize output grads
         grad_in: Dict[str, List[str]] = {}

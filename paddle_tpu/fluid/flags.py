@@ -27,6 +27,11 @@ FLAGS: Dict[str, Any] = {
     # conv/matmul (master weights and the rest of the graph stay f32) —
     # the standard TPU training configuration
     "amp": False,
+    # escalate UNEXPECTED shape-inference failures (emitter bugs) from a
+    # warn-once to a hard build-time error — the reference InferShape
+    # enforce semantics (shape_inference.h). CI enables this; the warn
+    # default keeps a conservative emitter from bricking user programs.
+    "strict_shape_inference": False,
 }
 
 

@@ -239,15 +239,17 @@ def _outer_reads(sub, parent_block, exclude=()):
 class While:
     """reference control_flow.py:608. Usage:
         cond = layers.less_than(i, n)
-        w = While(cond)                    # forward-only (lax.while_loop)
-        w = While(cond, max_steps=K)       # differentiable (bounded scan)
+        w = While(cond)                    # dynamic trip count
+        w = While(cond, max_steps=K)       # known trip bound
         with w.block():
             ...ops...  (must update `cond` for termination)
 
-    With `max_steps` the loop lowers to a K-step scan with freeze-after-exit
-    masking and supports append_backward (the reference's while grad,
-    while_op.cc:96); without it, requesting a gradient through the loop is a
-    hard error."""
+    Both forms support append_backward (the reference's while grad,
+    while_op.cc:96). With `max_steps` the loop lowers to a K-step scan with
+    freeze-after-exit masking — direct reverse-mode, O(K) memory. Without
+    it the gradient is a recompute-based reverse replay of the
+    lax.while_loop: O(1) extra memory but O(T^2) recompute, so prefer
+    max_steps when a bound is known."""
 
     def __init__(self, cond, name=None, max_steps=None):
         self.helper = LayerHelper("while", name=name)

@@ -710,7 +710,12 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
     def _pair(v):
         return list(v) if isinstance(v, (list, tuple)) else [v, v]
 
-    padding = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+    # paddings normalize to [up, left, down, right] (reference
+    # im2sequence_op.cc): scalar -> same all round, [ph, pw] -> symmetric
+    if not isinstance(padding, (list, tuple)):
+        padding = [padding] * 4
+    elif len(padding) == 2:
+        padding = [padding[0], padding[1], padding[0], padding[1]]
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(
         type="im2sequence", inputs={"X": [input]}, outputs={"Out": [out]},
@@ -721,9 +726,17 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
 
 
 def prelu(x, mode="all", param_attr=None, name=None):
+    """mode: 'all' (one alpha), 'channel' (alpha per channel, dim 1),
+    'element' (alpha per element of x.shape[1:]) — reference prelu_op.cc."""
     helper = LayerHelper("prelu", param_attr=param_attr, name=name)
+    if mode == "channel":
+        alpha_shape = [int(x.shape[1])]
+    elif mode == "element":
+        alpha_shape = [int(d) for d in x.shape[1:]]
+    else:
+        alpha_shape = [1]
     alpha = helper.create_parameter(
-        helper.param_attr, [1], x.dtype,
+        helper.param_attr, alpha_shape, x.dtype,
         default_initializer=ConstantInitializer(0.25),
     )
     out = helper.create_variable_for_type_inference(x.dtype)

@@ -63,5 +63,15 @@ _unary("gelu", lambda x, a: jax.nn.gelu(x, approximate=False))
 
 @register_op("prelu", ref="paddle/fluid/operators/prelu_op.cc")
 def prelu(ctx, ins, attrs):
+    """Modes (reference prelu_op.cc): 'all' = one shared alpha;
+    'channel' = one alpha per channel (dim 1 of NC...); 'element' = one
+    alpha per element of x.shape[1:]."""
     x, alpha = one(ins, "X"), one(ins, "Alpha")
-    return {"Out": jnp.where(x > 0, x, alpha.reshape(()) * x)}
+    mode = str(attrs.get("mode", "all"))
+    if mode == "all":
+        a = alpha.reshape(())
+    elif mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:  # element
+        a = alpha.reshape((1,) + x.shape[1:])
+    return {"Out": jnp.where(x > 0, x, a * x)}

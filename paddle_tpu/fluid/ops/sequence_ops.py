@@ -362,7 +362,15 @@ def lod_reset(ctx, ins, attrs):
     equivalent: X's valid tokens are flattened in order, then re-chunked
     into the target partition and re-padded. X may be dense ([total, ...]
     lod_level 0, no XLengths) or padded+lengths; the target comes from the
-    static `target_lod` offsets or from Y (padded shape) + YLengths."""
+    static `target_lod` offsets or from Y (padded shape) + YLengths.
+
+    Known validation gap (ADVICE r3): for DENSE X the reference's
+    "last offset == row count" enforce is applied below; for
+    padded+lengths X the true token count is a traced value, so a target
+    claiming MORE tokens than X holds cannot be rejected at trace time —
+    the out-of-range gathers resolve to zero-filled rows (mode="drop"
+    scatter + clip gather). Callers feeding dynamic lengths own that
+    invariant."""
     x = one(ins, "X")
     in_lens = (ins.get("XLengths") or [None])[0]
     y = (ins.get("Y") or [None])[0]

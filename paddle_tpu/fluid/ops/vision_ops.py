@@ -356,3 +356,61 @@ def lstmp(ctx, ins, attrs):
             "BatchedProjection": proj, "BatchedCell": cell,
             "BatchedInput": x, "BatchedHidden": cell,
             "OrderedP0": r0}
+
+
+def _align_corners_axis(x, out_n, axis):
+    """Resample one spatial axis with the reference's align-corners ratio
+    (in-1)/(out-1): corners map to corners exactly."""
+    in_n = x.shape[axis]
+    if out_n == in_n:
+        return x
+    if out_n == 1 or in_n == 1:
+        idx = jnp.zeros((out_n,), jnp.int32)
+        return jnp.take(x, idx, axis=axis)
+    src = jnp.arange(out_n, dtype=jnp.float32) * ((in_n - 1) / (out_n - 1))
+    lo = jnp.floor(src).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, in_n - 1)
+    frac = (src - lo).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[axis] = out_n
+    frac = frac.reshape(shape)
+    return (jnp.take(x, lo, axis=axis) * (1 - frac)
+            + jnp.take(x, hi, axis=axis) * frac)
+
+
+@register_op("bilinear_interp",
+             ref="paddle/fluid/operators/bilinear_interp_op.cc")
+def bilinear_interp(ctx, ins, attrs):
+    """Bilinear resize of NCHW feature maps to (out_h, out_w) with the
+    reference's ALIGN-CORNERS ratio (bilinear_interp_op.h: ratio =
+    (in-1)/(out-1)), implemented as two separable 1-D lerps."""
+    x = one(ins, "X")
+    out_h, out_w = int(attrs["out_h"]), int(attrs["out_w"])
+    out = _align_corners_axis(x, out_h, axis=2)
+    out = _align_corners_axis(out, out_w, axis=3)
+    return {"Out": out}
+
+
+@register_op("nearest_interp",
+             ref="paddle/fluid/operators/math/unpooling.cc (legacy upsample)")
+def nearest_interp(ctx, ins, attrs):
+    """Nearest-neighbour resize of NCHW maps to (out_h, out_w): the
+    legacy upsample_layer's mapping src = floor(i * in / out)."""
+    x = one(ins, "X")
+    out_h, out_w = int(attrs["out_h"]), int(attrs["out_w"])
+    h, w = x.shape[2], x.shape[3]
+    hi = (jnp.arange(out_h) * h // out_h).astype(jnp.int32)
+    wi = (jnp.arange(out_w) * w // out_w).astype(jnp.int32)
+    return {"Out": jnp.take(jnp.take(x, hi, axis=2), wi, axis=3)}
+
+
+@register_op("sampling_id", needs_rng=True,
+             ref="paddle/fluid/operators/sampling_id_op.cc")
+def sampling_id(ctx, ins, attrs):
+    """Sample one index per row from each row's probability distribution
+    (rows need not be normalized; jax.random.categorical works on logits,
+    so take log of the clipped probabilities)."""
+    x = one(ins, "X")
+    logits = jnp.log(jnp.clip(x.astype(jnp.float32), 1e-30, None))
+    ids = jax.random.categorical(ctx.rng(attrs), logits, axis=-1)
+    return {"Out": ids.astype(jnp.int64)}

@@ -48,6 +48,12 @@ def data(name, type: _DataType, **kwargs):
 
 
 def fc_layer(input, size, act=None, **kwargs):
+    # sequence inputs ([N, T, D]) project per-timestep, as the legacy
+    # config parser did for fc over a sequence layer
+    ref = input[0] if isinstance(input, (list, tuple)) else input
+    if "num_flatten_dims" not in kwargs and getattr(ref, "shape", None) \
+            is not None and len(ref.shape) == 3:
+        kwargs["num_flatten_dims"] = 2
     return _fl.fc(input=input, size=size, act=_act_name(act), **kwargs)
 
 
@@ -811,3 +817,376 @@ def recurrent_layer(input, act=None, reverse=False, **kwargs):
         return h
 
     return recurrent_group(step=step, input=input, reverse=reverse)
+
+
+# --- round-4 DSL breadth: the long tail of trainer_config_helpers/layers.py
+# mapped onto fluid ops (reference layers.py — 109 layer types; each function
+# below names its reference counterpart) ------------------------------------
+
+
+def data_layer(name, size, **kwargs):
+    """reference data_layer(name, size): raw config-helper spelling —
+    v2's data(name, type) wraps it; size is the flat feature dim."""
+    return data(name, data_type.dense_vector(size), **kwargs)
+
+
+def cross_entropy(input, label, **kwargs):
+    """reference cross_entropy (config-helper spelling of the cost)."""
+    return classification_cost(input, label)
+
+
+def batch_norm_layer(input, act=None, bias_attr=None, param_attr=None,
+                     use_global_stats=None, moving_average_fraction=0.9,
+                     **kwargs):
+    """reference batch_norm_layer -> fluid batch_norm."""
+    return _fl.batch_norm(
+        input, act=_act_name(act),
+        is_test=bool(use_global_stats) if use_global_stats is not None
+        else False,
+        momentum=moving_average_fraction,
+        param_attr=param_attr, bias_attr=bias_attr)
+
+
+def tensor_layer(a, b, size, act=None, **kwargs):
+    """reference tensor_layer: out_k = a^T W_k b (a bilinear form per
+    output) -> fluid bilinear_tensor_product op."""
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("tensor_layer")
+    w = helper.create_parameter(
+        helper.param_attr,
+        shape=[size, int(a.shape[-1]), int(b.shape[-1])], dtype=a.dtype)
+    out = _raw_op("bilinear_tensor_product",
+                  {"X": [a], "Y": [b], "Weight": [w]})
+    name = _act_name(act)
+    return getattr(_fl, name)(out) if name else out
+
+
+def gated_unit_layer(input, size, act=None, gate_act=None, **kwargs):
+    """reference gated_unit_layer: act(fc(x)) * gate_act(fc(x))."""
+    proj = _fl.fc(input=input, size=size, act=_act_name(act))
+    gate = _fl.fc(input=input, size=size,
+                  act=_act_name(gate_act) or "sigmoid")
+    return _fl.elementwise_mul(proj, gate)
+
+
+def prelu_layer(input, partial_sum=1, param_attr=None, **kwargs):
+    """reference prelu_layer: partial_sum counts elements SHARING one
+    alpha — 1 = element-wise (the reference default), the whole feature =
+    one shared alpha. Intermediate groupings (a specific channel/pixel
+    tiling) are not representable here; they map to the shared form."""
+    mode = "element" if partial_sum == 1 else "all"
+    return _fl.prelu(input, mode=mode, param_attr=param_attr)
+
+
+def multiplex_layer(input, **kwargs):
+    """reference multiplex_layer: input[0] is the per-row selector index,
+    the rest are candidate tensors."""
+    index, candidates = input[0], list(input[1:])
+    return _fl.multiplex(candidates, index)
+
+
+def kmax_seq_score_layer(input, beam_size=1, **kwargs):
+    """reference kmax_seq_score_layer: top-k scores over the sequence
+    axis. Padded positions are masked to -inf first when the input
+    carries lengths — beam scores are log-probs (negative), so unmasked
+    zero padding would otherwise win the top-k."""
+    from ..fluid.layers.sequence import seq_lengths_of
+
+    scores = input
+    lens = seq_lengths_of(input)
+    if scores.shape is not None and len(scores.shape) == 3 \
+            and scores.shape[-1] == 1:
+        scores = _fl.reshape(scores, shape=[0, -1])  # [N, T, 1] -> [N, T]
+    if lens is not None:
+        from ..fluid.layers.sequence import sequence_mask as _seq_mask
+
+        mask = _seq_mask(lens, maxlen_ref=scores, dtype="float32")  # [N,T]
+        # masked = scores*mask + (mask-1)*1e30: valid scores unchanged,
+        # padding pushed to -1e30 so it can never enter the top-k
+        neg = _fl.scale(_fl.elementwise_sub(
+            mask, _fl.fill_constant(shape=[1], dtype=scores.dtype,
+                                    value=1.0)), scale=1e30)
+        scores = _fl.elementwise_add(_fl.elementwise_mul(scores, mask), neg)
+    vals, _ = _fl.topk(scores, k=beam_size)
+    return vals
+
+
+def sub_seq_layer(input, offsets, sizes, **kwargs):
+    """reference sub_seq_layer -> sequence_slice op (per-sequence
+    offset/size; padded form masks outside the slice)."""
+    return _raw_op("sequence_slice",
+                   {"X": [input], "Offset": [offsets], "Length": [sizes]})
+
+
+def switch_order_layer(input, reshape_axis=None, **kwargs):
+    """reference switch_order_layer: NCHW <-> NHWC (reshape_axis names the
+    split point; the legacy configs only use the [3] <-> channel-last
+    form)."""
+    return _fl.transpose(input, perm=[0, 2, 3, 1])
+
+
+def upsample_layer(input, scale=2, upsample_size=None, **kwargs):
+    """reference upsample_layer (nearest): integer `scale` repeats
+    rows/cols via expand; an explicit `upsample_size` (or (w, h) pair)
+    resizes to exactly that via the nearest_interp op."""
+    n, c, h, w = [int(s) if s != -1 else -1 for s in input.shape]
+    if upsample_size is not None:
+        if isinstance(upsample_size, (list, tuple)):
+            ow, oh = int(upsample_size[0]), int(upsample_size[1])
+        else:
+            ow = oh = int(upsample_size)
+        return _raw_op("nearest_interp", {"X": [input]},
+                       {"out_h": oh, "out_w": ow})
+    x = _fl.reshape(input, shape=[-1, c, h, 1, w, 1])
+    x = _fl.expand(x, expand_times=[1, 1, 1, scale, 1, scale])
+    return _fl.reshape(x, shape=[-1, c, h * scale, w * scale])
+
+
+def warp_ctc_layer(input, label, blank=0, norm_by_times=False, **kwargs):
+    """reference warp_ctc_layer -> fluid warpctc."""
+    return _fl.warpctc(input, label, blank=blank,
+                       norm_by_times=norm_by_times)
+
+
+def factorization_machine(input, factor_size, **kwargs):
+    """reference factorization_machine: second-order interactions
+    0.5 * sum_f [(sum_i v_if x_i)^2 - sum_i (v_if x_i)^2]."""
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("factorization_machine")
+    d = int(input.shape[-1])
+    v = helper.create_parameter(helper.param_attr, shape=[d, factor_size],
+                                dtype=input.dtype)
+    xv = _fl.matmul(input, v)                      # [N, F]
+    sq_of_sum = _fl.elementwise_mul(xv, xv)
+    x2 = _fl.elementwise_mul(input, input)
+    v2 = _fl.elementwise_mul(v, v)
+    sum_of_sq = _fl.matmul(x2, v2)                 # [N, F]
+    diff = _fl.elementwise_sub(sq_of_sum, sum_of_sq)
+    return _fl.scale(_fl.reduce_sum(diff, dim=-1, keep_dim=True), scale=0.5)
+
+
+def img_conv3d_layer(input, filter_size, num_filters, stride=1, padding=0,
+                     act=None, **kwargs):
+    """reference img_conv3d_layer -> conv3d op (NCDHW, OIDHW filter)."""
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("img_conv3d")
+    k = [filter_size] * 3 if isinstance(filter_size, int) else list(filter_size)
+    c = int(input.shape[1])
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[num_filters, c] + k,
+                                dtype=input.dtype)
+    out = _raw_op("conv3d", {"Input": [input], "Filter": [w]},
+                  {"strides": [stride] * 3, "paddings": [padding] * 3},
+                  out_slots=("Output",))
+    name = _act_name(act)
+    return getattr(_fl, name)(out) if name else out
+
+
+def img_pool3d_layer(input, pool_size, stride=1, padding=0, pool_type=None,
+                     **kwargs):
+    """reference img_pool3d_layer -> pool3d op."""
+    kind = pool_type.kind if isinstance(pool_type, _Pool) else (
+        pool_type or "max")
+    if kind in ("average", "sqrt", "sum"):
+        kind = "avg"
+    k = [pool_size] * 3 if isinstance(pool_size, int) else list(pool_size)
+    return _raw_op("pool3d", {"X": [input]},
+                   {"pooling_type": kind, "ksize": k,
+                    "strides": [stride] * 3, "paddings": [padding] * 3})
+
+
+def cross_channel_norm_layer(input, param_attr=None, **kwargs):
+    """reference cross_channel_norm_layer: per-pixel L2 norm across
+    channels with a learned per-channel scale (the SSD conv4_3 norm)."""
+    from ..fluid.layer_helper import LayerHelper
+
+    helper = LayerHelper("cross_channel_norm", param_attr=param_attr)
+    c = int(input.shape[1])
+    normed = _fl.l2_normalize(input, axis=1)
+    s = helper.create_parameter(helper.param_attr, shape=[1, c, 1, 1],
+                                dtype=input.dtype)
+    return _fl.elementwise_mul(normed, s)
+
+
+def priorbox_layer(input, image, min_size, max_size=None, aspect_ratio=None,
+                   variance=(0.1, 0.1, 0.2, 0.2), **kwargs):
+    """reference priorbox_layer -> fluid prior_box (SSD anchors)."""
+    from ..fluid.layers import detection as _det
+
+    boxes, variances = _det.prior_box(
+        input, image, min_sizes=list(min_size),
+        max_sizes=list(max_size) if max_size else None,
+        aspect_ratios=list(aspect_ratio) if aspect_ratio else [1.0],
+        variance=list(variance))
+    # legacy layout: [P, 8] = boxes || variances per prior — EXACTLY what
+    # detection_output_layer splits back apart
+    from ..fluid.layers import tensor as _t
+
+    b = _fl.reshape(boxes, shape=[-1, 4])
+    v = _fl.reshape(variances, shape=[-1, 4])
+    return _t.concat([b, v], axis=1)
+
+
+def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
+                           nms_threshold=0.45, nms_top_k=400, keep_top_k=200,
+                           confidence_threshold=0.01, background_id=0,
+                           **kwargs):
+    """reference detection_output_layer -> fluid detection_output (decode +
+    per-class NMS). priorbox here is the [P, 8] concat the legacy layer
+    produced (boxes||variances); fluid takes them separately."""
+    from ..fluid.layers import detection as _det
+
+    p = int(priorbox.shape[-1]) // 2 if priorbox.shape is not None else None
+    boxes = _raw_op("slice", {"Input": [priorbox]},
+                    {"axes": [len(priorbox.shape) - 1], "starts": [0],
+                     "ends": [p]}) if p else priorbox
+    var = _raw_op("slice", {"Input": [priorbox]},
+                  {"axes": [len(priorbox.shape) - 1], "starts": [p],
+                   "ends": [2 * p]}) if p else priorbox
+    return _det.detection_output(
+        input_loc, input_conf, boxes, var,
+        nms_threshold=nms_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, score_threshold=confidence_threshold,
+        background_label=background_id)
+
+
+def selective_fc_layer(input, size, select=None, act=None, **kwargs):
+    """reference selective_fc_layer: a full fc whose output is masked to
+    the selected columns (the reference computes only selected columns;
+    on the MXU the dense matmul + mask IS the fast form)."""
+    out = _fl.fc(input=input, size=size, act=_act_name(act))
+    if select is not None:
+        out = _fl.elementwise_mul(out, select)
+    return out
+
+
+def eos_layer(input, eos_id, **kwargs):
+    """reference eos_layer: 1.0 where the id equals eos_id."""
+    eos = _fl.fill_constant(shape=[1], dtype=input.dtype, value=eos_id)
+    return _fl.cast(_fl.equal(input, eos), "float32")
+
+
+def get_output_layer(input, arg_name=None, **kwargs):
+    """reference get_output_layer: project out a named auxiliary output of
+    a multi-output layer. Fluid layers return their outputs directly, so
+    this is the identity on whichever output the caller picked."""
+    return input
+
+
+def cross_entropy_with_selfnorm(input, label, softmax_selfnorm_alpha=0.1,
+                                **kwargs):
+    """reference cross_entropy_with_selfnorm: CE + alpha * (log Z)^2
+    self-normalization on the softmax partition function."""
+    ce = _fl.cross_entropy(input=input, label=label)
+    z = _fl.reduce_sum(input, dim=-1, keep_dim=True)
+    logz = _raw_op("log", {"X": [z]})
+    penalty = _fl.scale(_fl.elementwise_mul(logz, logz),
+                        scale=float(softmax_selfnorm_alpha))
+    return _fl.mean(_fl.elementwise_add(ce, penalty))
+
+
+def scaling_projection(input, **kwargs):
+    """reference scaling_projection: one learned scalar times the input."""
+    def realize(sz):
+        from ..fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper("scaling_projection")
+        s = helper.create_parameter(helper.param_attr, shape=[1],
+                                    dtype=input.dtype)
+        return _fl.elementwise_mul(input, s)
+
+    return _Projection(realize)
+
+
+def trans_full_matrix_projection(input, size=None, **kwargs):
+    """reference trans_full_matrix_projection: project through W^T (shares
+    no weight here — the legacy sharing came from param_attr naming, which
+    callers can still pass)."""
+    def realize(sz):
+        from ..fluid.layer_helper import LayerHelper
+
+        helper = LayerHelper("trans_full_matrix_projection")
+        w = helper.create_parameter(helper.param_attr,
+                                    shape=[sz, int(input.shape[-1])],
+                                    dtype=input.dtype)
+        return _fl.matmul(input, w, transpose_y=True)
+
+    return _Projection(realize)
+
+
+def slice_projection(input, slices, **kwargs):
+    """reference slice_projection: concat of [start, end) column slices."""
+    def realize(sz):
+        parts = []
+        axis = len(input.shape) - 1
+        for start, end in slices:
+            parts.append(_raw_op("slice", {"Input": [input]},
+                                 {"axes": [axis], "starts": [start],
+                                  "ends": [end]}))
+        if len(parts) == 1:
+            return parts[0]
+        from ..fluid.layers import tensor as _t
+
+        return _t.concat(parts, axis=axis)
+
+    return _Projection(realize)
+
+
+def conv_projection(input, filter_size, num_filters, stride=1, padding=0,
+                    **kwargs):
+    """reference conv_projection (a conv2d usable inside mixed_layer)."""
+    def realize(sz):
+        return _fl.conv2d(input, num_filters=num_filters,
+                          filter_size=filter_size, stride=stride,
+                          padding=padding)
+
+    return _Projection(realize)
+
+
+def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
+                  stride=1, padding=0, **kwargs):
+    """reference conv_operator: convolve `img` with a COMPUTED filter
+    tensor (not a parameter). Lowered as grouped correlation via matmul on
+    im2sequence patches."""
+    k = int(filter_size)
+    c = int(img.shape[1])
+    h, w = int(img.shape[2]), int(img.shape[3])
+    oh = (h + 2 * padding - k) // stride + 1
+    ow = (w + 2 * padding - k) // stride + 1
+    patches = _fl.im2sequence(img, filter_size=k, stride=stride,
+                              padding=padding)  # [N*L, C*k*k] (LoD-flat)
+    patches = _fl.reshape(patches, shape=[-1, oh * ow, c * k * k])
+    fil = _fl.reshape(filter, shape=[-1, num_filters, c * k * k])
+    out = _fl.matmul(patches, _fl.transpose(fil, perm=[0, 2, 1]))
+    return out  # [N, L, num_filters] (caller reshapes to NCHW if needed)
+
+
+def block_expand_layer(input, block_x, block_y, stride_x=1, stride_y=1,
+                       padding_x=0, padding_y=0, num_channels=None,
+                       **kwargs):
+    """reference block_expand_layer -> fluid im2sequence (im2col as a
+    sequence of flattened blocks, the OCR-CTC front end)."""
+    return _fl.im2sequence(input, filter_size=[block_y, block_x],
+                           stride=[stride_y, stride_x],
+                           padding=[padding_y, padding_x])
+
+
+def repeat_layer_as_seq(input, num_repeats, **kwargs):
+    """alias used by some legacy configs; same as repeat_layer."""
+    return repeat_layer(input, num_repeats)
+
+
+def bilinear_interp_layer(input, out_size_x, out_size_y, **kwargs):
+    """reference bilinear_interp_layer -> bilinear_interp op
+    (jax.image.resize under the hood)."""
+    return _raw_op("bilinear_interp", {"X": [input]},
+                   {"out_h": int(out_size_y), "out_w": int(out_size_x)})
+
+
+def sampling_id_layer(input, **kwargs):
+    """reference sampling_id_layer -> sampling_id op: sample one id per
+    row from the input's (normalized) distribution."""
+    return _raw_op("sampling_id", {"X": [input]}, dtype="int64")

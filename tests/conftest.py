@@ -25,6 +25,13 @@ assert len(jax.devices()) == 8, jax.devices()
 import numpy as np
 import pytest
 
+# CI runs with strict shape inference: an emitter whose abstract eval
+# fails unexpectedly is a hard build-time error here, not a warning
+# (reference shape_inference.h enforce semantics).
+from paddle_tpu.fluid.flags import set_flags
+
+set_flags({"strict_shape_inference": True})
+
 
 @pytest.fixture(autouse=True)
 def _seed_numpy():

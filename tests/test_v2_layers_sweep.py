@@ -375,3 +375,365 @@ def test_recurrent_layer_and_static_input():
     assert vals[0].shape == (2, 3, 4)
     assert vals[1].shape == (2, 3, 4)
     assert all(np.isfinite(v).all() for v in vals)
+
+
+# --- round-4 DSL breadth (the long tail of trainer_config_helpers) --------
+
+
+def test_round4_dense_tail_executes():
+    x = v2.layer.data(name="x", type=v2.layer.data_type.dense_vector(6))
+    y = v2.layer.data(name="y", type=v2.layer.data_type.dense_vector(4))
+    sel = v2.layer.data(name="sel", type=v2.layer.data_type.dense_vector(5))
+    outs = [
+        v2.layer.tensor_layer(x, y, size=3),
+        v2.layer.gated_unit_layer(x, size=5),
+        v2.layer.prelu_layer(x),
+        v2.layer.factorization_machine(x, factor_size=4),
+        v2.layer.selective_fc_layer(x, size=5, select=sel),
+        v2.layer.get_output_layer(x),
+    ]
+    rng = np.random.RandomState(1)
+    feeds = {"x": rng.rand(3, 6).astype(np.float32),
+             "y": rng.rand(3, 4).astype(np.float32),
+             "sel": (rng.rand(3, 5) > 0.5).astype(np.float32)}
+    vals = _run(outs, feeds)
+    assert vals[0].shape == (3, 3)
+    assert vals[1].shape == (3, 5)
+    assert vals[3].shape == (3, 1)
+    # selective fc: deselected columns are exactly zero
+    assert np.all(vals[4][feeds["sel"] == 0] == 0)
+    np.testing.assert_array_equal(vals[5], feeds["x"])
+    assert all(np.isfinite(v).all() for v in vals)
+
+
+def test_round4_image_tail_executes():
+    img = v2.layer.data(name="img",
+                        type=v2.layer.data_type.dense_vector(3 * 8 * 8))
+    from paddle_tpu.fluid import layers as fl
+
+    x4 = fl.reshape(img, shape=[-1, 3, 8, 8])
+    vol = v2.layer.data(name="vol",
+                        type=v2.layer.data_type.dense_vector(2 * 4 * 4 * 4))
+    v5 = fl.reshape(vol, shape=[-1, 2, 4, 4, 4])
+    outs = [
+        v2.layer.batch_norm_layer(x4, act=v2.layer.activation.Relu()),
+        v2.layer.switch_order_layer(x4),
+        v2.layer.upsample_layer(x4, scale=2),
+        v2.layer.cross_channel_norm_layer(x4),
+        v2.layer.bilinear_interp_layer(x4, out_size_x=16, out_size_y=16),
+        v2.layer.img_conv3d_layer(v5, filter_size=3, num_filters=4,
+                                  padding=1,
+                                  act=v2.layer.activation.Relu()),
+        v2.layer.img_pool3d_layer(v5, pool_size=2, stride=2),
+        v2.layer.block_expand_layer(x4, block_x=4, block_y=4, stride_x=4,
+                                    stride_y=4),
+    ]
+    rng = np.random.RandomState(2)
+    feeds = {"img": rng.rand(2, 3 * 8 * 8).astype(np.float32),
+             "vol": rng.rand(2, 2 * 4 * 4 * 4).astype(np.float32)}
+    vals = _run(outs, feeds)
+    assert vals[1].shape == (2, 8, 8, 3)
+    assert vals[2].shape == (2, 3, 16, 16)
+    assert vals[4].shape == (2, 3, 16, 16)
+    assert vals[5].shape == (2, 4, 4, 4, 4)
+    assert vals[6].shape == (2, 2, 2, 2, 2)
+    # im2sequence emits the LoD-flat [N*L, C*k*k] form: 2 imgs x 4 blocks
+    assert vals[7].shape == (2 * 4, 3 * 4 * 4)
+    assert all(np.isfinite(v).all() for v in vals)
+
+
+def test_round4_seq_and_select_tail_executes():
+    seq = v2.layer.data(
+        name="seq", type=v2.layer.data_type.dense_vector_sequence(4),
+        lod_level=1)
+    scores = v2.layer.data(name="scores",
+                           type=v2.layer.data_type.dense_vector(5))
+    idx = v2.layer.data(name="idx", type=v2.layer.data_type.integer_value(2))
+    a = v2.layer.data(name="a", type=v2.layer.data_type.dense_vector(3))
+    b = v2.layer.data(name="b", type=v2.layer.data_type.dense_vector(3))
+    off = v2.layer.data(name="off", type=v2.layer.data_type.integer_value(5))
+    ln = v2.layer.data(name="ln", type=v2.layer.data_type.integer_value(5))
+    outs = [
+        v2.layer.kmax_seq_score_layer(scores, beam_size=3),
+        v2.layer.multiplex_layer([idx, a, b]),
+        v2.layer.sub_seq_layer(seq, off, ln),
+        v2.layer.eos_layer(idx, eos_id=1),
+    ]
+    rng = np.random.RandomState(3)
+    feeds = {"scores": rng.rand(2, 5).astype(np.float32),
+             "idx": np.array([[0], [1]], np.int64),
+             "a": rng.rand(2, 3).astype(np.float32),
+             "b": rng.rand(2, 3).astype(np.float32),
+             "seq": rng.rand(2, 5, 4).astype(np.float32),
+             "seq@LEN": np.array([5, 3], np.int32),
+             "off": np.array([[1], [0]], np.int64),
+             "ln": np.array([[2], [3]], np.int64)}
+    vals = _run(outs, feeds)
+    np.testing.assert_allclose(
+        vals[0], np.sort(feeds["scores"], axis=1)[:, ::-1][:, :3], rtol=1e-6)
+    np.testing.assert_allclose(vals[1][0], feeds["a"][0], rtol=1e-6)
+    np.testing.assert_allclose(vals[1][1], feeds["b"][1], rtol=1e-6)
+    np.testing.assert_allclose(vals[3], [[0.0], [1.0]])
+    # sub_seq masks outside [offset, offset+len)
+    assert np.all(vals[2][0, 0] == 0) and np.all(vals[2][0, 3:] == 0)
+    assert np.all(vals[2][1, 3:] == 0)
+
+
+def test_round4_projections_and_costs_execute():
+    x = v2.layer.data(name="x", type=v2.layer.data_type.dense_vector(6))
+    logits = v2.layer.data(name="p", type=v2.layer.data_type.dense_vector(4))
+    label = v2.layer.data(name="l", type=v2.layer.data_type.integer_value(4))
+    probs = v2.layer.softmax_layer(logits)
+    outs = [
+        v2.layer.mixed_layer(size=6, input=[v2.layer.scaling_projection(x)]),
+        v2.layer.mixed_layer(
+            size=5, input=[v2.layer.trans_full_matrix_projection(x)]),
+        v2.layer.mixed_layer(
+            size=4, input=[v2.layer.slice_projection(x, [(0, 2), (4, 6)])]),
+        v2.layer.cross_entropy_with_selfnorm(probs, label),
+        v2.layer.cross_entropy(probs, label),
+        v2.layer.sampling_id_layer(probs),
+    ]
+    rng = np.random.RandomState(4)
+    feeds = {"x": rng.rand(3, 6).astype(np.float32),
+             "p": rng.rand(3, 4).astype(np.float32),
+             "l": np.array([[0], [1], [3]], np.int64)}
+    vals = _run(outs, feeds)
+    assert vals[0].shape == (3, 6)
+    assert vals[1].shape == (3, 5)
+    assert vals[2].shape == (3, 4)
+    assert vals[2].dtype == np.float32
+    np.testing.assert_allclose(
+        vals[2], np.concatenate([feeds["x"][:, 0:2], feeds["x"][:, 4:6]], 1),
+        rtol=1e-6)
+    assert np.isfinite(vals[3]).all() and np.isfinite(vals[4]).all()
+    assert vals[5].shape[0] == 3 and np.all((vals[5] >= 0) & (vals[5] < 4))
+
+
+def test_round4_detection_and_conv_operator_execute():
+    img = v2.layer.data(name="img",
+                        type=v2.layer.data_type.dense_vector(3 * 16 * 16))
+    from paddle_tpu.fluid import layers as fl
+
+    x4 = fl.reshape(img, shape=[-1, 3, 16, 16])
+    feat = v2.layer.img_conv_layer(x4, filter_size=3, num_filters=4,
+                                   padding=1)
+    boxes = v2.layer.priorbox_layer(feat, x4, min_size=[4.0],
+                                    aspect_ratio=[1.0, 2.0])
+    filt = v2.layer.data(name="filt",
+                         type=v2.layer.data_type.dense_vector(2 * 3 * 3 * 3))
+    conv_out = v2.layer.conv_operator(x4, filt, filter_size=3,
+                                      num_filters=2, padding=1)
+    rng = np.random.RandomState(5)
+    feeds = {"img": rng.rand(2, 3 * 16 * 16).astype(np.float32),
+             "filt": rng.rand(2, 2 * 3 * 3 * 3).astype(np.float32)}
+    vals = _run([boxes, conv_out], feeds)
+    # legacy [P, 8] boxes||variances layout (what detection_output_layer
+    # splits back apart)
+    assert vals[0].ndim == 2 and vals[0].shape[-1] == 8
+    assert vals[1].shape == (2, 16 * 16, 2)
+    assert all(np.isfinite(v).all() for v in vals)
+
+
+def test_round4_warp_ctc_executes():
+    logits = v2.layer.data(
+        name="lg", type=v2.layer.data_type.dense_vector_sequence(6),
+        lod_level=1)
+    lbl = v2.layer.data(
+        name="lb", type=v2.layer.data_type.integer_value_sequence(5),
+        lod_level=1)
+    cost = v2.layer.warp_ctc_layer(logits, lbl, blank=0)
+    rng = np.random.RandomState(6)
+    feeds = {"lg": rng.rand(2, 7, 6).astype(np.float32),
+             "lg@LEN": np.array([7, 5], np.int32),
+             "lb": np.array([[1, 2, 0], [3, 0, 0]], np.int64)[:, :, None],
+             "lb@LEN": np.array([2, 1], np.int32)}
+    (val,) = _run([cost], feeds)
+    assert val.shape[0] == 2 and np.isfinite(val).all()
+
+
+# --- round-4 goldens: 3 -> 10 topologies (reference
+# trainer_config_helpers/tests/ protostr coverage of the canonical demo
+# configs: NMT seq2seq w/ attention, tagger, VGG, word2vec, recommender,
+# custom recurrent_group, text CNN) ----------------------------------------
+
+
+def test_golden_nmt_attention_config():
+    """Attention seq2seq (reference demo machine_translation config):
+    bi-GRU encoder, Bahdanau attention inside a recurrent_group decoder."""
+    src = v2.layer.data(
+        name="src", type=v2.layer.data_type.integer_value_sequence(100),
+        lod_level=1)
+    trg = v2.layer.data(
+        name="trg", type=v2.layer.data_type.integer_value_sequence(100),
+        lod_level=1)
+    semb = v2.layer.embedding_layer(src, size=8)
+    enc = v2.networks.bidirectional_gru(semb, size=4, return_seq=True)
+    enc_proj = v2.layer.mixed_layer(
+        size=8, input=[v2.layer.full_matrix_projection(enc)])
+    temb = v2.layer.embedding_layer(trg, size=8)
+
+    def decoder_step(t_emb, enc_s, proj_s):
+        state = v2.layer.memory(size=8)
+        ctxv = v2.networks.simple_attention(enc_s, proj_s, state)
+        inp = v2.layer.fc_layer([t_emb, ctxv], size=8, act=None)
+        gru = v2.layer.gru_step_layer(inp, state, size=8)
+        return gru
+
+    dec = v2.layer.recurrent_group(
+        step=decoder_step,
+        input=[temb, v2.layer.StaticInput(enc),
+               v2.layer.StaticInput(enc_proj)])
+    out = v2.layer.fc_layer(dec, size=100,
+                            act=v2.layer.activation.Softmax())
+    _golden_check("nmt_attention", v2.topology.Topology(out))
+
+
+def test_golden_bilstm_tagger_config():
+    """Bidirectional LSTM sequence tagger with CRF cost (reference demo
+    sequence_tagging config)."""
+    words = v2.layer.data(
+        name="words", type=v2.layer.data_type.integer_value_sequence(200),
+        lod_level=1)
+    tags = v2.layer.data(
+        name="tags", type=v2.layer.data_type.integer_value_sequence(5),
+        lod_level=1)
+    emb = v2.layer.embedding_layer(words, size=8)
+    bi = v2.networks.bidirectional_lstm(emb, size=6, return_seq=True)
+    feat = v2.layer.fc_layer(bi, size=5, act=None)
+    crf = v2.layer.crf_layer(feat, tags)
+    _golden_check("bilstm_tagger", v2.topology.Topology(crf))
+
+
+def test_golden_vgg16_config():
+    img = v2.layer.data(name="img",
+                        type=v2.layer.data_type.dense_vector(3 * 32 * 32))
+    from paddle_tpu.fluid import layers as fl
+
+    x4 = fl.reshape(img, shape=[-1, 3, 32, 32])
+    out = v2.networks.vgg_16_network(x4, num_channels=3, num_classes=10)
+    _golden_check("vgg16", v2.topology.Topology(out))
+
+
+def test_golden_word2vec_config():
+    """N-gram word embedding model (reference book ch4 / demo word2vec
+    config): 4 context words -> projected -> hsigmoid-style softmax."""
+    ctx_words = [
+        v2.layer.data(name=f"w{i}",
+                      type=v2.layer.data_type.integer_value(1000))
+        for i in range(4)
+    ]
+    embs = [v2.layer.embedding_layer(w, size=16,
+                                     param_attr=fluid.ParamAttr(name="emb"))
+            for w in ctx_words]
+    merged = v2.layer.addto_layer(embs)
+    hidden = v2.layer.fc_layer(merged, size=32,
+                               act=v2.layer.activation.Sigmoid())
+    out = v2.layer.fc_layer(hidden, size=1000,
+                            act=v2.layer.activation.Softmax())
+    _golden_check("word2vec", v2.topology.Topology(out))
+
+
+def test_golden_recommender_twin_tower_config():
+    """Twin-tower recommender (reference demo recommendation config): user
+    and item towers -> cosine similarity."""
+    uid = v2.layer.data(name="uid",
+                        type=v2.layer.data_type.integer_value(500))
+    mid = v2.layer.data(name="mid",
+                        type=v2.layer.data_type.integer_value(800))
+    genres = v2.layer.data(name="genres",
+                           type=v2.layer.data_type.dense_vector(18))
+    u = v2.layer.fc_layer(v2.layer.embedding_layer(uid, size=16), size=16,
+                          act=v2.layer.activation.Tanh())
+    m_emb = v2.layer.embedding_layer(mid, size=16)
+    m_gen = v2.layer.fc_layer(genres, size=16, act=None)
+    m = v2.layer.fc_layer(v2.layer.addto_layer([m_emb, m_gen]), size=16,
+                          act=v2.layer.activation.Tanh())
+    sim = v2.layer.cos_sim(u, m)
+    _golden_check("recommender", v2.topology.Topology(sim))
+
+
+def test_golden_recurrent_group_custom_step_config():
+    """Custom recurrent_group step mixing a static input and two memories
+    (the legacy API's hallmark flexibility)."""
+    seq = v2.layer.data(
+        name="seq", type=v2.layer.data_type.dense_vector_sequence(6),
+        lod_level=1)
+    bias = v2.layer.data(name="bias",
+                         type=v2.layer.data_type.dense_vector(6))
+
+    def step(x_t, b):
+        h_prev = v2.layer.memory(size=6)
+        c_prev = v2.layer.memory(size=6)
+        xt = v2.layer.addto_layer([x_t, b])
+        h = v2.layer.fc_layer([xt, h_prev], size=6,
+                              act=v2.layer.activation.Tanh())
+        c = v2.layer.addto_layer([c_prev, h])
+        return h, c
+
+    h, c = v2.layer.recurrent_group(
+        step=step, input=[seq, v2.layer.StaticInput(bias)])
+    out = v2.layer.fc_layer(v2.layer.last_seq(c), size=2,
+                            act=v2.layer.activation.Softmax())
+    _golden_check("recurrent_custom", v2.topology.Topology(out))
+
+
+def test_golden_text_conv_config():
+    """Text CNN sentiment classifier (reference demo sentiment /
+    understand_sentiment convpool config)."""
+    words = v2.layer.data(
+        name="words", type=v2.layer.data_type.integer_value_sequence(300),
+        lod_level=1)
+    emb = v2.layer.embedding_layer(words, size=16)
+    conv3 = v2.networks.sequence_conv_pool(emb, context_len=3,
+                                           hidden_size=12)
+    conv4 = v2.networks.sequence_conv_pool(emb, context_len=4,
+                                           hidden_size=12)
+    out = v2.layer.fc_layer([conv3, conv4], size=2,
+                            act=v2.layer.activation.Softmax())
+    _golden_check("text_conv", v2.topology.Topology(out))
+
+
+def test_round4_review_semantics():
+    """Pins the round-4 review fixes: align-corners bilinear, explicit
+    upsample_size, element-wise prelu default, priorbox->detection_output
+    composition, and length-masked kmax scores."""
+    from paddle_tpu.fluid import layers as fl
+
+    img = v2.layer.data(name="img",
+                        type=v2.layer.data_type.dense_vector(1 * 4 * 4))
+    x4 = fl.reshape(img, shape=[-1, 1, 4, 4])
+    up_sz = v2.layer.upsample_layer(x4, upsample_size=(7, 5))  # (w, h)
+    bil = v2.layer.bilinear_interp_layer(x4, out_size_x=7, out_size_y=7)
+    pre = v2.layer.prelu_layer(x4)  # partial_sum=1 -> element-wise
+    scores = v2.layer.data(
+        name="sc", type=v2.layer.data_type.dense_vector_sequence(1),
+        lod_level=1)
+    kmax = v2.layer.kmax_seq_score_layer(scores, beam_size=2)
+
+    rng = np.random.RandomState(9)
+    x_np = rng.rand(2, 16).astype(np.float32)
+    # all-NEGATIVE scores with padding: top-k must come from valid steps
+    sc_np = -1.0 - rng.rand(2, 4, 1).astype(np.float32)
+    feeds = {"img": x_np, "sc": sc_np,
+             "sc@LEN": np.array([4, 2], np.int32)}
+    vals = _run([up_sz, bil, pre, kmax], feeds)
+    assert vals[0].shape == (2, 1, 5, 7)
+    # align-corners: corners of the resized map equal the input corners
+    x_img = x_np.reshape(2, 1, 4, 4)
+    np.testing.assert_allclose(vals[1][:, :, 0, 0], x_img[:, :, 0, 0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(vals[1][:, :, -1, -1], x_img[:, :, -1, -1],
+                               rtol=1e-6)
+    assert vals[1].shape == (2, 1, 7, 7)
+    # prelu with alpha=0.25 init: positive inputs unchanged
+    np.testing.assert_allclose(vals[2], x_img, rtol=1e-6)
+    # the element-wise alpha parameter has x.shape[1:] elements
+    prog = fluid.default_main_program()
+    alpha = next(v for n, v in prog.global_block().vars.items()
+                 if "prelu" in n and getattr(v.desc, "is_parameter", False))
+    assert int(np.prod(alpha.shape)) == 1 * 4 * 4
+    # kmax over padded all-negative scores: row 1 has only 2 valid steps;
+    # its top-2 are its OWN scores, not the padding zeros
+    want = np.sort(sc_np[1, :2, 0])[::-1]
+    np.testing.assert_allclose(vals[3][1], want, rtol=1e-5)
