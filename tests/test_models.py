@@ -170,3 +170,30 @@ def test_legacy_benchmark_models_train_step(name):
             (loss,) = exe.run(main, feed={"img": x, "label": y},
                               fetch_list=[avg_cost])
             assert np.isfinite(loss).all()
+
+
+def test_fluid_benchmark_suite_quick_mode():
+    """The reference benchmark/fluid suite's remaining workloads (mnist,
+    vgg, stacked_dynamic_lstm) run end-to-end through the bench harness in
+    CPU quick mode: one JSON line each, finite losses that move."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["SUITE_ALLOW_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "benchmarks",
+                                      "fluid_suite_bench.py")],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.strip().startswith("{")]
+    by_name = {r.get("workload"): r for r in rows}
+    assert set(by_name) == {"mnist", "vgg", "stacked_lstm"}, rows
+    for r in by_name.values():
+        assert r["finite"] and r["distinct_losses"] >= 2, r
+        assert r["quick_mode"] and r["backend"] == "cpu", r

@@ -31,7 +31,9 @@ JUSTIFIED_SKIPS = {
     # program — the capability is distributed/param_server.ParameterServer
     # (start_pserver), which RUNS the pserver program behind RPC
     "listen_and_serv": "distributed/param_server.ParameterServer service",
-    "prefetch": "sparse params pull via ParameterClient.get_param/recv op",
+    # prefetch is no longer skipped: it is a REAL executor host op
+    # (executor._run_prefetch_ops + pserver get_rows RPC, row-granular
+    # pull) and is covered via _SKIP_OP_TYPES below,
     # NCCL bootstrap: XLA GSPMD inserts collectives; no communicator var
     "nccl": "jax.distributed + GSPMD collectives replace ncclInit",
     # LoD plumbing the padded+lengths redesign makes structural:
