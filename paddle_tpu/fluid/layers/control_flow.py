@@ -16,9 +16,9 @@ from ..layer_helper import LayerHelper
 
 __all__ = [
     "While", "StaticRNN", "DynamicRNN", "IfElse", "ConditionalBlock",
-    "Switch", "ParallelDo", "get_places", "increment", "array_write",
-    "array_read", "array_length", "create_array", "less_than", "equal",
-    "zeros_like_array", "Print", "lod_rank_table",
+    "Switch", "ParallelDo", "Recompute", "get_places", "increment",
+    "array_write", "array_read", "array_length", "create_array",
+    "less_than", "equal", "zeros_like_array", "Print", "lod_rank_table",
     "reorder_lod_tensor_by_rank", "max_sequence_len",
 ]
 
@@ -315,6 +315,93 @@ class ConditionalBlock:
                     "out_var_names": carried,
                 },
             )
+
+
+class Recompute:
+    """Gradient rematerialization region (TPU-native capability; the
+    2018 reference has no equivalent — its memory story is
+    memory_optimization_transpiler reuse). Ops built inside `block()`
+    lower under `jax.checkpoint`: their activations are NOT stored for
+    backward; the backward pass re-runs the region instead, trading
+    FLOPs for HBM — the standard big-model training lever on TPU.
+
+        rc = layers.Recompute()
+        with rc.block():
+            h = layers.fc(x, size=4096, act="relu")
+            h = layers.fc(h, size=4096, act="relu")
+        h = rc.output(h)
+
+    Gradients are bit-identical to the non-recompute lowering (the
+    deterministic per-op RNG makes dropout replay exactly)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("recompute", name=name)
+        self._sub = None
+        self._parent = None
+
+    @contextlib.contextmanager
+    def block(self):
+        main = self.helper.main_program
+        self._parent = main.current_block()
+        self._sub = main.create_block()
+        try:
+            yield
+        finally:
+            main.rollback()
+
+    def output(self, *out_vars):
+        """Completes the region; returns parent-block vars mirroring
+        `out_vars` (one var -> one var, several -> tuple). Writes the
+        region makes to OUTER vars (assign/increment into a parent var)
+        are carried out as additional op outputs so post-region readers
+        see the updated values."""
+        if self._sub is None:
+            raise RuntimeError("Recompute.output() must follow block()")
+        if not out_vars:
+            raise ValueError("Recompute.output() needs at least one var")
+        sub, parent = self._sub, self._parent
+        reads = _outer_reads(sub, parent)
+        # an unbounded `while` inside the region would be differentiated
+        # by the generic vjp straight through lax.while_loop (its custom
+        # recompute-replay grad only fires for a top-level while_grad op
+        # desc) — reject it here instead of a deep JAX trace error
+        for op in sub.ops:
+            if op.desc.type == "while" and not op.desc.attrs.get("max_steps"):
+                raise ValueError(
+                    "Recompute region contains a While without max_steps — "
+                    "its gradient cannot lower inside jax.checkpoint; give "
+                    "the loop max_steps or move it outside the region")
+        produced = {n for op in sub.ops for n in op.desc.output_names() if n}
+        for v in out_vars:
+            if v.name not in produced and v.name not in reads:
+                raise ValueError(
+                    f"Recompute.output(): '{v.name}' is neither produced "
+                    "nor read by the region — pass a var computed inside "
+                    "block()")
+        # outer vars the region writes IN PLACE: carried out name-for-name
+        outer_written = [
+            n for n in produced
+            if n not in sub.vars and parent._var_recursive(n) is not None
+        ]
+        outs = []
+        for i, v in enumerate(out_vars):
+            outs.append(parent.create_var(
+                name=f"{self.helper.name}.out{i}", dtype=v.dtype,
+                shape=list(v.shape) if v.shape is not None else None,
+            ))
+        parent.append_op(
+            type="recompute",
+            inputs={"X": reads},
+            outputs={"Out": outs + [parent._var_recursive(n)
+                                    for n in sorted(outer_written)]},
+            attrs={
+                "sub_block": sub.idx,
+                "x_var_names": reads,
+                "out_var_names": [v.name for v in out_vars]
+                                 + sorted(outer_written),
+            },
+        )
+        return outs[0] if len(outs) == 1 else tuple(outs)
 
 
 def get_places(device_count=0, device_type=None):

@@ -223,6 +223,29 @@ def _while_grad(ctx, fwd_ins, fwd_outs, out_grads, attrs):
 OPS["while"].grad = _while_grad
 
 
+@register_op("recompute",
+             ref="TPU-native (jax.checkpoint); the 2018 reference's memory "
+                 "lever is memory_optimization_transpiler reuse instead")
+def recompute_op(ctx, ins, attrs):
+    """Run the sub-block under jax.checkpoint: the generic vjp that
+    differentiates this emitter then REMATERIALIZES the region's
+    intermediates in the backward pass instead of storing them —
+    activation memory for the region drops to its inputs/outputs while
+    backward re-runs the forward ops (XLA CSEs what it can)."""
+    ops = _sub_op_descs(ctx, attrs)
+    x_names = list(attrs["x_var_names"])
+    out_names = list(attrs["out_var_names"])
+    xs = ins.get("X", [])
+
+    @jax.checkpoint
+    def region(vals):
+        env = dict(zip(x_names, vals))
+        exec_op_descs(ctx, ops, env)
+        return tuple(env[n] for n in out_names)
+
+    return {"Out": list(region(tuple(xs)))}
+
+
 @register_op("conditional_block", no_grad=("Condition",),
              ref="paddle/fluid/operators/conditional_block_op.cc")
 def conditional_block(ctx, ins, attrs):

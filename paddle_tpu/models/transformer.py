@@ -43,6 +43,11 @@ class TransformerConfig:
     # dims at the boundary and falls back to full rematerialization
     # (hybrid dp×tp×sp mesh, spmd_partitioner.cc:652).
     tp_axis: str = "tp"
+    # activation rematerialization: wrap each encoder/decoder layer in a
+    # layers.Recompute region (jax.checkpoint) — backward re-runs the
+    # layer instead of storing its activations, the standard TPU lever
+    # for fitting long sequences / deep stacks in HBM
+    recompute: bool = False
 
 
 def _pos_encoding_table(max_len, d_model):
@@ -151,12 +156,27 @@ def _embed(cfg, ids, vocab, name):
     return x
 
 
+def _maybe_recompute(cfg, layer_fn, x):
+    """Wrap one transformer layer in a Recompute region when
+    cfg.recompute (activation remat: backward re-runs the layer)."""
+    if not cfg.recompute:
+        return layer_fn(x)
+    rc = layers.Recompute()
+    with rc.block():
+        out = layer_fn(x)
+    return rc.output(out)
+
+
 def encoder(cfg: TransformerConfig, src_ids):
     x = _embed(cfg, src_ids, cfg.src_vocab, "enc")
     for i in range(cfg.n_layers):
-        x = _residual_ln(x, _mha(cfg, x, x, name=f"enc{i}.self"),
-                         name=f"enc{i}.a")
-        x = _residual_ln(x, _ffn(cfg, x, name=f"enc{i}"), name=f"enc{i}.b")
+        def enc_layer(x, i=i):
+            h = _residual_ln(x, _mha(cfg, x, x, name=f"enc{i}.self"),
+                             name=f"enc{i}.a")
+            return _residual_ln(h, _ffn(cfg, h, name=f"enc{i}"),
+                                name=f"enc{i}.b")
+
+        x = _maybe_recompute(cfg, enc_layer, x)
     return x
 
 
@@ -170,12 +190,16 @@ def decoder(cfg: TransformerConfig, trg_ids, enc_out):
         mask = _const_param("dec.causal_mask", causal)
     x = _embed(cfg, trg_ids, cfg.trg_vocab, "dec")
     for i in range(cfg.n_layers):
-        x = _residual_ln(x, _mha(cfg, x, x, mask=mask, causal=True,
-                                 name=f"dec{i}.self"),
-                         name=f"dec{i}.a")
-        x = _residual_ln(x, _mha(cfg, x, enc_out, name=f"dec{i}.cross"),
-                         name=f"dec{i}.b")
-        x = _residual_ln(x, _ffn(cfg, x, name=f"dec{i}"), name=f"dec{i}.c")
+        def dec_layer(x, i=i):
+            h = _residual_ln(x, _mha(cfg, x, x, mask=mask, causal=True,
+                                     name=f"dec{i}.self"),
+                             name=f"dec{i}.a")
+            h = _residual_ln(h, _mha(cfg, h, enc_out, name=f"dec{i}.cross"),
+                             name=f"dec{i}.b")
+            return _residual_ln(h, _ffn(cfg, h, name=f"dec{i}"),
+                                name=f"dec{i}.c")
+
+        x = _maybe_recompute(cfg, dec_layer, x)
     return x
 
 
