@@ -19,7 +19,8 @@ __all__ = [
     "elementwise_add", "elementwise_sub", "elementwise_mul", "elementwise_div",
     "elementwise_max", "elementwise_min", "elementwise_pow", "label_smooth",
     "sigmoid_cross_entropy_with_logits", "smooth_l1", "lrn", "expand", "pad",
-    "im2sequence", "prelu", "autoincreased_step_counter", "cos_sim",
+    "im2sequence", "prelu", "hsigmoid", "autoincreased_step_counter",
+    "cos_sim",
     "dot_product_attention", "edit_distance", "chunk_eval",
     "ring_attention", "moe", "warpctc", "nce", "row_conv", "multiplex",
     "lstm_unit",
@@ -745,6 +746,30 @@ def prelu(x, mode="all", param_attr=None, name=None):
         outputs={"Out": [out]}, attrs={"mode": mode},
     )
     return out
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Hierarchical sigmoid cost over a complete binary tree of
+    `num_classes` leaves (reference hierarchical_sigmoid_op.cc + legacy
+    trainer_config_helpers hsigmoid): O(log K) per sample instead of a
+    K-way softmax. Returns Cost [N, 1]."""
+    helper = LayerHelper("hsigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(helper.param_attr,
+                                [num_classes - 1, d], input.dtype)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr, [num_classes - 1],
+                                    input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="hierarchical_sigmoid", inputs=inputs,
+        outputs={"Cost": [cost]}, attrs={"num_classes": num_classes},
+    )
+    return cost
 
 
 def cos_sim(X, Y):

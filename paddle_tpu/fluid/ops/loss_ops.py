@@ -125,3 +125,108 @@ def modified_huber_loss(ctx, ins, attrs):
     out = jnp.where(z < -1.0, -4.0 * z,
                     jnp.where(z < 1.0, jnp.square(1.0 - z), 0.0))
     return {"Out": out, "IntermediateVal": z}
+
+
+@register_op("hierarchical_sigmoid", no_grad=("Label",),
+             ref="paddle/fluid/operators/hierarchical_sigmoid_op.cc")
+def hierarchical_sigmoid(ctx, ins, attrs):
+    """Hierarchical sigmoid over a complete binary tree (the reference's
+    matrix_bit_code scheme: leaf `label` walks node ids (label+K)>>1..;
+    internal node j's row of W scores the right-branch decision). Inputs:
+    X [N, D], W [K-1, D], Label [N, 1] (+ optional Bias [K-1]). Output:
+    Cost [N, 1] = sum over the path of sigmoid cross entropy."""
+    import numpy as _np
+
+    x = one(ins, "X")
+    w = one(ins, "W")
+    label = one(ins, "Label")
+    bias = (ins.get("Bias") or [None])[0]
+    num_classes = int(attrs["num_classes"])
+    if label.ndim >= 2 and label.shape[-1] == 1:
+        label = jnp.squeeze(label, -1)
+    code = label.astype(jnp.int32) + num_classes  # [N], in [K, 2K-1]
+    # static max path length: bit_length(2K-1) - 1 levels; shorter paths
+    # (when K is not a power of two) mask their top levels off
+    max_len = int(_np.ceil(_np.log2(2 * num_classes)))
+    js = jnp.arange(max_len)  # level index from the leaf
+    shifted = code[:, None] >> (js[None, :] + 1)        # [N, L]
+    valid = shifted >= 1
+    node = jnp.clip(shifted - 1, 0, num_classes - 2)    # [N, L] W rows
+    bit = ((code[:, None] >> js[None, :]) & 1).astype(x.dtype)
+    z = jnp.einsum("nld,nd->nl", w[node].astype(x.dtype), x,
+                   preferred_element_type=jnp.float32)
+    if bias is not None:
+        z = z + bias[node].astype(z.dtype)
+    # sigmoid CE per node: softplus(z) - bit*z, masked to the true path
+    ce = jax.nn.softplus(z) - bit * z
+    cost = jnp.sum(jnp.where(valid, ce, 0.0), axis=1, keepdims=True)
+    return {"Cost": cost.astype(x.dtype)}
+
+
+
+
+@register_op("lambda_cost", no_grad=("Score", "Lengths"),
+             ref="legacy paddle/gserver LambdaCost (trainer_config_helpers "
+                 "lambda_cost) — LambdaRank listwise ranking cost")
+def lambda_cost(ctx, ins, attrs):
+    """LambdaRank cost per query. Inputs: X [N, T] model scores (padded
+    sequence), Score [N, T] relevance labels, Lengths [N]. For each doc
+    pair with r_i > r_j the cost is |dNDCG_ij| * log(1+exp(-(s_i-s_j))),
+    dNDCG from swapping the pair in the model's ranking, normalized by
+    the ideal DCG@NDCG_num. Output: Cost [N, 1]."""
+    s = one(ins, "X").astype(jnp.float32)
+    r = one(ins, "Score").astype(jnp.float32)
+    lens = (ins.get("Lengths") or [None])[0]
+    ndcg_num = int(attrs.get("NDCG_num", 5))
+    if s.ndim == 3 and s.shape[-1] == 1:
+        s, r = jnp.squeeze(s, -1), jnp.squeeze(r, -1)
+    T = s.shape[1]
+    pos = jnp.arange(T)
+    valid = (pos[None, :] < lens[:, None]) if lens is not None else \
+        jnp.ones(s.shape, bool)
+    neg_inf = jnp.float32(-1e30)
+    s_m = jnp.where(valid, s, neg_inf)
+    r_m = jnp.where(valid, r, neg_inf)
+    # rank of each doc under the model's ordering (0 = best)
+    order = jnp.argsort(-s_m, axis=1)
+    rank = jnp.argsort(order, axis=1).astype(jnp.float32)
+    discount = 1.0 / jnp.log2(rank + 2.0)
+    gain = jnp.where(valid, jnp.exp2(r_m) - 1.0, 0.0)
+    # ideal DCG@N: top-N relevances in sorted order
+    r_sorted = -jnp.sort(-jnp.where(valid, r, 0.0), axis=1)
+    n_top = min(ndcg_num, T)
+    ideal = jnp.sum(
+        (jnp.exp2(r_sorted[:, :n_top]) - 1.0)
+        / jnp.log2(jnp.arange(n_top, dtype=jnp.float32) + 2.0), axis=1)
+    ideal = jnp.maximum(ideal, 1e-6)[:, None, None]
+    # pairwise |dNDCG| for swapping i and j in the model ranking
+    dgain = gain[:, :, None] - gain[:, None, :]
+    ddisc = discount[:, :, None] - discount[:, None, :]
+    dndcg = jnp.abs(dgain * ddisc) / ideal
+    pair = (r_m[:, :, None] > r_m[:, None, :]) \
+        & valid[:, :, None] & valid[:, None, :]
+    ds = s[:, :, None] - s[:, None, :]
+    logistic = jax.nn.softplus(-ds)
+    cost = jnp.sum(jnp.where(pair, dndcg * logistic, 0.0), axis=(1, 2))
+    return {"Cost": cost[:, None]}
+
+
+@register_op("scale_sub_region", no_grad=("Indices",),
+             ref="legacy paddle/gserver ScaleSubRegionLayer "
+                 "(trainer_config_helpers scale_sub_region_layer)")
+def scale_sub_region(ctx, ins, attrs):
+    """Scale a per-sample [c0:c1, h0:h1, w0:w1] box of an NCHW tensor by
+    `value` (1-based inclusive indices, the legacy layer's convention).
+    Inputs: X [N,C,H,W], Indices [N, 6] int."""
+    x = one(ins, "X")
+    idx = one(ins, "Indices").astype(jnp.int32)
+    value = float(attrs.get("value", 1.0))
+    n, c, h, w = x.shape
+    ci = jnp.arange(c)[None, :, None, None]
+    hi = jnp.arange(h)[None, None, :, None]
+    wi = jnp.arange(w)[None, None, None, :]
+    get = lambda k: idx[:, k][:, None, None, None]
+    mask = ((ci >= get(0) - 1) & (ci <= get(1) - 1)
+            & (hi >= get(2) - 1) & (hi <= get(3) - 1)
+            & (wi >= get(4) - 1) & (wi <= get(5) - 1))
+    return {"Out": jnp.where(mask, x * value, x)}

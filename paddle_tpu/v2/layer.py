@@ -6,6 +6,7 @@ translate to fluid data vars."""
 from __future__ import annotations
 
 from ..fluid import layers as _fl
+from ..fluid.param_attr import ParamAttr as _ParamAttr
 
 
 class _DataType:
@@ -1190,3 +1191,198 @@ def sampling_id_layer(input, **kwargs):
     """reference sampling_id_layer -> sampling_id op: sample one id per
     row from the input's (normalized) distribution."""
     return _raw_op("sampling_id", {"X": [input]}, dtype="int64")
+
+
+def hsigmoid(input, label, num_classes=None, param_attr=None,
+             bias_attr=None, **kwargs):
+    """reference hsigmoid (trainer_config_helpers/layers.py:2423):
+    hierarchical sigmoid cost over a complete binary class tree."""
+    if num_classes is None:
+        t = getattr(label, "_v2_type", None)
+        if t is None or t.kind != "int":
+            raise ValueError("hsigmoid needs num_classes= or an integer "
+                             "label from v2.layer.data")
+        num_classes = t.dim
+    return _fl.hsigmoid(input, label, num_classes, param_attr=param_attr,
+                        bias_attr=bias_attr)
+
+
+def conv_shift_layer(a, b, **kwargs):
+    """reference conv_shift_layer (layers.py:5066): circular correlation
+    c[i] = sum_j a[(i+j) mod M] * b[j], b's width odd."""
+    return _raw_op("conv_shift", {"X": [a], "Y": [b]})
+
+
+def gru_step_naive_layer(input, output_mem, size=None, **kwargs):
+    """reference gru_step_naive_layer: same math as gru_step_layer
+    without the fused-kernel layout constraint — identical here, where
+    XLA does the fusing."""
+    return gru_step_layer(input, output_mem, size=size, **kwargs)
+
+
+def printer_layer(input, format=None, **kwargs):
+    """reference printer_layer: print the input tensor at run time
+    (maps to the fluid Print op, forward direction)."""
+    return print_layer(input, **kwargs)
+
+
+def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, **kwargs):
+    """reference lambda_cost (layers.py): LambdaRank listwise ranking
+    cost — per query, |dNDCG@N|-weighted logistic loss over doc pairs.
+    `max_sort_size` is accepted for API parity (the full pairwise form
+    here subsumes the reference's partial-sort optimization)."""
+    from ..fluid.layers.sequence import seq_lengths_of
+
+    inputs = {"X": [input], "Score": [score]}
+    lens = seq_lengths_of(input) or seq_lengths_of(score)
+    if lens is not None:
+        inputs["Lengths"] = [lens]
+    return _raw_op("lambda_cost", inputs, {"NDCG_num": NDCG_num},
+                   out_slots=("Cost",))
+
+
+def scale_sub_region_layer(input, indices, value, **kwargs):
+    """reference scale_sub_region_layer: scale a per-sample
+    [c0:c1, h0:h1, w0:w1] box (1-based inclusive) by `value`."""
+    return _raw_op("scale_sub_region", {"X": [input], "Indices": [indices]},
+                   {"value": float(value)})
+
+
+class GeneratedInput:
+    """reference paddle.layer.GeneratedInput: marks the decoder input that
+    feeds back the previously generated token through an embedding."""
+
+    def __init__(self, size, embedding_name, embedding_size, **kwargs):
+        self.size = size                      # vocabulary size
+        self.embedding_name = embedding_name  # shared with training
+        self.embedding_size = embedding_size
+
+
+class BeamMemory:
+    """Recurrent-state spec for beam_search (declared OUTSIDE the loop —
+    the generation While carries state arrays created before the block;
+    an in-step memory() declaration could not be loop-carried). One of:
+    boot_layer= (encoder-derived init, [B, H]) or size= (zero init)."""
+
+    def __init__(self, boot_layer=None, size=None):
+        if boot_layer is None and size is None:
+            raise ValueError("BeamMemory needs boot_layer= or size=")
+        self.boot_layer = boot_layer
+        self.size = size
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size, max_length=100,
+                batch_size=None, memories=(), **kwargs):
+    """reference paddle.layer.beam_search (generation over a recurrent
+    step). `input` mixes ONE GeneratedInput (the fed-back token) with any
+    number of StaticInput layers. Step contract here (documented
+    divergence from the config-parser's name-linked in-step memories —
+    loop state must pre-exist the While block to be carried):
+
+      * recurrent state is declared up front via `memories=[BeamMemory
+        (boot_layer=...), ...]`;
+      * the step receives (token_emb, *statics, *memory_values) with
+        beams FLATTENED into the batch dim — every tensor is
+        [B*K, ...]; StaticInput layers are tiled over beams;
+      * the step returns (prob, *new_memory_values): vocabulary
+        probabilities plus one update per declared memory, in order.
+        Selected beams' memories are reordered by parent via
+        batch_gather each step.
+
+    Returns (ids, scores) from beam_search_decode: ids is
+    [B, beam, T+1] — ALL beams, best first, bos prefix included —
+    and scores the matching per-beam totals. `batch_size` must be
+    static (generation lanes are a [batch, beam] shape under XLA)."""
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    gens = [x for x in ins if isinstance(x, GeneratedInput)]
+    if len(gens) != 1:
+        raise ValueError("beam_search needs exactly one GeneratedInput")
+    gen = gens[0]
+    statics = [x.input if isinstance(x, StaticInput) else x
+               for x in ins if not isinstance(x, GeneratedInput)]
+    if batch_size is None:
+        raise ValueError(
+            "beam_search(batch_size=...) is required: generation lanes "
+            "are a static [batch, beam] shape under XLA")
+    B, K, V = int(batch_size), int(beam_size), int(gen.size)
+
+    from ..fluid.layers import tensor as _t
+
+    def _tile_over_beams(v):
+        """[B, ...] -> [B*K, ...]: every beam lane sees the same static."""
+        tail = [int(d) for d in v.shape[1:]]
+        r = _fl.reshape(v, shape=[B, 1] + tail)
+        r = _fl.expand(r, expand_times=[1, K] + [1] * len(tail))
+        return _fl.reshape(r, shape=[B * K] + tail)
+
+    statics = [_tile_over_beams(s) for s in statics]
+
+    counter = _fl.zeros(shape=[1], dtype="int64")
+    limit = _fl.fill_constant(shape=[1], dtype="int64", value=max_length)
+    ids_arr = _fl.create_array("int64", max_length + 1, [B, K])
+    scores_arr = _fl.create_array("float32", max_length + 1, [B, K])
+    parents_arr = _fl.create_array("int32", max_length + 1, [B, K])
+
+    init_ids = _fl.fill_constant(shape=[B, K], dtype="int64", value=bos_id)
+    # lane 0 active, lanes 1.. start at -inf so step 1 expands ONE beam
+    neg = _fl.fill_constant(shape=[B, K - 1], dtype="float32", value=-1e9) \
+        if K > 1 else None
+    zero = _fl.fill_constant(shape=[B, 1], dtype="float32", value=0.0)
+    init_scores = _t.concat([zero, neg], axis=1) if neg is not None else zero
+    _fl.array_write(init_ids, counter, ids_arr)
+    _fl.array_write(init_scores, counter, scores_arr)
+
+    # beam-tracked memories: arrays created (and booted) BEFORE the loop
+    # so the While op carries them
+    mem_arrays, mem_widths = [], []
+    for m in memories:
+        if m.boot_layer is not None:
+            h = int(m.boot_layer.shape[-1])
+            boot3 = _fl.reshape(m.boot_layer, shape=[B, 1, h])
+            boot3 = _fl.expand(boot3, expand_times=[1, K, 1])
+        else:
+            h = int(m.size)
+            boot3 = _fl.fill_constant(shape=[B, K, h], dtype="float32",
+                                      value=0.0)
+        arr = _fl.create_array("float32", max_length + 1, [B, K, h])
+        _fl.array_write(boot3, counter, arr)
+        mem_arrays.append(arr)
+        mem_widths.append(h)
+
+    cond = _fl.less_than(counter, limit)
+    w = _fl.While(cond)
+    with w.block():
+        pre_ids = _fl.array_read(ids_arr, counter)
+        pre_scores = _fl.array_read(scores_arr, counter)
+        emb = _fl.embedding(
+            pre_ids, size=[V, gen.embedding_size],
+            param_attr=_ParamAttr(name=gen.embedding_name))  # [B, K, E]
+        emb_flat = _fl.reshape(emb, shape=[B * K, gen.embedding_size])
+        pre_mems = [
+            _fl.reshape(_fl.array_read(arr, counter), shape=[B * K, h])
+            for arr, h in zip(mem_arrays, mem_widths)
+        ]
+
+        outs = step(emb_flat, *statics, *pre_mems)
+        outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        prob, new_mems = outs[0], outs[1:]
+        if len(new_mems) != len(mem_arrays):
+            raise ValueError(
+                f"beam_search step returned {len(new_mems)} memory updates "
+                f"for {len(mem_arrays)} declared memories")
+
+        logp = _raw_op("log", {"X": [prob]})
+        logp3 = _fl.reshape(logp, shape=[B, K, V])
+        sel_ids, sel_scores, parent = _fl.beam_search(
+            pre_ids, pre_scores, logp3, K, end_id=eos_id)
+        _fl.increment(counter, value=1)
+        _fl.array_write(sel_ids, counter, ids_arr)
+        _fl.array_write(sel_scores, counter, scores_arr)
+        _fl.array_write(parent, counter, parents_arr)
+        for arr, new, h in zip(mem_arrays, new_mems, mem_widths):
+            new3 = _fl.reshape(new, shape=[B, K, h])
+            _fl.array_write(_fl.batch_gather(new3, parent), counter, arr)
+        _fl.less_than(counter, limit, cond=cond)
+
+    return _fl.beam_search_decode(ids_arr, scores_arr, parents_arr,
+                                  end_id=eos_id)

