@@ -8,8 +8,11 @@ Capability, not code, parity (SURVEY.md §2.8/§7 step 10): v2-style programs
 lowering). The layer DSL maps onto fluid layers."""
 from .. import batch, reader  # noqa: F401
 from .. import dataset  # noqa: F401
-from . import event, layer, networks, optimizer, plot, topology  # noqa: F401
-from .layer import activation, pooling  # noqa: F401
+from . import (  # noqa: F401
+    attr, data_feeder, evaluator, event, image, layer, minibatch, networks,
+    op, optimizer, plot, topology,
+)
+from .layer import activation, data_type, pooling  # noqa: F401
 from .topology import Topology  # noqa: F401
 from .inference import infer  # noqa: F401
 from .parameters import Parameters, create  # noqa: F401

@@ -258,3 +258,55 @@ def test_v2_trainer_cli(tmp_path, capsys):
     tests = [float(l.split()[-1]) for l in out.splitlines()
              if "test cost" in l]
     assert tests[-1] < tests[0]
+
+
+def test_v2_package_submodule_parity():
+    """The v2 package exposes the reference's submodule surface
+    (python/paddle/v2/: attr, data_type, image, minibatch, op, evaluator,
+    data_feeder alongside the trainer stack); the numpy image transforms
+    behave like the reference's cv2 pipeline."""
+    import numpy as np
+
+    import paddle_tpu.v2 as v2
+
+    for name in ("attr", "data_type", "evaluator", "event", "image",
+                 "layer", "minibatch", "networks", "op", "optimizer",
+                 "plot", "topology", "data_feeder"):
+        assert hasattr(v2, name), name
+    assert v2.attr.ParamAttr is not None
+    assert v2.minibatch.batch is v2.batch
+
+    im = (np.arange(24 * 32 * 3) % 255).reshape(24, 32, 3).astype(np.uint8)
+    r = v2.image.resize_short(im, 16)
+    assert min(r.shape[:2]) == 16 and r.shape[1] > 16  # aspect kept
+    t = v2.image.simple_transform(im, 20, 16, is_train=False,
+                                  mean=[0.0, 0.0, 0.0])
+    assert t.shape == (3, 16, 16) and t.dtype == np.float32
+    flipped = v2.image.left_right_flip(im)
+    np.testing.assert_array_equal(flipped[:, 0], im[:, -1])
+
+    # op sugar lowers to elementwise/scale ops (fresh program — the
+    # module's other tests share the default one)
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = v2.layer.data(name="opx",
+                          type=v2.layer.data_type.dense_vector(3))
+        y = v2.layer.data(name="opy",
+                          type=v2.layer.data_type.dense_vector(3))
+        outs = [v2.op.add(x, y), v2.op.sub(x, 1.0), v2.op.mul(x, 2.0),
+                v2.op.neg(y)]
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        vals = exe.run(main,
+                       feed={"opx": np.ones((2, 3), np.float32),
+                             "opy": np.full((2, 3), 2.0, np.float32)},
+                       fetch_list=outs)
+    np.testing.assert_allclose(vals[0], 3.0 * np.ones((2, 3)))
+    np.testing.assert_allclose(vals[1], 0.0 * np.ones((2, 3)))
+    np.testing.assert_allclose(vals[2], 2.0 * np.ones((2, 3)))
+    np.testing.assert_allclose(vals[3], -2.0 * np.ones((2, 3)))
