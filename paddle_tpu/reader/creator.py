@@ -44,3 +44,34 @@ def recordio(paths: Union[str, Sequence[str]], num_threads: int = 2,
             yield pickle.loads(rec)
 
     return reader
+
+
+def cloud_reader(paths: Union[str, Sequence[str]], master_endpoint,
+                 unpickle: bool = True):
+    """Master-fed fault-tolerant reader (reference
+    python/paddle/v2/reader/creator.py:91 cloud_reader — there, recordio
+    chunks are leased from the Go master found via etcd; here from
+    distributed.master.MasterService over its TCP RPC). The first reader
+    to arrive registers the dataset; every worker then drains leased
+    tasks — a worker that dies mid-task has its lease expire and the
+    task re-queued, so records are processed at-least-once across the
+    fleet."""
+    if isinstance(paths, str):
+        paths = [p for p in paths.split(",") if p]
+
+    def reader():
+        from ..distributed.master import MasterClient
+
+        ep = master_endpoint
+        if isinstance(ep, str):
+            host, _, port = ep.rpartition(":")
+            ep = (host or "127.0.0.1", int(port))
+        client = MasterClient(addr=ep)
+        try:
+            client.set_dataset(list(paths))
+            for rec in client.records():
+                yield pickle.loads(rec) if unpickle else rec
+        finally:
+            client.close()
+
+    return reader

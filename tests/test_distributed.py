@@ -556,3 +556,30 @@ def test_worker_registry_same_id_two_processes_get_two_slots(tmp_path):
     assert b.is_registered()
     assert list(b.members().values()) == ["trainer-x"]
     b.deregister()
+
+
+def test_cloud_reader_creator(tmp_path):
+    """reader.creator.cloud_reader drains a master-managed dataset
+    (reference v2 cloud_reader over the Go master, here over
+    MasterService TCP)."""
+    import pickle
+
+    from paddle_tpu.fluid.recordio_writer import (
+        convert_reader_to_recordio_file,
+    )
+    from paddle_tpu.reader import creator
+
+    shards = []
+    for i in range(3):
+        p = str(tmp_path / f"cloud_{i}.recordio")
+        convert_reader_to_recordio_file(
+            p, lambda i=i: iter([(i, j) for j in range(4)]))
+        shards.append(p)
+    svc = MasterService(chunks_per_task=1, lease_timeout=60)
+    addr = svc.serve()
+    try:
+        ep = f"{addr[0]}:{addr[1]}"
+        rows = sorted(creator.cloud_reader(shards, ep)())
+        assert rows == sorted((i, j) for i in range(3) for j in range(4))
+    finally:
+        svc.shutdown()

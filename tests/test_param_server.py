@@ -720,3 +720,54 @@ def test_trainer_startup_prunes_table_and_accumulators():
     assert any(n.startswith("padam.w_moment") for n in orig), sorted(orig)
     # the dense fc param stays
     assert any(n.startswith("padam.fc.w") for n in names)
+
+
+def test_sync_four_trainers_through_executor_ops():
+    """Sync rounds scale past two trainers THROUGH the executor's
+    send/recv/send_barrier host ops: four trainer threads, lockstep
+    rounds, identical post-round params."""
+    port = _free_ports(1)[0]
+    ep = f"127.0.0.1:{port}"
+    main, startup, cost = _linear_model(seed=29)
+    t0 = DistributeTranspiler()
+    t0.transpile(trainer_id=0, program=main, startup_program=startup,
+                 pservers=ep, trainers=4, sync_mode=True)
+    ps = t0.start_pserver(ep, port=port)
+    try:
+        progs = []
+        for tid in range(4):
+            t = DistributeTranspiler()
+            t.transpile(trainer_id=tid, program=main,
+                        startup_program=startup, pservers=ep, trainers=4,
+                        sync_mode=True)
+            progs.append(t.get_trainer_program(send_recv=True))
+
+        results = {}
+
+        def trainer(tid):
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor()
+                exe.run(startup)
+                for i in range(4):
+                    exe.run(progs[tid], feed=_feed(i), fetch_list=[cost])
+                results[tid] = {
+                    p: np.asarray(scope.find_var(p)).copy()
+                    for p in t0.param_assignment}
+
+        threads = [threading.Thread(target=trainer, args=(i,))
+                   for i in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=180)
+        assert set(results) == {0, 1, 2, 3}, "a trainer thread died or hung"
+        stats = ps.stats()
+        assert stats["round"] == 4, stats
+        assert stats["steps"] == 4 * len(t0.param_assignment), stats
+        for p in t0.param_assignment:
+            for tid in (1, 2, 3):
+                np.testing.assert_allclose(results[0][p], results[tid][p],
+                                           rtol=1e-6)
+    finally:
+        ps.shutdown()
