@@ -11,6 +11,7 @@ from .api import (  # noqa: F401
     make_mesh,
     mesh_context,
     plan_data_parallel,
+    plan_fsdp,
     plan_moe_ep,
     plan_sequence_parallel,
     plan_transformer_tp,

@@ -119,6 +119,23 @@ def plan_moe_ep(batch_axis: str = "dp", ep_axis: str = "ep") -> ShardingPlan:
     )
 
 
+def plan_fsdp(batch_axis: str = "dp", shard_axis: Optional[str] = None
+              ) -> ShardingPlan:
+    """ZeRO/FSDP-style fully sharded data parallel (the scaling-book
+    recipe; no 2018-reference equivalent — its multi-GPU path replicates
+    params and NCCL-all-reduces grads): every parameter AND its optimizer
+    accumulators shard dim 0 over the data axis. GSPMD then all-gathers
+    a weight just before its use and reduce-scatters its gradient —
+    per-chip parameter+optimizer memory drops by the dp degree while the
+    math stays exactly data parallel. Scalar state (lr, beta pows) is
+    replicated by ShardingPlan's ndim guard."""
+    axis = shard_axis or batch_axis
+    # one catch-all rule: any named var (params and their `<p>_moment...`
+    # accumulators alike) shards dim 0; spec_for's len(spec)>ndim guard
+    # keeps scalars replicated
+    return ShardingPlan(rules=[(r".", P(axis))], batch_axis=batch_axis)
+
+
 def plan_sequence_parallel(batch_axis: str = "dp",
                            seq_axis: str = "sp") -> ShardingPlan:
     """Context parallelism: feeds shard on [batch, seq]; params replicated.

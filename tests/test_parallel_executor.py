@@ -180,3 +180,71 @@ def test_pe_train_then_test_exe_consistency():
         (t3,) = test_pe.run(feed=feed, fetch_list=[avg_cost.name])
         assert (float(np.asarray(t3).reshape(-1)[0])
                 < float(np.asarray(t1).reshape(-1)[0]))
+
+
+def test_fsdp_plan_shards_params_and_matches_dp():
+    """plan_fsdp (ZeRO/FSDP-style): params AND optimizer accumulators
+    shard dim 0 over dp — per-chip state memory drops by the dp degree —
+    while the training math stays exactly data parallel (loss curves
+    match plain DP step for step)."""
+    import jax
+
+    from paddle_tpu.parallel import make_mesh, plan_data_parallel, plan_fsdp
+
+    def build():
+        main, startup = Program(), Program()
+        main.random_seed = startup.random_seed = 41
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[16], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="float32")
+            h = layers.fc(input=x, size=32, act="tanh",
+                          param_attr="fsdp.w1", bias_attr="fsdp.b1")
+            pred = layers.fc(input=h, size=1, param_attr="fsdp.w2",
+                             bias_attr="fsdp.b2")
+            cost = layers.mean(layers.square_error_cost(input=pred,
+                                                        label=y))
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(cost)
+        return main, startup, cost
+
+    rng = np.random.RandomState(0)
+    x_np = rng.rand(16, 16).astype(np.float32)
+    y_np = x_np.sum(axis=1, keepdims=True) * 0.1
+
+    from paddle_tpu.fluid import unique_name
+
+    curves = {}
+    for label, plan in (("dp", plan_data_parallel()),
+                        ("fsdp", plan_fsdp())):
+        with unique_name.guard():
+            main, startup, cost = build()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            mesh = make_mesh({"dp": 8})
+            pe = fluid.ParallelExecutor(loss_name=cost.name,
+                                        main_program=main, mesh=mesh,
+                                        sharding_plan=plan)
+            losses = []
+            for _ in range(6):
+                (l,) = pe.run(fetch_list=[cost],
+                              feed={"x": x_np, "y": y_np})
+                losses.append(float(np.ravel(l)[0]))
+            curves[label] = losses
+            if label == "fsdp":
+                # the point of the plan: weight AND accumulator state is
+                # dim-0 sharded across the mesh, not replicated (the
+                # accumulator's unique-name suffix varies, so find it by
+                # prefix)
+                moment = next(n for n in main.global_block().vars
+                              if n.startswith("fsdp.w1_moment1"))
+                for name in ("fsdp.w1", moment):
+                    var = scope.find_var(name)
+                    assert var is not None, name
+                    spec = var.sharding.spec
+                    assert spec and spec[0] == "dp", (name, spec)
+                    shard_rows = [
+                        s.data.shape[0] for s in var.addressable_shards]
+                    assert max(shard_rows) < var.shape[0], (name, shard_rows)
+    np.testing.assert_allclose(curves["fsdp"], curves["dp"], rtol=2e-4)
+    assert curves["dp"][-1] < curves["dp"][0]
