@@ -1,0 +1,45 @@
+"""reference python/paddle/v2/master/client.py:29 — the trainer-side
+master client (set_dataset / next_record task loop)."""
+from __future__ import annotations
+
+
+class client:
+    """API-parity facade over distributed.master.MasterClient. The
+    reference dials etcd to find the Go master; here `endpoints` is the
+    master's own "host:port" (or (host, port))."""
+
+    def __init__(self, endpoints, timeout_sec: int = 5, buf_size: int = 0):
+        from ...distributed.master import MasterClient
+
+        ep = endpoints
+        if isinstance(ep, str):
+            host, _, port = ep.rpartition(":")
+            ep = (host or "127.0.0.1", int(port))
+        self._client = MasterClient(addr=ep)
+        self._records = None
+
+    def set_dataset(self, paths):
+        self._client.set_dataset(list(paths))
+        self._records = self._client.records()
+
+    def next_record(self):
+        """One record (bytes), or None at end of pass (the reference's
+        (None, -1) end condition collapsed to None)."""
+        if self._records is None:
+            raise RuntimeError("set_dataset() first")
+        try:
+            return next(self._records)
+        except StopIteration:
+            return None
+
+    def paddle_start_get_records(self, pass_id):  # reference client.py:94
+        self._records = self._client.records()
+
+    def request_save_model(self, trainer_id, block_ms):
+        """The reference asks the master which ONE trainer should save the
+        model this pass; with the TCP master any caller may save — report
+        yes for trainer 0, matching the single-writer intent."""
+        return 1 if int(trainer_id) == 0 else 0
+
+    def release(self):
+        self._client.close()

@@ -583,3 +583,33 @@ def test_cloud_reader_creator(tmp_path):
         assert rows == sorted((i, j) for i in range(3) for j in range(4))
     finally:
         svc.shutdown()
+
+
+def test_v2_master_client_facade(tmp_path):
+    """paddle.v2.master.client parity surface over the TCP master."""
+    from paddle_tpu.fluid.recordio_writer import (
+        convert_reader_to_recordio_file,
+    )
+    from paddle_tpu.v2.master import client as v2_master_client
+
+    p = str(tmp_path / "v2m.recordio")
+    convert_reader_to_recordio_file(p, lambda: iter(range(5)))
+    svc = MasterService(chunks_per_task=1, lease_timeout=60)
+    addr = svc.serve()
+    try:
+        c = v2_master_client(f"{addr[0]}:{addr[1]}", timeout_sec=5)
+        c.set_dataset([p])
+        import pickle
+
+        got = []
+        while True:
+            r = c.next_record()
+            if r is None:
+                break
+            got.append(pickle.loads(r))
+        assert sorted(got) == [0, 1, 2, 3, 4]
+        assert c.request_save_model(0, 100) == 1
+        assert c.request_save_model(1, 100) == 0
+        c.release()
+    finally:
+        svc.shutdown()
