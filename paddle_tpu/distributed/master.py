@@ -89,8 +89,14 @@ class MasterService:
 
     # -- dataset ----------------------------------------------------------
     def set_dataset(self, shard_paths: Sequence[str]):
-        """Partition shards into tasks (reference partition:106)."""
+        """Partition shards into tasks (reference partition:106).
+        IDEMPOTENT for an unchanged shard list: a second worker joining
+        the fleet must drain the EXISTING queues, not reset them (a reset
+        would invalidate in-flight leases and re-serve finished tasks)."""
         with self._mu:
+            if list(shard_paths) == getattr(self, "_dataset_paths", None):
+                return
+            self._dataset_paths = list(shard_paths)
             self._todo = []
             self._pending.clear()
             self._done = []
@@ -163,12 +169,35 @@ class MasterService:
             self._check_timeouts_locked()
             return not self._todo and not self._pending
 
+    def new_pass(self) -> bool:
+        """Start the next pass when the current one is exhausted: done
+        (and dropped) tasks re-queue as todo (reference TaskFinished's
+        rollover, service.go:435-445 — made EXPLICIT here because this
+        service's clients detect pass end via all_done(), which an
+        automatic rollover would never let become true). Returns False
+        while tasks are still outstanding."""
+        with self._mu:
+            self._check_timeouts_locked()
+            if self._todo or self._pending:
+                return False
+            if not self._done and not self._failed_dropped:
+                return False
+            self._cur_pass = getattr(self, "_cur_pass", 0) + 1
+            self._todo = self._done + self._failed_dropped
+            self._done = []
+            self._failed_dropped = []
+            for t in self._todo:
+                t.num_failures = 0
+            self._snapshot_locked()
+            return True
+
     def stats(self) -> Dict[str, int]:
         with self._mu:
             return {
                 "todo": len(self._todo), "pending": len(self._pending),
                 "done": len(self._done),
                 "dropped": len(self._failed_dropped),
+                "pass": getattr(self, "_cur_pass", 0),
             }
 
     def _fail_locked(self, task: Task):
@@ -246,7 +275,7 @@ class MasterService:
     # RPC surface exposed over TCP — everything else is unreachable
     _RPC_METHODS = frozenset({
         "set_dataset", "get_task", "task_finished", "task_failed",
-        "all_done", "stats",
+        "all_done", "new_pass", "stats",
     })
 
     # frames larger than this are a protocol violation (a real set_dataset
@@ -353,6 +382,9 @@ class MasterClient:
         (re)connect, so a standby takeover is followed automatically.
         Retries with backoff span the election gap after a master crash."""
         self._service = service
+        if isinstance(addr, str):  # "host:port" accepted everywhere
+            host, _, port = addr.rpartition(":")
+            addr = (host or "127.0.0.1", int(port))
         self._addr = addr
         self._resolver = addr_resolver
         self._retries = int(reconnect_retries)
@@ -424,6 +456,10 @@ class MasterClient:
 
     def all_done(self) -> bool:
         return self._call("all_done")
+
+    def new_pass(self) -> bool:
+        """Re-queue the finished pass's tasks for another epoch."""
+        return self._call("new_pass")
 
     def stats(self):
         return self._call("stats")

@@ -119,11 +119,15 @@ class ParallelExecutor:
             """Plan spec for a state var. Size-1 arrays (scalar optimizer
             accumulators whose names match a param rule) fall back to
             replication; a genuinely indivisible param is a misconfigured
-            plan and fails loudly."""
+            plan and fails loudly — except under a best_effort plan
+            (plan_fsdp's catch-all: real FSDP replicates the odd-width
+            biases and class-count tails it cannot split evenly)."""
             spec = self._plan.spec_for(name, len(shape))
             if _divisible(shape, spec):
                 return spec
             if int(np.prod(shape, dtype=np.int64)) <= 1:
+                return P(*([None] * len(shape)))
+            if getattr(self._plan, "best_effort", False):
                 return P(*([None] * len(shape)))
             throw_on(
                 "sharding plan maps var '%s' (shape %s) to %s, but a "

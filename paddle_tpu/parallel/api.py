@@ -53,10 +53,15 @@ class ShardingPlan:
 
     def __init__(self, rules: Sequence[Tuple[str, P]] = (),
                  batch_axis: Optional[str] = "dp",
-                 seq_axis: Optional[str] = None):
+                 seq_axis: Optional[str] = None,
+                 best_effort: bool = False):
         self.rules = [(re.compile(pat), spec) for pat, spec in rules]
         self.batch_axis = batch_axis
         self.seq_axis = seq_axis
+        # best_effort: an indivisible dim falls back to replication instead
+        # of erroring (catch-all plans like plan_fsdp, where odd-width
+        # biases simply stay replicated)
+        self.best_effort = best_effort
 
     def add(self, pattern: str, spec: P) -> "ShardingPlan":
         self.rules.append((re.compile(pattern), spec))
@@ -132,8 +137,10 @@ def plan_fsdp(batch_axis: str = "dp", shard_axis: Optional[str] = None
     axis = shard_axis or batch_axis
     # one catch-all rule: any named var (params and their `<p>_moment...`
     # accumulators alike) shards dim 0; spec_for's len(spec)>ndim guard
-    # keeps scalars replicated
-    return ShardingPlan(rules=[(r".", P(axis))], batch_axis=batch_axis)
+    # keeps scalars replicated, and best_effort keeps odd-width tensors
+    # (a [10]-class bias on dp=8) replicated instead of erroring
+    return ShardingPlan(rules=[(r".", P(axis))], batch_axis=batch_axis,
+                        best_effort=True)
 
 
 def plan_sequence_parallel(batch_axis: str = "dp",

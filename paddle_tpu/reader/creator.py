@@ -62,13 +62,16 @@ def cloud_reader(paths: Union[str, Sequence[str]], master_endpoint,
     def reader():
         from ..distributed.master import MasterClient
 
-        ep = master_endpoint
-        if isinstance(ep, str):
-            host, _, port = ep.rpartition(":")
-            ep = (host or "127.0.0.1", int(port))
-        client = MasterClient(addr=ep)
+        client = MasterClient(addr=master_endpoint)
         try:
+            # idempotent on the service side: the first worker registers,
+            # later workers (or later passes) join the existing queues
             client.set_dataset(list(paths))
+            if client.all_done():
+                # previous pass exhausted: this reader() call is an epoch —
+                # re-queue the finished tasks (no-op race-safe: only one
+                # caller's new_pass returns True, everyone then drains)
+                client.new_pass()
             for rec in client.records():
                 yield pickle.loads(rec) if unpickle else rec
         finally:

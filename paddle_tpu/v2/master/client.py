@@ -11,11 +11,7 @@ class client:
     def __init__(self, endpoints, timeout_sec: int = 5, buf_size: int = 0):
         from ...distributed.master import MasterClient
 
-        ep = endpoints
-        if isinstance(ep, str):
-            host, _, port = ep.rpartition(":")
-            ep = (host or "127.0.0.1", int(port))
-        self._client = MasterClient(addr=ep)
+        self._client = MasterClient(addr=endpoints)
         self._records = None
 
     def set_dataset(self, paths):
@@ -33,6 +29,11 @@ class client:
             return None
 
     def paddle_start_get_records(self, pass_id):  # reference client.py:94
+        if self._client.all_done():
+            # previous pass fully consumed: re-queue its tasks (the Go
+            # master rolls passes inside TaskFinished; this service makes
+            # the roll explicit so all_done() can mark pass ends)
+            self._client.new_pass()
         self._records = self._client.records()
 
     def request_save_model(self, trainer_id, block_ms):

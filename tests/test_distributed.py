@@ -562,8 +562,6 @@ def test_cloud_reader_creator(tmp_path):
     """reader.creator.cloud_reader drains a master-managed dataset
     (reference v2 cloud_reader over the Go master, here over
     MasterService TCP)."""
-    import pickle
-
     from paddle_tpu.fluid.recordio_writer import (
         convert_reader_to_recordio_file,
     )
@@ -611,5 +609,56 @@ def test_v2_master_client_facade(tmp_path):
         assert c.request_save_model(0, 100) == 1
         assert c.request_save_model(1, 100) == 0
         c.release()
+    finally:
+        svc.shutdown()
+
+
+def test_master_multi_pass_and_idempotent_set_dataset(tmp_path):
+    """(review findings) set_dataset with an unchanged shard list must NOT
+    reset the queues out from under the fleet; new_pass re-queues a
+    finished pass so epochs after the first see data."""
+    from paddle_tpu.fluid.recordio_writer import (
+        convert_reader_to_recordio_file,
+    )
+    from paddle_tpu.v2.master import client as v2c
+
+    shards = []
+    for i in range(2):
+        p = str(tmp_path / f"mp_{i}.recordio")
+        convert_reader_to_recordio_file(p, lambda i=i: iter([i * 10, i * 10 + 1]))
+        shards.append(p)
+    svc = MasterService(chunks_per_task=1, lease_timeout=60)
+    addr = svc.serve()
+    try:
+        c = v2c(addr)  # tuple endpoint form
+        c.set_dataset(shards)
+        # a second worker registering the SAME dataset must not reset
+        t1 = c._client.get_task()
+        c2 = v2c(addr)
+        c2.set_dataset(shards)
+        assert svc.stats()["pending"] == 1  # the lease survived
+        assert c._client.task_finished(t1.id, t1.epoch)  # still valid
+        # drain the remainder of pass 0
+        import pickle as _p
+
+        [_p.loads(x) for x in c._client.records()]
+        assert svc.all_done()
+        # pass 1: explicit roll, full dataset again
+        assert c._client.new_pass()
+        assert not c._client.new_pass()  # idempotent mid-pass... queues full
+        pass1 = sorted(_p.loads(x) for x in c._client.records())
+        assert pass1 == [0, 1, 10, 11]
+        assert svc.stats()["pass"] == 1
+        # v2 facade: paddle_start_get_records starts the next epoch
+        c.paddle_start_get_records(2)
+        seen = []
+        while True:
+            r = c.next_record()
+            if r is None:
+                break
+            seen.append(_p.loads(r))
+        assert sorted(seen) == [0, 1, 10, 11]
+        c.release()
+        c2.release()
     finally:
         svc.shutdown()
