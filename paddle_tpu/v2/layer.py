@@ -2,7 +2,18 @@
 + trainer_config_helpers/layers.py — declarative layers composed by passing
 outputs as inputs). Each function appends ops to the implicit default
 program, exactly like fluid layers; the v2-specific `data_type` objects
-translate to fluid data vars."""
+translate to fluid data vars.
+
+Coverage: 114 layer functions vs the reference's 109 names. Intentionally
+absent (each a nested-raggedness construct the padded+lengths sequence
+model deliberately flattens — SURVEY §5.7):
+  - sub_nested_seq_layer: selects inner sequences of a 2-level LoD;
+    lod_level-2 data arrives here already flattened to one level.
+  - cross_entropy_over_beam: cost over the beam-structured LoD the legacy
+    generator emitted; generation here keeps fixed [batch, beam] lanes
+    (see beam_search below) where plain cross_entropy applies per lane.
+  - layer_support/__cost_input__/__img_norm_layer__: config-parser
+    internals, not user layers."""
 from __future__ import annotations
 
 from ..fluid import layers as _fl
