@@ -59,7 +59,12 @@ class ParameterServer:
         self._pending: Dict[str, Dict[int, Any]] = {}
         self._applied_round: set = set()
         self._steps = 0
-        self._apply_mu = threading.Lock()
+        # async: one lock per param (concurrent cross-param applies);
+        # _shared_mu guards the cycle bookkeeping + counters, and
+        # _shared_run_mu serializes the stateful LR-chain executions
+        self._param_locks: Dict[str, threading.Lock] = {}
+        self._shared_mu = threading.Lock()
+        self._shared_run_mu = threading.Lock()
         # params applied since the shared (LR-decay) program last ran: the
         # shared chain advances once per DISTINCT-PARAM CYCLE — a repeat
         # push means a new optimization step started — not once per
@@ -72,6 +77,7 @@ class ParameterServer:
             n for n, v in block.vars.items()
             if getattr(v.desc, "is_parameter", False)
         )
+        self._param_locks = {p: threading.Lock() for p in self._owned}
         owned = set(self._owned)
         # Split the pserver program (reference listen_and_serv: per-param
         # optimize sub-blocks + ONE lr-decay sub-block run once per round):
@@ -190,10 +196,12 @@ class ParameterServer:
         if name not in self._owned:
             raise KeyError(f"param '{name}' is not owned by this pserver")
         if not self._sync:
-            # hogwild-style async, but each individual update is atomic:
-            # unserialized applies would drop whole gradients (read-modify-
-            # write on the scope), which is worse than async staleness
-            with self._apply_mu:
+            # hogwild-style async with PER-PARAM atomicity: updates to one
+            # param serialize (an unserialized read-modify-write would drop
+            # whole gradients), while different params apply CONCURRENTLY
+            # from different handler threads — the reference pserver's
+            # per-block locking (parameter_server2's block-sharded applies)
+            with self._param_locks[name]:
                 self._apply(name, grad)
             return {"step": self._steps, "round": self._round}
         with self._cv:
@@ -238,21 +246,32 @@ class ParameterServer:
 
     # --- internals -----------------------------------------------------
     def _apply(self, name: str, grad):
+        """Caller holds this param's lock (async) or the big cv lock
+        (sync). Cross-param concurrency is safe: per-param programs write
+        disjoint scope names; the shared LR chain's cycle bookkeeping and
+        its stateful execution take their own locks (an apply may read an
+        LR mid-decay of a concurrent cycle boundary — the documented
+        hogwild staleness, not a lost update)."""
         import paddle_tpu.fluid as fluid
 
         with fluid.scope_guard(self._scope):
             # shared stateful chain (LR-decay counters) advances once per
             # distinct-param cycle: at the first push ever, and whenever a
             # param REPEATS (its second push means a new step began)
-            if name in self._applied_since_shared or \
-                    not self._applied_since_shared:
-                if self._shared_prog is not None:
+            run_shared = False
+            with self._shared_mu:
+                if name in self._applied_since_shared or \
+                        not self._applied_since_shared:
+                    run_shared = self._shared_prog is not None
+                    self._applied_since_shared = set()
+                self._applied_since_shared.add(name)
+            if run_shared:
+                with self._shared_run_mu:
                     self._exe.run(self._shared_prog)
-                self._applied_since_shared = set()
-            self._applied_since_shared.add(name)
             self._exe.run(self._per_param[name],
                           feed={self._grad_name[name]: grad})
-        self._steps += 1
+        with self._shared_mu:
+            self._steps += 1
 
     # --- lifecycle -----------------------------------------------------
     def serve(self, host: str = "127.0.0.1", port: int = 0

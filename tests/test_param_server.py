@@ -771,3 +771,55 @@ def test_sync_four_trainers_through_executor_ops():
                                            rtol=1e-6)
     finally:
         ps.shutdown()
+
+
+def test_async_concurrent_cross_param_applies_are_exact():
+    """Async applies serialize PER PARAM, not globally: eight threads
+    hammer two params concurrently and every single gradient must land —
+    final value == init - lr * pushes (a dropped read-modify-write would
+    break the arithmetic)."""
+    main, startup, cost = _linear_model(seed=51)
+    port = _free_ports(1)[0]
+    ep = f"127.0.0.1:{port}"
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, startup_program=startup,
+                pservers=ep, trainers=1, sync_mode=False)
+    ps = t.start_pserver(ep, port=port)
+    try:
+        from paddle_tpu.distributed.param_server import ParameterClient
+
+        owned = ps.owned_params()
+        assert len(owned) == 2
+        before = {p: ps.get_param(p).copy() for p in owned}
+        pushes_per_thread, n_threads = 25, 8
+        errors = []
+
+        def hammer(tid):
+            try:
+                client = ParameterClient(t.param_assignment, trainer_id=tid)
+                for i in range(pushes_per_thread):
+                    p = owned[(tid + i) % 2]
+                    client.send_grad(p, np.ones_like(before[p]))
+            except Exception as e:  # surface thread failures in the test
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+        assert not errors, errors
+        stats = ps.stats()
+        total = pushes_per_thread * n_threads
+        assert stats["steps"] == total, stats
+        counts = {p: sum(1 for tid in range(n_threads)
+                         for i in range(pushes_per_thread)
+                         if owned[(tid + i) % 2] == p) for p in owned}
+        for p in owned:
+            # lr=0.05 SGD, unit grads: every push must have landed exactly
+            np.testing.assert_allclose(
+                ps.get_param(p), before[p] - 0.05 * counts[p],
+                rtol=1e-4, atol=1e-4)
+    finally:
+        ps.shutdown()
