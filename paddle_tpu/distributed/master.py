@@ -84,6 +84,8 @@ class MasterService:
         self._failed_dropped: List[Task] = []
         self._epoch = 0
         self._next_id = 0
+        self._dataset_paths: Optional[List[str]] = None
+        self._cur_pass = 0
         if snapshot_path and os.path.exists(snapshot_path):
             self._recover()
 
@@ -94,7 +96,7 @@ class MasterService:
         the fleet must drain the EXISTING queues, not reset them (a reset
         would invalidate in-flight leases and re-serve finished tasks)."""
         with self._mu:
-            if list(shard_paths) == getattr(self, "_dataset_paths", None):
+            if list(shard_paths) == self._dataset_paths:
                 return
             self._dataset_paths = list(shard_paths)
             self._todo = []
@@ -182,7 +184,7 @@ class MasterService:
                 return False
             if not self._done and not self._failed_dropped:
                 return False
-            self._cur_pass = getattr(self, "_cur_pass", 0) + 1
+            self._cur_pass += 1
             self._todo = self._done + self._failed_dropped
             self._done = []
             self._failed_dropped = []
@@ -197,7 +199,7 @@ class MasterService:
                 "todo": len(self._todo), "pending": len(self._pending),
                 "done": len(self._done),
                 "dropped": len(self._failed_dropped),
-                "pass": getattr(self, "_cur_pass", 0),
+                "pass": self._cur_pass,
             }
 
     def _fail_locked(self, task: Task):
@@ -232,6 +234,11 @@ class MasterService:
             # epoch must survive recovery or pre-crash stale leases could
             # collide with fresh ones and defeat the epoch guard
             "epoch": self._epoch,
+            # the set_dataset idempotency guard keys on these: without them
+            # a recovered master treats the first (unchanged) set_dataset as
+            # new, resets the queues, and re-serves finished tasks
+            "dataset_paths": self._dataset_paths,
+            "pass": self._cur_pass,
         }
         payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
         blob = struct.pack("<I", zlib.crc32(payload)) + payload
@@ -270,6 +277,9 @@ class MasterService:
         self._failed_dropped = state["dropped"]
         self._next_id = state["next_id"]
         self._epoch = state.get("epoch", 0)
+        if state.get("dataset_paths") is not None:
+            self._dataset_paths = state["dataset_paths"]
+        self._cur_pass = state.get("pass", 0)
 
     # -- TCP server (role of the reference's net/rpc endpoint) ------------
     # RPC surface exposed over TCP — everything else is unreachable

@@ -183,12 +183,16 @@ class RpcServer:
                     while True:
                         try:
                             msg = read_msg(self.rfile)
-                        except json.JSONDecodeError as e:
-                            # malformed but well-framed: report, keep serving
+                        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                            # malformed header: report, then CLOSE. If the
+                            # unparseable header declared __segs__, their raw
+                            # bytes are still on the wire and cannot be
+                            # skipped — reading on would parse tensor bytes
+                            # as the next length prefix and silently desync
                             write_frame(self.wfile,
                                         {"ok": False,
                                          "error": f"bad frame: {e}"})
-                            continue
+                            return
                         if msg is None:
                             return
                         req, segs = msg

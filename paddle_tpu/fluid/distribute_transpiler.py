@@ -271,16 +271,22 @@ class DistributeTranspiler:
                 w = (op.desc.inputs.get("W") or [""])[0]
                 if w in self.param_assignment:
                     dist.add(w)
-        import re as _re
-
-        # a distributed table's optimizer accumulators (<w>_moment1_0 etc.)
-        # are vocab-sized too, and their optimize ops were stripped to the
-        # pserver — initializing them on the trainer would materialize the
-        # very arrays this pruning exists to avoid
-        pats = [_re.compile(rf"^{_re.escape(w)}(_\w+)?$") for w in dist]
+        # a distributed table's optimizer accumulators are vocab-sized too,
+        # and their optimize ops were stripped to the pserver — initializing
+        # them on the trainer would materialize the very arrays this pruning
+        # exists to avoid. The prune set is EXACT: the table plus the output
+        # vars of its optimize ops (ParamOut/MomentOut/... name the in-place
+        # accumulator vars) — a wildcard <w>_* suffix would also swallow
+        # unrelated params that merely share the prefix (e.g. 'emb_proj'
+        # next to table 'emb')
+        prune = set(dist)
+        for op in self._program.global_block().ops:
+            outs = set(op.desc.output_names())
+            if dist & outs:
+                prune.update(outs)
 
         def _is_dist(n):
-            return any(p.match(n) for p in pats)
+            return n in prune
 
         pruned = self._startup.clone()
         block = pruned.global_block()

@@ -332,6 +332,18 @@ def _run_send_ops(send_ops, values: Dict[str, Any],
                     rows, vals = rows[keep], vals[keep]
                 v = SelectedRows(rows.astype(np.int64), vals,
                                  int(info["vocab"]))
+            elif gname in sparse_remap:
+                # a remapped grad that arrives dense is [batch-ids, dim]
+                # sub-table shaped — pushing it against the [vocab, dim]
+                # pserver param would fail (or mis-apply) far from the
+                # cause; fail HERE with the cause named
+                info = sparse_remap[gname]
+                raise RuntimeError(
+                    f"send op: grad '{gname}' for prefetched table "
+                    f"'{info['param']}' arrived dense (shape "
+                    f"{np.asarray(v).shape}) but must be SelectedRows "
+                    "over local sub-table rows — the lookup_table grad "
+                    "emitter fell back to a dense gradient")
             elif not is_selected_rows(v):
                 v = np.asarray(v)
             resp = get_client(eps[gname]).call(

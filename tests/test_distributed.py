@@ -95,6 +95,42 @@ def test_master_snapshot_recovery(tmp_path):
     assert svc2.all_done()
 
 
+def test_master_set_dataset_idempotent_after_recover(tmp_path):
+    """The set_dataset idempotency guard must survive a restart (ADVICE
+    r4, master.py:97): after recovery, the first worker re-registering the
+    UNCHANGED shard list must not reset the queues — a reset would
+    invalidate in-flight leases and re-serve finished tasks. The pass
+    counter survives too."""
+    snap = str(tmp_path / "master.snap")
+    shards = _shards(tmp_path)
+    svc = MasterService(chunks_per_task=2, lease_timeout=60,
+                        snapshot_path=snap)
+    svc.set_dataset(shards)
+    done_one = svc.get_task()
+    svc.task_finished(done_one.id)
+
+    svc2 = MasterService(chunks_per_task=2, lease_timeout=60,
+                         snapshot_path=snap)
+    before = svc2.stats()
+    assert before["done"] == 1
+    svc2.set_dataset(shards)  # worker (re)joining after the restart
+    assert svc2.stats() == before, "unchanged set_dataset reset the queues"
+    # a CHANGED list still resets (that is a genuinely new dataset)
+    svc2.set_dataset(shards[:2])
+    assert svc2.stats()["done"] == 0 and svc2.stats()["todo"] == 1
+
+    # pass counter survives recovery
+    svc3 = MasterService(chunks_per_task=6, lease_timeout=60,
+                         snapshot_path=str(tmp_path / "m2.snap"))
+    svc3.set_dataset(shards)
+    t = svc3.get_task()
+    svc3.task_finished(t.id)
+    assert svc3.new_pass()
+    svc4 = MasterService(chunks_per_task=6, lease_timeout=60,
+                         snapshot_path=str(tmp_path / "m2.snap"))
+    assert svc4.stats()["pass"] == 1
+
+
 def test_master_snapshot_corruption_detected(tmp_path):
     snap = str(tmp_path / "master.snap")
     svc = MasterService(snapshot_path=snap)

@@ -494,6 +494,50 @@ def test_rpc_oversized_response_reports_error_frame():
         server.shutdown()
 
 
+def test_rpc_bad_header_closes_connection():
+    """A header frame that fails JSON decode may be followed by raw
+    __segs__ bytes the server cannot skip — it must reply with one error
+    frame and CLOSE, never read the tensor bytes as the next length prefix
+    (ADVICE r4, rpc.py:186)."""
+    import socket
+    import struct as _struct
+
+    from paddle_tpu.distributed.rpc import RpcServer, read_frame
+
+    server = RpcServer({"ping": lambda: "pong"})
+    host, port = server.serve()
+    try:
+        sock = socket.create_connection((host, port), timeout=10)
+        try:
+            # well-framed but unparseable header, followed by 64 raw bytes
+            # that WOULD desync the stream if the server kept reading
+            bad = b'{"method": "push", "__segs__": [64]'  # truncated JSON
+            sock.sendall(_struct.pack("<I", len(bad)) + bad)
+            sock.sendall(b"\x00" * 64)
+            rf = sock.makefile("rb")
+            resp = read_frame(rf)
+            assert resp["ok"] is False and "bad frame" in resp["error"]
+            # server closed: next read hits EOF, no desynced second reply
+            assert rf.read(4) == b""
+        finally:
+            sock.close()
+        # invalid-UTF-8 header (tensor bytes misread as a header — the
+        # likeliest real-world shape of a desynced stream) gets the same
+        # error-then-close treatment, not an uncaught UnicodeDecodeError
+        sock = socket.create_connection((host, port), timeout=10)
+        try:
+            raw = b"\xff\xfe\x00garbage"
+            sock.sendall(_struct.pack("<I", len(raw)) + raw)
+            rf = sock.makefile("rb")
+            resp = read_frame(rf)
+            assert resp["ok"] is False and "bad frame" in resp["error"]
+            assert rf.read(4) == b""
+        finally:
+            sock.close()
+    finally:
+        server.shutdown()
+
+
 def _emb_model(vocab=100_000, dim=16, seed=7):
     """≥100k-vocab distributed embedding model (reference
     distributed_lookup_table_design.md scale target)."""
@@ -706,6 +750,11 @@ def test_trainer_startup_prunes_table_and_accumulators():
                                param_attr=fluid.ParamAttr(name="padam.w"))
         pred = layers.fc(input=emb, size=1,
                          param_attr=fluid.ParamAttr(name="padam.fc.w"))
+        # prefix-colliding UNRELATED param: shares the table's name as a
+        # prefix but is a dense trainer-side param (ADVICE r4 — a wildcard
+        # '<table>_*' prune would silently drop its initializer)
+        pred = layers.fc(input=pred, size=1,
+                         param_attr=fluid.ParamAttr(name="padam.w_proj"))
         cost = layers.mean(layers.square_error_cost(input=pred, label=y))
         fluid.optimizer.Adam(learning_rate=0.01).minimize(cost)
     t = DistributeTranspiler()
@@ -713,13 +762,20 @@ def test_trainer_startup_prunes_table_and_accumulators():
                 pservers="127.0.0.1:9", trainers=1, sync_mode=False)
     ts = t.get_trainer_startup_program()
     names = set(ts.global_block().vars)
-    assert not any(n == "padam.w" or n.startswith("padam.w_")
-                   for n in names), sorted(names)
+    assert "padam.w" not in names, sorted(names)
+    assert not any(n.startswith("padam.w_moment") for n in names), \
+        sorted(names)
     # the startup DID have vocab-sized accumulators before pruning
     orig = set(startup.global_block().vars)
     assert any(n.startswith("padam.w_moment") for n in orig), sorted(orig)
     # the dense fc param stays
     assert any(n.startswith("padam.fc.w") for n in names)
+    # the prefix-colliding dense param and ITS initializer survive: pruning
+    # is by exact optimize-op output names, not name prefix
+    assert "padam.w_proj" in names, sorted(names)
+    init_outs = {n for op in ts.global_block().ops
+                 for n in op.desc.output_names()}
+    assert "padam.w_proj" in init_outs
 
 
 def test_sync_four_trainers_through_executor_ops():
