@@ -166,6 +166,19 @@ class MasterService:
             self._snapshot_locked()
             return True
 
+    def task_released(self, task_id: int, epoch: Optional[int] = None) -> bool:
+        """Voluntary lease release (client abandons a pass mid-task):
+        requeue IMMEDIATELY and WITHOUT a failure mark — unlike
+        task_failed, releasing is not evidence the task is bad, so it must
+        not count toward failure_max's drop threshold."""
+        with self._mu:
+            p = self._pop_pending(task_id, epoch)
+            if p is None:
+                return False
+            self._todo.append(p.task)
+            self._snapshot_locked()
+            return True
+
     def all_done(self) -> bool:
         with self._mu:
             self._check_timeouts_locked()
@@ -285,7 +298,7 @@ class MasterService:
     # RPC surface exposed over TCP — everything else is unreachable
     _RPC_METHODS = frozenset({
         "set_dataset", "get_task", "task_finished", "task_failed",
-        "all_done", "new_pass", "stats",
+        "task_released", "all_done", "new_pass", "stats",
     })
 
     # frames larger than this are a protocol violation (a real set_dataset
@@ -386,12 +399,16 @@ class MasterClient:
 
     def __init__(self, addr=None, service: Optional[MasterService] = None,
                  addr_resolver=None, reconnect_retries: int = 8,
-                 reconnect_backoff: float = 0.2):
+                 reconnect_backoff: float = 0.2,
+                 timeout: Optional[float] = None):
         """`addr_resolver`: zero-arg callable returning (host, port) of the
         CURRENT master (see election.endpoint_resolver) — consulted on every
         (re)connect, so a standby takeover is followed automatically.
-        Retries with backoff span the election gap after a master crash."""
+        Retries with backoff span the election gap after a master crash.
+        `timeout`: dial + per-RPC deadline in seconds (None = block forever)
+        — the role of the reference ctypes client's timeout_sec."""
         self._service = service
+        self._timeout = timeout
         if isinstance(addr, str):  # "host:port" accepted everywhere
             host, _, port = addr.rpartition(":")
             addr = (host or "127.0.0.1", int(port))
@@ -428,7 +445,12 @@ class MasterClient:
             try:
                 if self._sock is None:
                     addr = self._resolver() if self._resolver else self._addr
-                    self._sock = socket.create_connection(addr)
+                    # timeout covers the dial AND every subsequent
+                    # read/write on the socket (a wedged master surfaces
+                    # as socket.timeout -> OSError -> retry/raise, not a
+                    # silent hang)
+                    self._sock = socket.create_connection(
+                        addr, timeout=self._timeout)
                     self._rfile = self._sock.makefile("rb")
                     self._wfile = self._sock.makefile("wb")
                 # sender-side cap must match the SERVER's read cap, or an
@@ -464,6 +486,10 @@ class MasterClient:
     def task_failed(self, task_id: int, epoch: Optional[int] = None) -> bool:
         return self._call("task_failed", task_id, epoch)
 
+    def task_released(self, task_id: int, epoch: Optional[int] = None) -> bool:
+        """Voluntarily return a leased task to todo, without failure mark."""
+        return self._call("task_released", task_id, epoch)
+
     def all_done(self) -> bool:
         return self._call("all_done")
 
@@ -474,14 +500,20 @@ class MasterClient:
     def stats(self):
         return self._call("stats")
 
-    def records(self, poll_interval: float = 0.2):
+    def records(self, poll_interval: float = 0.2, should_stop=None):
         """Iterate every record of the leased tasks until the dataset is
         exhausted (role of client.go NextRecord): lease task -> stream its
         recordio shards -> mark finished; crashes mid-task just let the
-        lease expire and another trainer re-reads it."""
+        lease expire and another trainer re-reads it. `should_stop`:
+        zero-arg callable polled while WAITING for a task — lets a
+        prefetch pump abandon the pass even when it is parked in the poll
+        loop (another trainer holding the last lease), not just at a
+        yield."""
         from ..native.recordio import multi_file_reader
 
         while True:
+            if should_stop is not None and should_stop():
+                return
             task = self.get_task()
             if task is None:
                 if self.all_done():
@@ -491,6 +523,12 @@ class MasterClient:
             try:
                 for rec in multi_file_reader(task.paths):
                     yield rec
+            except GeneratorExit:
+                # consumer abandoned the pass (gen.close()): hand the
+                # lease back NOW so the task re-serves immediately instead
+                # of after lease_timeout — and without a failure mark
+                self.task_released(task.id, task.epoch)
+                raise
             except Exception:
                 self.task_failed(task.id, task.epoch)
                 raise

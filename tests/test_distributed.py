@@ -594,6 +594,141 @@ def test_worker_registry_same_id_two_processes_get_two_slots(tmp_path):
     b.deregister()
 
 
+def test_master_client_timeout_sec(tmp_path):
+    """`timeout_sec` must be a real dial+RPC deadline (reference ctypes
+    client honored it, python/paddle/v2/master/client.py:25): a master
+    that accepts but never replies surfaces as a bounded ConnectionError,
+    not a hang."""
+    import socket as _socket
+    import time as _time
+
+    from paddle_tpu.distributed.master import MasterClient
+    from paddle_tpu.v2.master import client as v2c
+
+    silent = _socket.socket()
+    silent.bind(("127.0.0.1", 0))
+    silent.listen(8)
+    try:
+        c = MasterClient(addr=silent.getsockname(), timeout=0.5,
+                         reconnect_retries=0)
+        t0 = _time.monotonic()
+        with pytest.raises(ConnectionError):
+            c.get_task()
+        assert _time.monotonic() - t0 < 5.0
+        # the v2 facade threads timeout_sec through to the socket deadline
+        fc = v2c(silent.getsockname(), timeout_sec=3)
+        assert fc._client._timeout == 3.0
+    finally:
+        silent.close()
+
+
+def test_v2_master_client_buf_size_prefetch(tmp_path):
+    """buf_size > 0 prefetches records through a BOUNDED background queue
+    (role of the reference Go client's buffered record channel) — every
+    record still arrives exactly once, across multiple passes."""
+    import pickle as _p
+
+    from paddle_tpu.fluid.recordio_writer import (
+        convert_reader_to_recordio_file,
+    )
+    from paddle_tpu.v2.master import client as v2c
+
+    shards = []
+    for i in range(3):
+        p = str(tmp_path / f"buf_{i}.recordio")
+        convert_reader_to_recordio_file(
+            p, lambda i=i: iter([i * 10 + j for j in range(4)]))
+        shards.append(p)
+    svc = MasterService(chunks_per_task=1, lease_timeout=60)
+    addr = svc.serve()
+    try:
+        c = v2c(addr, buf_size=2)
+        c.set_dataset(shards)
+        assert c._pump is not None and c._pump.q.maxsize == 2
+        pass0 = []
+        while True:
+            r = c.next_record()
+            if r is None:
+                break
+            pass0.append(_p.loads(r))
+        assert sorted(pass0) == sorted(i * 10 + j
+                                       for i in range(3) for j in range(4))
+        # after end of pass, further calls keep returning None (same
+        # contract as the unbuffered path — not a RuntimeError)
+        assert c.next_record() is None
+        assert c.next_record() is None
+        # second pass through the same bounded-queue path
+        c.paddle_start_get_records(1)
+        pass1 = []
+        while True:
+            r = c.next_record()
+            if r is None:
+                break
+            pass1.append(_p.loads(r))
+        assert sorted(pass1) == sorted(pass0)
+        # starting a pass with records UNCONSUMED must neither deadlock
+        # nor stream the leftovers over the wire: the pump stops at its
+        # next queue-put and RELEASES its in-flight lease (no failure
+        # mark, immediate requeue)
+        import time as _time
+
+        c.paddle_start_get_records(2)
+        assert c.next_record() is not None
+        t0 = _time.monotonic()
+        c.paddle_start_get_records(3)
+        assert _time.monotonic() - t0 < 2.0, "abandon streamed the pass"
+        assert c.next_record() is not None
+        st = svc.stats()
+        assert st["dropped"] == 0
+        # a NEW dataset mid-pass retires the old pump before the reset —
+        # two pumps must never lease from the same client concurrently
+        shards2 = []
+        for i in range(2):
+            p = str(tmp_path / f"buf2_{i}.recordio")
+            convert_reader_to_recordio_file(
+                p, lambda i=i: iter([100 + i * 10 + j for j in range(4)]))
+            shards2.append(p)
+        c.set_dataset(shards2)
+        got = []
+        while True:
+            r = c.next_record()
+            if r is None:
+                break
+            got.append(_p.loads(r))
+        assert sorted(got) == [100, 101, 102, 103, 110, 111, 112, 113]
+        c.release()
+    finally:
+        svc.shutdown()
+
+
+def test_v2_master_client_prefetch_error_surfaces(tmp_path):
+    """A reader error inside the prefetch pump must re-raise from
+    next_record(), NOT read as a silent end-of-pass (truncated training
+    data)."""
+    from paddle_tpu.fluid.recordio_writer import (
+        convert_reader_to_recordio_file,
+    )
+    from paddle_tpu.v2.master import client as v2c
+
+    good = str(tmp_path / "good.recordio")
+    convert_reader_to_recordio_file(good, lambda: iter(range(4)))
+    corrupt = str(tmp_path / "corrupt.recordio")
+    with open(corrupt, "wb") as f:
+        f.write(b"\x00not a recordio file\xff" * 16)
+    # corrupt shard FIRST: the failure path must fire before any records
+    svc = MasterService(chunks_per_task=2, lease_timeout=60, failure_max=1)
+    addr = svc.serve()
+    try:
+        c = v2c(addr, buf_size=4)
+        c.set_dataset([corrupt, good])
+        with pytest.raises(Exception):
+            while c.next_record() is not None:
+                pass
+        c.release()
+    finally:
+        svc.shutdown()
+
+
 def test_cloud_reader_creator(tmp_path):
     """reader.creator.cloud_reader drains a master-managed dataset
     (reference v2 cloud_reader over the Go master, here over

@@ -197,3 +197,34 @@ def test_fluid_benchmark_suite_quick_mode():
     for r in by_name.values():
         assert r["finite"] and r["distinct_losses"] >= 2, r
         assert r["quick_mode"] and r["backend"] == "cpu", r
+
+
+def test_graft_entry_is_full_train_step():
+    """VERDICT r4 weak 7: entry() must compile-check what bench.py
+    measures — batch-norm TRAINING stats, the backward, and the Momentum
+    update — not a forward-only inference graph."""
+    import os
+    import sys
+
+    import jax
+    import numpy as np
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    try:
+        import __graft_entry__ as g
+    finally:
+        sys.path.pop(0)
+    fn, args = g.entry()
+    state, img, label = args
+    loss, new_state = jax.jit(fn)(state, img, label)
+    loss = float(np.asarray(loss).reshape(-1)[0])
+    assert np.isfinite(loss)
+    # the optimizer ran: trainable params moved
+    moved = [k for k in new_state
+             if k in state and np.asarray(state[k]).dtype.kind == "f"
+             and not np.array_equal(np.asarray(state[k]),
+                                    np.asarray(new_state[k]))]
+    assert len(moved) > 100, len(moved)
+    # momentum velocity accumulators are part of the carried state
+    assert any("velocity" in k for k in new_state), sorted(new_state)[:5]
