@@ -12,6 +12,13 @@ DATA_HOME = os.environ.get(
 )
 
 
+def data_home() -> str:
+    """Resolve the data root at CALL time (not import time) so a test or
+    launcher can point PADDLE_TPU_DATA at real-format files after the
+    package is already imported."""
+    return os.environ.get("PADDLE_TPU_DATA", DATA_HOME)
+
+
 def rng(name: str, split: str) -> np.random.Generator:
     # crc32, not hash(): python's hash is salted per process, which would
     # make "deterministic" synthetic data differ between processes
@@ -25,6 +32,13 @@ def rng(name: str, split: str) -> np.random.Generator:
 _REQUIRED_FILES = {
     "mnist": ["train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
               "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"],
+    # 'cifar' covers the CIFAR-10 readers; CIFAR-100 provenance must be
+    # queried with the explicit file (data_source("cifar",
+    # "cifar-100-python.tar.gz")) since either tarball can be dropped
+    # without the other
+    "cifar": ["cifar-10-python.tar.gz"],
+    "imdb": ["aclImdb_v1.tar.gz"],
+    "wmt14": ["wmt14.tgz"],
 }
 
 
@@ -36,7 +50,7 @@ def data_source(name: str, *relative_files: str) -> str:
     reader to real data). Pass the file list explicitly for datasets not
     in _REQUIRED_FILES; a bare name with no known file list conservatively
     reports 'synthetic' rather than guessing from a non-empty directory."""
-    base = os.path.join(DATA_HOME, name)
+    base = os.path.join(data_home(), name)
     files = list(relative_files) or _REQUIRED_FILES.get(name)
     if not files:
         return "synthetic"

@@ -28,7 +28,7 @@ def _load_idx(images_path, labels_path):
 
 
 def _reader_creator(split: str, limit: int):
-    data_dir = os.path.join(common.DATA_HOME, "mnist")
+    data_dir = os.path.join(common.data_home(), "mnist")
     prefix = "train" if split == "train" else "t10k"
     images_path = os.path.join(data_dir, f"{prefix}-images-idx3-ubyte.gz")
     labels_path = os.path.join(data_dir, f"{prefix}-labels-idx1-ubyte.gz")

@@ -27,6 +27,9 @@ FLAGS: Dict[str, Any] = {
     # conv/matmul (master weights and the rest of the graph stay f32) —
     # the standard TPU training configuration
     "amp": False,
+    # tally while-loop step-fn evaluations via a host callback (tests use
+    # it to pin the checkpointed while-grad at O(T) step evals)
+    "count_while_step_evals": False,
     # escalate UNEXPECTED shape-inference failures (emitter bugs) from a
     # warn-once to a hard build-time error — the reference InferShape
     # enforce semantics (shape_inference.h). CI enables this; the warn
@@ -80,4 +83,4 @@ def trace_flags() -> tuple:
     executor jit-cache key must include them, or toggling a flag after the
     first run of a program would be silently ignored."""
     return (FLAGS["matmul_precision"], FLAGS["use_pallas_kernels"],
-            FLAGS["amp"])
+            FLAGS["amp"], FLAGS["count_while_step_evals"])

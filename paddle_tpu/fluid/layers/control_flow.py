@@ -251,12 +251,19 @@ class While:
     lax.while_loop: O(1) extra memory but O(T^2) recompute, so prefer
     max_steps when a bound is known."""
 
-    def __init__(self, cond, name=None, max_steps=None):
+    def __init__(self, cond, name=None, max_steps=None,
+                 grad_segment_len=None, grad_max_segments=None):
+        """`grad_segment_len` (S) / `grad_max_segments` (C) tune the
+        unbounded-While gradient's segment-checkpointed replay (defaults
+        S=32, C=128): backward costs ~3T step evaluations for trip counts
+        up to S*C, with S + C carry copies of extra memory."""
         self.helper = LayerHelper("while", name=name)
         if cond.dtype != "bool":
             raise TypeError("condition should be a bool variable")
         self.cond_var = cond
         self.max_steps = int(max_steps) if max_steps else 0
+        self.grad_segment_len = int(grad_segment_len or 0)
+        self.grad_max_segments = int(grad_max_segments or 0)
 
     @contextlib.contextmanager
     def block(self):
@@ -282,6 +289,8 @@ class While:
                     "cond_var_name": self.cond_var.name,
                     "out_var_names": carried,
                     "max_steps": self.max_steps,
+                    "grad_segment_len": self.grad_segment_len,
+                    "grad_max_segments": self.grad_max_segments,
                 },
             )
 

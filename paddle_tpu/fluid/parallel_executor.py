@@ -66,7 +66,13 @@ class ParallelExecutor:
         use_tpu: Optional[bool] = None,
         mesh: Optional[Mesh] = None,
         sharding_plan=None,
+        collect_cost: bool = False,
     ):
+        """`collect_cost`: compile through the AOT path and expose XLA's
+        cost analysis of the sharded executable as
+        `self.last_cost_analysis` ({"flops", "bytes_accessed"}) — the
+        dryrun records these per phase so a communication/remat regression
+        shows up as a number, not just a slower wall clock."""
         from ..parallel import ShardingPlan
 
         self._program = main_program or default_main_program()
@@ -81,6 +87,8 @@ class ParallelExecutor:
             share_vars_from._scope if share_vars_from is not None else global_scope()
         )
         self._cache: Dict[Any, Any] = {}
+        self._collect_cost = bool(collect_cost)
+        self.last_cost_analysis: Optional[Dict[str, float]] = None
 
     @property
     def device_count(self) -> int:
@@ -197,10 +205,13 @@ class ParallelExecutor:
                 donate_argnums=(2,),
                 out_shardings=(None, out_state_shardings),
             )
-            entry = (jfn, ro_names, rw_names, tuple(state_out))
+            entry = {"jfn": jfn, "ro": ro_names, "rw": rw_names,
+                     "state_out": tuple(state_out), "compiled": None,
+                     "cost": None}
             self._cache[cache_key] = entry
 
-        jfn, ro_names, rw_names, state_out = entry
+        jfn, ro_names, rw_names, state_out = (
+            entry["jfn"], entry["ro"], entry["rw"], entry["state_out"])
 
         def _place(name, x):
             if multiproc:
@@ -222,7 +233,24 @@ class ParallelExecutor:
         # emitters that need explicit SPMD (ring attention) see the mesh
         # during tracing, which happens inside this first call
         with mesh_context(mesh):
-            fetches, new_state = jfn(feed_arrays, state_ro, state_rw, key)
+            if self._collect_cost:
+                if entry["compiled"] is None:
+                    compiled = jfn.lower(
+                        feed_arrays, state_ro, state_rw, key).compile()
+                    ca = compiled.cost_analysis()
+                    if isinstance(ca, (list, tuple)):
+                        ca = ca[0] if ca else {}
+                    entry["compiled"] = compiled
+                    entry["cost"] = {
+                        "flops": float(ca.get("flops", -1.0)),
+                        "bytes_accessed": float(
+                            ca.get("bytes accessed", -1.0)),
+                    }
+                self.last_cost_analysis = entry["cost"]
+                fetches, new_state = entry["compiled"](
+                    feed_arrays, state_ro, state_rw, key)
+            else:
+                fetches, new_state = jfn(feed_arrays, state_ro, state_rw, key)
         for n, v in new_state.items():
             self._scope.set_var(n, v)
         if return_numpy:

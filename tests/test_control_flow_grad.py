@@ -329,3 +329,79 @@ def test_dynamic_rnn_grad_bf16_mixed_exit_steps_vs_f64():
         denom = np.abs(want).max() + 1e-8
         rel = np.abs(np.asarray(got, np.float64) - want).max() / denom
         assert rel < 4e-2, (which, rel)
+
+
+def test_while_grad_step_evals_linear_in_T():
+    """VERDICT r4 item 5 done-bar: the unbounded while-grad is segment-
+    checkpointed replay — total step-fn evaluations for trip count T must
+    be ~4T (primal T + count/record T + segment rebuild ~T + vjp T), NOT
+    the O(T^2) of replay-from-zero (T=200 would be ~20k evals there)."""
+    from paddle_tpu.fluid.flags import set_flags
+    from paddle_tpu.fluid.ops import control_flow as cf
+
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 3
+    with program_guard(prog, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        n_steps = layers.data(name="n_steps", shape=[1], dtype="int64",
+                              append_batch_size=False)
+        y = layers.fc(input=x, size=4, param_attr="cnt_w", bias_attr=False)
+        i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        cond = layers.less_than(i, n_steps)
+        w = layers.While(cond)  # NO max_steps: dynamic trip count
+        with w.block():
+            y2 = layers.scale(y, scale=1.01)
+            layers.assign(y2, y)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n_steps, cond=cond)
+        loss = layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.0).minimize(loss)
+
+    T = 200
+    x_np = np.ones((2, 4), np.float32)
+    w0 = np.eye(4, dtype=np.float32)
+    set_flags({"count_while_step_evals": True})
+    try:
+        cf.step_evals_reset()
+        (g,), _ = _run(prog, startup,
+                       {"x": x_np, "n_steps": np.array([T], np.int64)},
+                       ["cnt_w@GRAD"], init={"cnt_w": w0})
+        evals = cf.step_evals()
+    finally:
+        set_flags({"count_while_step_evals": False})
+    expected = (1.01 ** T) * x_np.T @ (np.ones((2, 4), np.float32) / 8.0)
+    np.testing.assert_allclose(np.asarray(g), expected, rtol=1e-4)
+    # linear bound with slack for segment padding; quadratic would be ~20k
+    assert 0 < evals <= 6 * T + 400, evals
+
+
+def test_while_grad_checkpoint_overflow_stays_correct():
+    """Trip counts beyond S*C degrade to longer replays but must stay
+    numerically EXACT (overflow segments replay from the last slot)."""
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 3
+    with program_guard(prog, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        n_steps = layers.data(name="n_steps", shape=[1], dtype="int64",
+                              append_batch_size=False)
+        y = layers.fc(input=x, size=4, param_attr="ovf_w", bias_attr=False)
+        i = layers.fill_constant(shape=[1], dtype="int64", value=0)
+        cond = layers.less_than(i, n_steps)
+        # S*C = 24 << T = 60: three overflow segments replay from slot C-1
+        w = layers.While(cond, grad_segment_len=8, grad_max_segments=3)
+        with w.block():
+            y2 = layers.scale(y, scale=1.01)
+            layers.assign(y2, y)
+            layers.increment(i, value=1, in_place=True)
+            layers.less_than(i, n_steps, cond=cond)
+        loss = layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.0).minimize(loss)
+
+    T = 60
+    x_np = np.ones((2, 4), np.float32)
+    w0 = np.eye(4, dtype=np.float32)
+    (g,), _ = _run(prog, startup,
+                   {"x": x_np, "n_steps": np.array([T], np.int64)},
+                   ["ovf_w@GRAD"], init={"ovf_w": w0})
+    expected = (1.01 ** T) * x_np.T @ (np.ones((2, 4), np.float32) / 8.0)
+    np.testing.assert_allclose(np.asarray(g), expected, rtol=1e-4)
