@@ -380,6 +380,7 @@ _EXTRA_BENCHES = [
      {"FLASH_DTYPES": "bfloat16",
       "FLASH_BLOCKS": "128x128,256x256,512x256"}, 240, 480),
     ("transformer", "transformer_bench.py", {}, 240, 420),
+    ("conv_pallas_vs_xla", "conv_fused_bench.py", {}, 200, 360),
     ("input_pipeline", "input_pipeline_bench.py",
      {"PIPE_ITERS": "12"}, 200, 360),
     ("legacy_k40m", "legacy_conv_bench.py", {}, 200, 360),

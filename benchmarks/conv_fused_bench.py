@@ -1,0 +1,105 @@
+"""A/B the Pallas fused conv+bn+relu kernel against the XLA chain on
+ResNet-50 layer shapes (VERDICT r4 item 6: a prepared fallback if plain
+XLA convs miss the V100 bar — reference conv_mkldnn_op.cc alternate-kernel
+axis, SURVEY §7(e) conv/batchnorm fusion).
+
+Per shape, times one jitted step of
+  xla:    lax.conv -> per-channel affine -> relu  (XLA's own fusion)
+  pallas: fused_conv_bn_relu (blocked im2col GEMM, epilogue in VMEM)
+and prints one JSON row:
+  {"shape": ..., "xla_ms": N, "pallas_ms": N, "speedup": N, "backend": ...}
+
+On a TPU backend this is the decision table for enabling the kernel on
+the ResNet bench; on CPU it runs tiny shapes in interpret mode purely to
+prove the harness (labeled backend=cpu, not evidence).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # env-var platform selection is unreliable under this environment's
+    # sitecustomize (the TPU plugin registers in every process);
+    # jax.config BEFORE backend init is authoritative
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fluid.ops.pallas_kernels import fused_conv_bn_relu
+
+# (N, C, H, W, F, k, stride, padding) — the ResNet-50 conv population
+TPU_SHAPES = [
+    (32, 64, 56, 56, 64, 1, 1, 0),
+    (32, 64, 56, 56, 64, 3, 1, 1),
+    (32, 128, 28, 28, 128, 3, 1, 1),
+    (32, 256, 14, 14, 256, 3, 1, 1),
+    (32, 512, 7, 7, 512, 3, 1, 1),
+    (32, 256, 56, 56, 512, 1, 2, 0),
+]
+CPU_SHAPES = [(2, 8, 10, 10, 16, 3, 1, 1)]
+
+
+def _time(fn, *args, iters, warmup):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1000.0
+
+
+def main():
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
+    shapes = TPU_SHAPES if on_tpu else CPU_SHAPES
+    iters = int(os.environ.get("CONV_ITERS", "20" if on_tpu else "2"))
+    warmup = 2
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    rng = np.random.RandomState(0)
+    for (n, c, h, w, f, k, s, p) in shapes:
+        x = jnp.asarray(rng.randn(n, c, h, w), dtype)
+        wt = jnp.asarray(rng.randn(f, c, k, k) * 0.1, dtype)
+        scale = jnp.asarray(rng.rand(f) + 0.5, jnp.float32)
+        shift = jnp.asarray(rng.randn(f) * 0.1, jnp.float32)
+
+        @jax.jit
+        def xla_chain(x, wt, scale, shift):
+            out = jax.lax.conv_general_dilated(
+                x, wt, (s, s), [(p, p), (p, p)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            out = out.astype(jnp.float32)
+            out = out * scale.reshape(1, f, 1, 1) + shift.reshape(1, f, 1, 1)
+            return jnp.maximum(out, 0.0).astype(x.dtype)
+
+        @jax.jit
+        def pallas_chain(x, wt, scale, shift):
+            return fused_conv_bn_relu(x, wt, scale, shift, stride=s,
+                                      padding=p, relu=True,
+                                      interpret=not on_tpu)
+
+        row = {"shape": f"n{n}c{c}h{h}f{f}k{k}s{s}", "backend": backend}
+        try:
+            row["xla_ms"] = round(_time(xla_chain, x, wt, scale, shift,
+                                        iters=iters, warmup=warmup), 4)
+            row["pallas_ms"] = round(_time(pallas_chain, x, wt, scale,
+                                           shift, iters=iters,
+                                           warmup=warmup), 4)
+            row["speedup"] = round(row["xla_ms"] / row["pallas_ms"], 4)
+        except Exception as e:  # keep earlier rows on a mid-sweep failure
+            row["error"] = f"{type(e).__name__}: {e}"[:300]
+            print(json.dumps(row), flush=True)
+            raise SystemExit(1)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Source-level compatibility shims for the reference's legacy config
+surfaces. `trainer_config_helpers` lets the reference's own DSL config
+files (python/paddle/trainer_config_helpers/tests/configs/*.py) run
+unmodified against paddle_tpu (see tests/test_reference_configs.py)."""
+from . import trainer_config_helpers  # noqa: F401

@@ -41,11 +41,25 @@ def _load_dict(tarf, suffix: str, dict_size: int):
     return out
 
 
+# (tar mtime, dict_size) -> (src_dict, trg_dict): the dictionaries are
+# re-used every epoch AND by get_dict — parse the tarball once, not per
+# reader() call (imdb.build_dict memoizes for the same reason)
+_dict_cache: dict = {}
+
+
+def _load_dicts(dict_size: int):
+    key = (os.path.getmtime(_tar_path()), dict_size)
+    if key not in _dict_cache:
+        with tarfile.open(_tar_path(), mode="r") as f:
+            _dict_cache[key] = (_load_dict(f, "src.dict", dict_size),
+                                _load_dict(f, "trg.dict", dict_size))
+    return _dict_cache[key]
+
+
 def _real_reader(file_suffix: str, dict_size: int):
     def reader():
+        src_dict, trg_dict = _load_dicts(dict_size)
         with tarfile.open(_tar_path(), mode="r") as f:
-            src_dict = _load_dict(f, "src.dict", dict_size)
-            trg_dict = _load_dict(f, "trg.dict", dict_size)
             names = [m.name for m in f
                      if file_suffix in m.name and m.isfile()
                      and not m.name.endswith(".dict")]
@@ -96,9 +110,7 @@ def get_dict(dict_size=DICT_SIZE, reverse=False):
     """(src_dict, trg_dict); reverse=True returns id->word maps
     (reference wmt14.py:136)."""
     if os.path.exists(_tar_path()):
-        with tarfile.open(_tar_path(), mode="r") as f:
-            src = _load_dict(f, "src.dict", dict_size)
-            trg = _load_dict(f, "trg.dict", dict_size)
+        src, trg = _load_dicts(dict_size)
         if reverse:
             return ({v: k for k, v in src.items()},
                     {v: k for k, v in trg.items()})

@@ -12,10 +12,13 @@ Filesystem requirement: the lease path must live on a filesystem with
 WORKING POSIX advisory locks — local disk (multi-process single host) or
 NFSv4 with its lock manager. Object-store FUSE mounts (gcsfuse, s3fs) do
 NOT implement flock; on those, two candidates could both win. For
-cross-host deployments without lock-capable shared storage, point the
-lease at an etcd/ZooKeeper-backed mount or run the reference's etcd
-protocol — this module deliberately keeps the same campaign/TTL semantics
-so that swap is mechanical.
+cross-host deployments without lock-capable shared storage use
+tcp_lease.LeaseServer/TcpLease (same lease surface over the master RPC
+framing — pass `lease=TcpLease(...)` to ElectedMaster); the campaign/TTL
+semantics are identical, so the swap is one constructor argument.
+Defense-in-depth either way: a holder whose lease state is corrupted or
+stolen under it steps down on the next renew() and its fenced() commits
+raise MasterDeposed (tests/test_distributed.py adversarial-swap test).
 On takeover the new leader recovers the queue from the shared snapshot
 (master.py snapshot/recover), so leased work survives a master crash: the
 pending leases it cannot see simply time out and re-queue.
@@ -159,11 +162,14 @@ class ElectedMaster:
     MasterService recovered from the shared snapshot; steps down (stops
     serving) if the lease is lost."""
 
-    def __init__(self, lease_path: str, snapshot_path: str,
+    def __init__(self, lease_path: Optional[str], snapshot_path: str,
                  holder_id: Optional[str] = None, ttl: float = 5.0,
                  host: str = "127.0.0.1", renew_interval: Optional[float] = None,
-                 **service_kwargs):
-        self.lease = FileLease(
+                 lease=None, **service_kwargs):
+        # lease= swaps the coordination backend: any object with the
+        # FileLease surface works (tcp_lease.TcpLease for storage without
+        # trustworthy POSIX locks)
+        self.lease = lease if lease is not None else FileLease(
             lease_path, holder_id or f"master-{os.getpid()}-{id(self):x}",
             ttl)
         self._snapshot_path = snapshot_path

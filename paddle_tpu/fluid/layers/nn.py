@@ -11,7 +11,8 @@ from ..layer_helper import LayerHelper
 
 __all__ = [
     "fc", "embedding", "conv2d", "pool2d", "batch_norm", "layer_norm",
-    "conv2d_transpose", "dropout", "softmax", "cross_entropy",
+    "conv2d_transpose", "conv2d_bn_relu", "dropout", "softmax",
+    "cross_entropy",
     "softmax_with_cross_entropy", "square_error_cost", "accuracy", "topk",
     "mean", "mul", "matmul", "reshape", "transpose", "split", "l2_normalize",
     "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_prod",
@@ -143,6 +144,43 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     )
     pre_act = _append_channel_bias(helper, pre_bias)
     return helper.append_activation(pre_act)
+
+
+def conv2d_bn_relu(input, num_filters, filter_size, stride=1, padding=0,
+                   param_attr=None, scale_attr=None, shift_attr=None,
+                   relu=True, name=None):
+    """Fused conv + per-channel affine + relu — the inference-bn fold of
+    the ResNet hot chain (reference conv+bn fuse passes; alternate-kernel
+    axis conv_mkldnn_op.cc). Scale/Shift are learnable parameters here;
+    to run a trained conv+batch_norm pair through the fused op, assign
+    them the folded statistics (pallas_kernels.fold_bn)."""
+    helper = LayerHelper("conv2d_bn_relu", param_attr=param_attr, name=name)
+    dtype = input.dtype
+    num_channels = int(input.shape[1])
+    kh, kw = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else (int(filter_size[0]), int(filter_size[1]))
+    std = (2.0 / (kh * kw * num_channels)) ** 0.5
+    from ..initializer import ConstantInitializer, NormalInitializer
+
+    w = helper.create_parameter(
+        helper.param_attr, [num_filters, num_channels, kh, kw], dtype,
+        default_initializer=NormalInitializer(0.0, std))
+    scale = helper.create_parameter(
+        scale_attr, [num_filters], "float32",
+        default_initializer=ConstantInitializer(1.0))
+    shift = helper.create_parameter(
+        shift_attr, [num_filters], "float32", is_bias=True,
+        default_initializer=ConstantInitializer(0.0))
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv2d_bn_relu",
+        inputs={"X": [input], "Filter": [w], "Scale": [scale],
+                "Shift": [shift]},
+        outputs={"Out": [out]},
+        attrs={"stride": int(stride), "padding": int(padding),
+               "relu": bool(relu)},
+    )
+    return out
 
 
 def _append_channel_bias(helper, pre_bias):

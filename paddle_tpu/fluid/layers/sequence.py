@@ -212,6 +212,22 @@ def sequence_reshape(input, new_dim):
     return out
 
 
+def sequence_reverse(x, name=None):
+    """Reverse each sequence's valid prefix (reference
+    paddle/fluid/operators/sequence_reverse_op.h); padding stays put, so
+    the output shares x's lengths companion."""
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    lens = seq_lengths_of(x)
+    if lens is not None:
+        inputs["Lengths"] = [lens]
+    helper.append_op(type="sequence_reverse", inputs=inputs,
+                     outputs={"Out": [out]})
+    _propagate_lengths(x, out)
+    return out
+
+
 def sequence_concat(input, name=None):
     helper = LayerHelper("sequence_concat", name=name)
     out = helper.create_variable_for_type_inference(input[0].dtype)

@@ -43,6 +43,77 @@ def conv2d(ctx, ins, attrs):
     return {"Output": out}
 
 
+@register_op("conv2d_bn_relu",
+             ref="paddle/fluid/operators/conv_mkldnn_op.cc (the "
+                 "alternate-kernel axis) + inference conv+bn fuse passes")
+def conv2d_bn_relu(ctx, ins, attrs):
+    """Fused conv + folded-bn affine + relu (the ResNet inference hot
+    chain). Scale/Shift are the per-output-channel folded statistics
+    (pallas_kernels.fold_bn). Pallas blocked-GEMM path on a single
+    device; plain lax ops otherwise (GSPMD-shardable, and XLA still
+    fuses the epilogue)."""
+    x, w = one(ins, "X"), one(ins, "Filter")
+    scale, shift = one(ins, "Scale"), one(ins, "Shift")
+    s = int(attrs.get("stride", 1))
+    p = int(attrs.get("padding", 0))
+    relu = bool(attrs.get("relu", True))
+    from ...parallel import current_mesh
+    from ..flags import pallas_enabled, pallas_interpret
+
+    if pallas_enabled() and current_mesh() is None:
+        from .pallas_kernels import fused_conv_bn_relu
+
+        return {"Out": fused_conv_bn_relu(
+            x, w, scale, shift, stride=s, padding=p, relu=relu,
+            interpret=pallas_interpret())}
+    x, w, restore = amp_operands(x, w)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(s, s), padding=[(p, p), (p, p)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    out = out.astype(jnp.float32)
+    f = w.shape[0]
+    out = out * scale.reshape(1, f, 1, 1) + shift.reshape(1, f, 1, 1)
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    if restore is not None:
+        out = out.astype(restore)
+    return {"Out": out}
+
+
+@register_op("conv2d_input_filter",
+             ref="legacy ConvOperator/conv_operator (proj_conf with a "
+                 "computed filter layer, trainer/config_parser.py "
+                 "parse_operator) — per-sample filters via vmap")
+def conv2d_input_filter(ctx, ins, attrs):
+    """Convolve X with a COMPUTED per-sample filter tensor (both inputs
+    differentiable; the generic vjp covers the grad). trans=True is the
+    transposed form, lowered as dilated correlation with the IO-swapped,
+    spatially-flipped kernel."""
+    x = one(ins, "X")  # [N, C, H, W]
+    f = one(ins, "Filter")  # [N, F, C, k, k] in BOTH modes (F = out chans)
+    s = int(attrs.get("stride", 1))
+    p = int(attrs.get("padding", 0))
+    trans = bool(attrs.get("trans", False))
+    k = f.shape[-1]
+
+    def one_sample(xi, fi):
+        if trans:
+            fi = jnp.flip(fi, axis=(-2, -1))
+            out = jax.lax.conv_general_dilated(
+                xi[None], fi, window_strides=(1, 1),
+                padding=[(k - 1 - p, k - 1 - p)] * 2,
+                lhs_dilation=(s, s),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        else:
+            out = jax.lax.conv_general_dilated(
+                xi[None], fi, window_strides=(s, s),
+                padding=[(p, p), (p, p)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return out[0]
+
+    return {"Out": jax.vmap(one_sample)(x, f)}
+
+
 @register_op("depthwise_conv2d", ref="paddle/fluid/operators/conv_op.cc (depthwise)")
 def depthwise_conv2d(ctx, ins, attrs):
     attrs = dict(attrs)
@@ -266,7 +337,8 @@ def lrn(ctx, ins, attrs):
     beta = float(attrs.get("beta", 0.75))
     sq = jnp.square(x)
     half = n // 2
-    pads = ((0, 0), (half, half), (0, 0), (0, 0))
+    # (half, n-1-half) keeps the channel count for even windows too
+    pads = ((0, 0), (half, n - 1 - half), (0, 0), (0, 0))
     acc = jax.lax.reduce_window(sq, 0.0, jax.lax.add, (1, n, 1, 1), (1, 1, 1, 1), pads)
     mid = k + alpha * acc
     return {"Out": x / jnp.power(mid, beta), "MidOut": mid}

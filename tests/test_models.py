@@ -60,6 +60,27 @@ def test_resnet_cifar_smoke():
         assert bn_means
 
 
+def test_resnet_cifar_fused_inference_build():
+    """fused=True builds the whole net through conv2d_bn_relu (the
+    inference conv+bn fold; Pallas alternate kernel under the flag) and
+    executes a forward pass."""
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup):
+            img = layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+            logits = resnet.resnet_cifar10(img, class_dim=10, depth=8,
+                                           is_test=True, fused=True)
+        assert any(op.type == "conv2d_bn_relu"
+                   for op in main.global_block().ops)
+        assert not any(op.type == "batch_norm"
+                       for op in main.global_block().ops)
+        exe = fluid.Executor()
+        exe.run(startup)
+        x = np.random.RandomState(1).rand(2, 3, 32, 32).astype(np.float32)
+        (out,) = exe.run(main, feed={"img": x}, fetch_list=[logits])
+        assert out.shape == (2, 10) and np.isfinite(out).all()
+
+
 def test_resnet50_imagenet_builds():
     main, startup = Program(), Program()
     with program_guard(main, startup):

@@ -284,3 +284,145 @@ def test_flash_attention_bf16_fwd_and_grads(causal):
         g32, r32 = np.asarray(g, np.float32), np.asarray(r)
         denom = np.abs(r32).max() + 1e-6
         assert np.abs(g32 - r32).max() / denom < 0.15
+
+
+# --- fused conv + folded-bn + relu (VERDICT r4 item 6: the ResNet hot
+# chain as a blocked Pallas GEMM; reference conv_mkldnn_op.cc axis) --------
+
+
+def _conv_ref(x, w, scale, shift, stride, padding, relu):
+    out = jax.lax.conv_general_dilated(
+        x.astype(jnp.float32), w.astype(jnp.float32),
+        window_strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    f = w.shape[0]
+    out = out * scale.reshape(1, f, 1, 1) + shift.reshape(1, f, 1, 1)
+    return jnp.maximum(out, 0.0) if relu else out
+
+
+@pytest.mark.parametrize("shape,f,k,stride,padding,relu", [
+    ((2, 8, 10, 10), 16, 3, 1, 1, True),     # resnet-style 3x3
+    ((2, 8, 9, 9), 12, 3, 2, 0, True),       # stride-2, odd spatial, odd F
+    ((2, 16, 7, 7), 32, 1, 1, 0, False),     # 1x1 projection, no relu
+    ((1, 3, 12, 12), 7, 5, 2, 2, True),      # 5x5, prime F (pad path)
+])
+def test_fused_conv_bn_relu_forward(shape, f, k, stride, padding, relu):
+    from paddle_tpu.fluid.ops.pallas_kernels import fused_conv_bn_relu
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    w = jnp.asarray(rng.randn(f, shape[1], k, k).astype(np.float32) * 0.1)
+    scale = jnp.asarray(rng.rand(f).astype(np.float32) + 0.5)
+    shift = jnp.asarray(rng.randn(f).astype(np.float32) * 0.1)
+    got = fused_conv_bn_relu(x, w, scale, shift, stride=stride,
+                             padding=padding, relu=relu, block_m=32,
+                             block_f=128, interpret=True)
+    ref = _conv_ref(x, w, scale, shift, stride, padding, relu)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_fused_conv_bn_relu_grads():
+    from paddle_tpu.fluid.ops.pallas_kernels import fused_conv_bn_relu
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 4, 8, 8).astype(np.float32))
+    w = jnp.asarray(rng.randn(6, 4, 3, 3).astype(np.float32) * 0.2)
+    scale = jnp.asarray(rng.rand(6).astype(np.float32) + 0.5)
+    shift = jnp.asarray(rng.randn(6).astype(np.float32) * 0.1)
+
+    def loss(x, w, s, b):
+        return jnp.sum(fused_conv_bn_relu(
+            x, w, s, b, stride=1, padding=1, relu=True, block_m=32,
+            interpret=True) ** 2)
+
+    def ref_loss(x, w, s, b):
+        return jnp.sum(_conv_ref(x, w, s, b, 1, 1, True) ** 2)
+
+    got = jax.grad(loss, argnums=(0, 1, 2, 3))(x, w, scale, shift)
+    ref = jax.grad(ref_loss, argnums=(0, 1, 2, 3))(x, w, scale, shift)
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_fused_conv_bn_relu_bf16():
+    from paddle_tpu.fluid.ops.pallas_kernels import fused_conv_bn_relu
+
+    rng = np.random.RandomState(2)
+    xf = rng.randn(2, 4, 8, 8).astype(np.float32)
+    wf = (rng.randn(8, 4, 3, 3) * 0.2).astype(np.float32)
+    scale = jnp.asarray(rng.rand(8).astype(np.float32) + 0.5)
+    shift = jnp.asarray(rng.randn(8).astype(np.float32) * 0.1)
+    x, w = jnp.asarray(xf, jnp.bfloat16), jnp.asarray(wf, jnp.bfloat16)
+    out = fused_conv_bn_relu(x, w, scale, shift, stride=1, padding=1,
+                             block_m=32, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = _conv_ref(jnp.asarray(xf), jnp.asarray(wf), scale, shift, 1, 1,
+                    True)
+    denom = np.abs(np.asarray(ref)).max() + 1e-6
+    assert np.abs(np.asarray(out, np.float32) - np.asarray(ref)).max() \
+        / denom < 0.1
+
+
+def test_fold_bn_matches_batch_norm_inference():
+    """fold_bn(gamma, beta, mean, var) + fused kernel == conv followed by
+    inference batch_norm + relu."""
+    from paddle_tpu.fluid.ops.pallas_kernels import (fold_bn,
+                                                     fused_conv_bn_relu)
+
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 4, 8, 8).astype(np.float32))
+    w = jnp.asarray(rng.randn(6, 4, 3, 3).astype(np.float32) * 0.2)
+    gamma = jnp.asarray(rng.rand(6).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(6).astype(np.float32))
+    mean = jnp.asarray(rng.randn(6).astype(np.float32) * 0.1)
+    var = jnp.asarray(rng.rand(6).astype(np.float32) + 0.2)
+    eps = 1e-5
+    scale, shift = fold_bn(gamma, beta, mean, var, eps)
+    got = fused_conv_bn_relu(x, w, scale, shift, stride=1, padding=1,
+                             block_m=32, interpret=True)
+    conv = jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    bn = (conv - mean.reshape(1, 6, 1, 1)) * jax.lax.rsqrt(
+        var.reshape(1, 6, 1, 1) + eps) * gamma.reshape(1, 6, 1, 1) \
+        + beta.reshape(1, 6, 1, 1)
+    ref = jnp.maximum(bn, 0.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_conv2d_bn_relu_op_uses_pallas_when_forced():
+    """Program-level: the conv2d_bn_relu layer routes through the fused
+    kernel under the flag and still trains (fwd+bwd through the op)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.flags import set_flags
+    from paddle_tpu.fluid.framework import Program, program_guard
+
+    set_flags({"use_pallas_kernels": True})
+    try:
+        main, startup, scope = Program(), Program(), fluid.Scope()
+        with fluid.scope_guard(scope):
+            with program_guard(main, startup):
+                x = layers.data(name="x", shape=[3, 8, 8], dtype="float32")
+                y = layers.data(name="y", shape=[1], dtype="float32")
+                h = layers.conv2d_bn_relu(x, num_filters=4, filter_size=3,
+                                          stride=1, padding=1)
+                pool = layers.pool2d(h, pool_size=8, pool_type="avg")
+                pred = layers.fc(input=pool, size=1)
+                cost = layers.mean(
+                    layers.square_error_cost(input=pred, label=y))
+                fluid.optimizer.SGD(learning_rate=0.01).minimize(cost)
+            exe = fluid.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(4)
+            feed = {"x": rng.randn(4, 3, 8, 8).astype(np.float32),
+                    "y": rng.randn(4, 1).astype(np.float32)}
+            l0 = exe.run(main, feed=feed, fetch_list=[cost])[0].item()
+            l1 = exe.run(main, feed=feed, fetch_list=[cost])[0].item()
+            assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+    finally:
+        set_flags({"use_pallas_kernels": "auto"})

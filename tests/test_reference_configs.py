@@ -1,0 +1,292 @@
+"""Run the reference's OWN trainer_config_helpers config files UNMODIFIED.
+
+Source files: /root/reference/python/paddle/trainer_config_helpers/tests/
+configs/*.py — the 58 DSL configs the reference's config-parser round-trip
+tests exec (reference tests/configs/run_tests.sh drove them through
+parse_config into protostr dumps; they were PARSE-only there).
+
+This harness goes further than the reference did: each config must BUILD
+into the default fluid program AND run one SGD training step on synthetic
+feeds with a finite loss (forward-only where a config has no trainable
+float output, e.g. unused_layers.py's sampling_id).
+
+Shim contract (the "documented shim import"):
+  - sys.modules['paddle'] / ['paddle.trainer_config_helpers'] point at
+    paddle_tpu.compat.trainer_config_helpers; the config source is exec'd
+    VERBATIM from the reference tree.
+  - per-config runtime input types (sequence-ness / integer-ness) are
+    declared before exec — the role the reference's DataProvider
+    declaration (PyDataProvider2 input_types) played; the config files
+    never carried that information in the reference either.
+  - per-config feed overrides supply semantically-valid synthetic data
+    where plain random tensors won't do (slice bounds, roi boxes, ...).
+
+Every skip is individually justified in SKIPS.
+"""
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.compat import trainer_config_helpers as tch
+from paddle_tpu.fluid import unique_name
+from paddle_tpu.fluid.framework import Program, program_guard
+
+CONFIG_DIR = ("/root/reference/python/paddle/trainer_config_helpers/"
+              "tests/configs")
+
+N, T = 4, 5  # synthetic batch / max sequence length
+
+
+def _roi_feed(rng, dim):
+    # roi_pool consumes cols (batch_idx, x1, y1, x2, y2); extra declared
+    # cols ride along ignored
+    rois = np.zeros((N, dim), np.float32)
+    rois[:, 0] = np.arange(N) % N
+    rois[:, 1:3] = 0
+    rois[:, 3:5] = 13
+    return rois
+
+
+def _indices_feed(rng, dim):
+    # scale_sub_region: per-sample [c0, c1, h0, h1, w0, w1], 1-based
+    return np.tile(np.array([1, 1, 2, 5, 2, 5], np.float32), (N, 1))
+
+
+def _starts_feed(rng, dim):
+    return rng.randint(0, 2, (N, dim)).astype(np.float32)
+
+
+def _ends_feed(rng, dim):
+    return (rng.randint(0, 2, (N, dim)) + 2).astype(np.float32)
+
+
+# file -> {"types": {data_layer_name: 'dense'|'int'|'seq'|'int_seq'},
+#          "feeds": {data_layer_name: fn(rng, dim) -> array}}
+CONFIGS = {
+    # trans_layer transposes the BATCH matrix ([N,D] -> [D,N]), so the
+    # following fc's width is the batch size — executable only at a
+    # pinned batch (the reference never executed this file at all)
+    "test_fc.py": {"fixed_batch": True},
+    "projections.py": {"types": {"test": "int"}},
+    # n=1: the two 256x256/227x227 full-resolution conv configs are the
+    # runtime hot spots of this suite on the CPU backend (32x32 pool
+    # windows -> select_and_scatter in the backward); batch is a runtime
+    # choice, not config content
+    "img_layers.py": {"n": 1},
+    "img_trans_layers.py": {"n": 1},
+    "layer_activations.py": {},
+    "math_ops.py": {},
+    "util_layers.py": {},
+    "shared_fc.py": {"types": {"label": "int"}},
+    "shared_gru.py": {"types": {"data_a": "seq", "data_b": "seq",
+                                "label": "int"}},
+    "shared_lstm.py": {"types": {"data_a": "seq", "data_b": "seq",
+                                 "label": "int"}},
+    "simple_rnn_layers.py": {"types": {"data": "seq"}},
+    "last_first_seq.py": {"types": {"data": "seq"}},
+    "test_sequence_pooling.py": {"types": {"dat_in": "seq"}},
+    "test_expand_layer.py": {"types": {"data_seq": "seq"}},
+    "test_bi_grumemory.py": {"types": {"data": "seq"}},
+    "test_grumemory_layer.py": {"types": {"data": "seq"}},
+    "test_lstmemory_layer.py": {"types": {"data": "seq"}},
+    "test_rnn_group.py": {"types": {"seq_input": "seq",
+                                    "sub_seq_input": "seq"}},
+    "test_cost_layers_with_weight.py": {
+        "types": {"label": "int", "multi_class_label": "int"},
+        "feeds": {"label": lambda rng, dim: rng.randint(
+            0, 10, (N, 1)).astype(np.int64)}},
+    "test_smooth_l1.py": {},
+    "test_hsigmoid.py": {"types": {"label": "int"}},
+    "test_maxout.py": {},
+    "test_pad.py": {},
+    "test_bilinear_interp.py": {},
+    "test_clip_layer.py": {},
+    "test_dot_prod_layer.py": {},
+    "test_l2_distance_layer.py": {},
+    "test_row_l2_norm_layer.py": {},
+    "test_scale_shift_layer.py": {},
+    "test_repeat_layer.py": {},
+    "test_resize_layer.py": {},
+    "test_seq_concat_reshape.py": {"types": {"data1": "seq",
+                                             "data2": "seq"}},
+    "test_seq_slice_layer.py": {
+        "types": {"word": "seq"},
+        "feeds": {"starts": _starts_feed, "ends": _ends_feed}},
+    "test_kmax_seq_socre_layer.py": {"types": {"input_seq": "seq"}},
+    "test_factorization_machine.py": {},
+    "test_gated_unit_layer.py": {},
+    "test_multiplex_layer.py": {
+        "types": {"index": "int"},
+        "feeds": {"index": lambda rng, dim: rng.randint(
+            0, 3, (N, 1)).astype(np.int64)}},
+    "test_prelu_layer.py": {},
+    "test_print_layer.py": {},
+    "test_recursive_topology.py": {},
+    "test_row_conv.py": {"types": {"data": "seq"}},
+    "test_scale_sub_region_layer.py": {"feeds": {"indices": _indices_feed}},
+    "test_roi_pool_layer.py": {"feeds": {"rois": _roi_feed}},
+    "test_ntm_layers.py": {},
+    "test_spp_layer.py": {},
+    "unused_layers.py": {},
+    "test_conv3d_layer.py": {},
+    "test_deconv3d_layer.py": {},
+    "test_BatchNorm3D.py": {},
+    "test_pooling3D_layer.py": {},
+}
+
+SKIPS = {
+    "test_cost_layers.py":
+        "parse-only in the reference and not executable as written: it "
+        "pairs shape-incompatible layers (huber_regression_cost over a "
+        "200-wide sequence against a 5000-vocab id sequence; xe_label "
+        "consumed both as a class id and as a 10-wide multi-binary "
+        "vector). The individual cost layers are executed by "
+        "test_cost_layers_with_weight.py / test_smooth_l1.py here and "
+        "tests/test_v2_layers_sweep.py::test_cost_family_executes.",
+    "test_crop.py":
+        "broken in the reference itself: `outputs(pad)` references an "
+        "undefined name (no `pad` in trainer_config_helpers) and two "
+        "data layers share the name 'data' — no exec-based parser can "
+        "run it. crop_layer executes in test_v2_layers_sweep.py.",
+    "test_sub_nested_seq_select_layer.py":
+        "sub_nested_seq_layer selects inner sequences of a 2-level LoD; "
+        "nested raggedness is deliberately flattened by the "
+        "padded+lengths sequence model (v2/layer.py module docstring, "
+        "SURVEY §5.7).",
+    "test_cross_entropy_over_beam.py":
+        "cross_entropy_over_beam costs the beam-structured LoD of the "
+        "legacy generator; generation here keeps fixed [batch, beam] "
+        "lanes (v2/layer.py module docstring).",
+    "test_config_parser_for_non_file_config.py":
+        "tests the reference config-parser CLI plumbing (getopt + "
+        "protostr dump via parse_config_and_serialize), not layer "
+        "semantics — there is no config graph to build.",
+    "test_split_datasource.py":
+        "define_py_data_sources2 declares the legacy DataProvider; data "
+        "feeding here goes through paddle_tpu.reader / DataFeeder "
+        "(compat/trainer_config_helpers.py docstring).",
+    "test_detection_output_layer.py":
+        "the declared shapes are parse-only placeholders (input_conf "
+        "1x8 for num_classes=21, priorbox 4x8 vs the op's [P,8] anchor "
+        "contract) — the executable SSD path is covered by "
+        "fluid.layers.detection tests (tests/test_ops_detection.py).",
+    "test_multibox_loss_layer.py":
+        "same parse-only placeholder shapes (label declared 4x6 dense "
+        "vs the matching loss's (prior, gt) contract); the executable "
+        "SSD training loss is fluid.layers.detection.ssd_loss "
+        "(tests/test_ops_detection.py).",
+}
+
+
+def _all_accounted_for():
+    listed = set(CONFIGS) | set(SKIPS)
+    present = {f for f in os.listdir(CONFIG_DIR) if f.endswith(".py")}
+    return listed, present
+
+
+def test_every_reference_config_is_accounted_for():
+    """Each of the reference's config files is either executed or has an
+    individually-justified skip — no silent omissions."""
+    listed, present = _all_accounted_for()
+    assert present - listed == set(), (
+        f"unaccounted reference configs: {sorted(present - listed)}")
+    assert listed - present == set(), (
+        f"stale entries for missing files: {sorted(listed - present)}")
+
+
+@pytest.fixture
+def _fresh():
+    main, startup = Program(), Program()
+    saved = {k: sys.modules.get(k)
+             for k in ("paddle", "paddle.trainer_config_helpers")}
+    pkg = types.ModuleType("paddle")
+    pkg.trainer_config_helpers = tch
+    pkg.__path__ = []  # mark as package for the import machinery
+    sys.modules["paddle"] = pkg
+    sys.modules["paddle.trainer_config_helpers"] = tch
+    tch.reset()
+    try:
+        with unique_name.guard():
+            with program_guard(main, startup):
+                yield main, startup
+    finally:
+        tch.reset()
+        for k, v in saved.items():
+            if v is None:
+                sys.modules.pop(k, None)
+            else:
+                sys.modules[k] = v
+
+
+def _feed_for(name, var, kind, rng, overrides, n=N):
+    t = getattr(var, "_v2_type", None)
+    dim = t.dim if t is not None else int(var.shape[-1])
+    feeds = {}
+    if name in overrides:
+        feeds[name] = overrides[name](rng, dim)
+    elif kind == "dense":
+        feeds[name] = (rng.rand(n, dim) * 0.5 + 0.25).astype(np.float32)
+    elif kind == "int":
+        feeds[name] = rng.randint(0, max(dim, 2), (n, 1)).astype(np.int64)
+    elif kind == "seq":
+        feeds[name] = (rng.rand(n, T, dim) * 0.5 + 0.25).astype(np.float32)
+    else:  # int_seq
+        feeds[name] = rng.randint(0, max(dim, 2), (n, T, 1)).astype(np.int64)
+    if kind in ("seq", "int_seq"):
+        lens = np.maximum(1, T - np.arange(n) % 3).astype(np.int32)
+        feeds[name + "@LEN"] = lens
+    return feeds
+
+
+@pytest.mark.parametrize("fname", sorted(CONFIGS))
+def test_reference_config_builds_and_trains(fname, _fresh):
+    main, startup = _fresh
+    spec = CONFIGS[fname]
+    tch.declare_input_types(spec.get("types", {}))
+    if spec.get("fixed_batch"):
+        tch.set_fixed_batch(spec.get("n", N))
+    path = os.path.join(CONFIG_DIR, fname)
+    with open(path) as f:
+        src = f.read()
+    ns = {"__name__": f"ref_config_{fname[:-3]}", "__file__": path}
+    exec(compile(src, path, "exec"), ns)
+
+    cfg = tch.get_config()
+    outs = cfg["outputs"]
+    assert outs, f"{fname} declared no outputs"
+
+    # loss = sum of means of the float outputs; int outputs (sampled ids,
+    # kmax indices) are fetched to prove they execute but carry no grad
+    from paddle_tpu.fluid import layers as fl
+
+    float_outs = [o for o in outs if "int" not in str(o.dtype)]
+    fetches = list(outs)
+    loss = None
+    for o in float_outs:
+        m = fl.mean(o)
+        loss = m if loss is None else fl.elementwise_add(loss, m)
+
+    has_params = bool(main.global_block().all_parameters())
+    if loss is not None and has_params:
+        lr = float(cfg["settings"].get("learning_rate", 1e-4) or 1e-4)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+        fetches = [loss] + fetches
+
+    rng = np.random.RandomState(7)
+    feeds = {}
+    for name, var, kind in cfg["data_layers"]:
+        feeds.update(_feed_for(name, var, kind, rng, spec.get("feeds", {}),
+                               n=spec.get("n", N)))
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        vals = exe.run(main, feed=feeds, fetch_list=fetches)
+    for v in vals:
+        assert np.isfinite(np.asarray(v, dtype=np.float64)).all(), (
+            f"{fname}: non-finite fetch")

@@ -522,8 +522,11 @@ def test_round4_detection_and_conv_operator_execute():
                                     aspect_ratio=[1.0, 2.0])
     filt = v2.layer.data(name="filt",
                          type=v2.layer.data_type.dense_vector(2 * 3 * 3 * 3))
-    conv_out = v2.layer.conv_operator(x4, filt, filter_size=3,
-                                      num_filters=2, padding=1)
+    # conv_operator is a mixed_layer operator (its reference contract);
+    # realized it contributes a flat [N, F*OH*OW] projection
+    conv_out = v2.layer.mixed_layer(input=[
+        v2.layer.conv_operator(x4, filt, filter_size=3, num_filters=2,
+                               padding=1)])
     rng = np.random.RandomState(5)
     feeds = {"img": rng.rand(2, 3 * 16 * 16).astype(np.float32),
              "filt": rng.rand(2, 2 * 3 * 3 * 3).astype(np.float32)}
@@ -531,7 +534,7 @@ def test_round4_detection_and_conv_operator_execute():
     # legacy [P, 8] boxes||variances layout (what detection_output_layer
     # splits back apart)
     assert vals[0].ndim == 2 and vals[0].shape[-1] == 8
-    assert vals[1].shape == (2, 16 * 16, 2)
+    assert vals[1].shape == (2, 2 * 16 * 16)
     assert all(np.isfinite(v).all() for v in vals)
 
 
