@@ -1,0 +1,3 @@
+"""Benchmark scripts package (so bench.py and the scripts can share
+benchmarks/_timing.py, the true-sync timing utility for the tunnelled
+TPU)."""

@@ -48,13 +48,24 @@ CPU_SHAPES = [(2, 8, 10, 10, 16, 3, 1, 1)]
 
 
 def _time(fn, *args, iters, warmup):
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1000.0
+    """Per-call ms via benchmarks/_timing.py (round-5 finding: on the
+    tunnelled TPU, block_until_ready acks enqueue without waiting for the
+    device, which made this sweep report an implied 370 TFLOP/s). On CPU
+    (interpret-mode correctness harness) a plain synced loop is kept —
+    interpret-mode calls are seconds each and block_until_ready is a true
+    barrier on the local backend."""
+    if jax.default_backend() == "cpu":
+        for _ in range(warmup):
+            jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1000.0
+    from benchmarks._timing import kernel_time_ms
+
+    ms, _ = kernel_time_ms(lambda i: fn(*args), warmup=warmup)
+    return ms
 
 
 def main():

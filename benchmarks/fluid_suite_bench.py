@@ -20,29 +20,33 @@ Env: SUITE_WORKLOADS=mnist,vgg,stacked_lstm  SUITE_ITERS  SUITE_WARMUP
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
 
 def _bench_program(exe, scope, prog, feed, fetch, iters, warmup):
-    import jax
+    # slope-sync timing (benchmarks/_timing.py): block_until_ready does
+    # not wait for the device through the axon tunnel
+    from benchmarks._timing import step_time_s
 
     losses = []
-    for _ in range(warmup):
-        exe.run(prog, feed=feed, fetch_list=fetch, return_numpy=False)
     a_param = prog.global_block().all_parameters()[0].name
-    jax.block_until_ready(scope.find_var(a_param))
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(iters):
+
+    def _dispatch(_i):
         out = exe.run(prog, feed=feed, fetch_list=fetch, return_numpy=False)
         losses.append(out[0])
-    jax.block_until_ready(scope.find_var(a_param))
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    vals = [float(np.asarray(l).ravel()[0]) for l in losses]
-    return dt / iters, vals
+        return scope.find_var(a_param)
+
+    n1 = max(1, iters // 3)
+    per_step_s, _ev = step_time_s(_dispatch, n1, max(iters, n1 + 1),
+                                  warmup=warmup)
+    # sample a few losses for integrity evidence (each fetch is a ~75 ms
+    # tunnel round trip); always includes first and last
+    from benchmarks._timing import sample_indices
+
+    idx = sample_indices(len(losses), k=6)
+    vals = [float(np.asarray(losses[i]).ravel()[0]) for i in idx]
+    return per_step_s, vals
 
 
 def _run_workload(name, quick):

@@ -28,6 +28,11 @@ def main():
         n_heads=8, d_ff=2048, n_layers=6, dropout=0.0,
     )
     batch = 16
+
+    def _mark(msg):
+        print(f"# transformer_bench: {msg} t={time.perf_counter():.0f}",
+              file=sys.stderr, flush=True)
+
     main_prog, startup, scope = Program(), Program(), fluid.Scope()
     main_prog.random_seed = startup.random_seed = 3
     with fluid.scope_guard(scope):
@@ -37,9 +42,12 @@ def main():
             lbl = layers.data(name="lbl", shape=[cfg.max_len, 1],
                               dtype="int64")
             avg_cost, _ = transformer.build_train(cfg, src, trg, lbl)
+            _mark("built train graph")
             fluid.optimizer.Adam(learning_rate=1e-4).minimize(avg_cost)
+            _mark("built optimizer")
         exe = fluid.Executor()
         exe.run(startup)
+        _mark("startup ran")
 
         rng = np.random.RandomState(0)
         s = jnp.asarray(rng.randint(3, cfg.src_vocab,
@@ -51,21 +59,29 @@ def main():
         # flops of the compiled step, from XLA itself — via the executor's
         # own cache entry, so AOT inspection and the run() loop below share
         # ONE compiled executable
+        _mark("lowering step")
         jfn, args = exe.lowered(main_prog, feed, [avg_cost], scope)
+        _mark("lowered; compiling")
         comp = jfn.lower(*args).compile()
+        _mark("compiled")
         step_flops = comp.cost_analysis().get("flops", 0.0)
 
-        for i in range(5):
-            (l,) = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
-                           return_numpy=False)
-        jax.block_until_ready(l)
-        iters = 30
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            (l,) = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
-                           return_numpy=False)
-        jax.block_until_ready(l)
-        dt = (time.perf_counter() - t0) / iters
+        # slope-sync timing: block_until_ready does not wait for the
+        # device through the axon tunnel (benchmarks/_timing.py)
+        from benchmarks._timing import step_time_s
+
+        a_param = main_prog.global_block().all_parameters()[0].name
+        last = {}
+
+        def _dispatch(_i):
+            (last["l"],) = exe.run(main_prog, feed=feed,
+                                   fetch_list=[avg_cost],
+                                   return_numpy=False)
+            # the Adam-updated param is the end of the step's chain
+            return scope.find_var(a_param)
+
+        dt, _ev = step_time_s(_dispatch, 8, 24, warmup=4)
+        l = last["l"]
 
         tokens_per_sec = batch * cfg.max_len / dt
         tflops = step_flops / dt / 1e12

@@ -55,6 +55,11 @@ class FileLease:
         self.path = path
         self.holder = holder_id
         self.ttl = float(ttl)
+        # monotonic fencing term: bumped each time the lease changes
+        # hands (tcp_lease.LeaseServer semantics). Stamped into master
+        # snapshots so stale-leader writes lose by term comparison, not
+        # by timing.
+        self.term = 0
 
     def _locked(self):
         lock = open(self.path + ".lock", "a+")
@@ -83,8 +88,13 @@ class FileLease:
             if (st.get("holder") not in (None, self.holder)
                     and st.get("deadline", 0) > now):
                 return False
+            term = (st.get("term", 0)
+                    if st.get("holder") == self.holder
+                    else st.get("term", 0) + 1)
             self._write({"holder": self.holder, "deadline": now + self.ttl,
+                         "term": term,
                          "endpoint": list(endpoint) if endpoint else None})
+            self.term = term
             return True
         finally:
             lock.close()
@@ -98,6 +108,7 @@ class FileLease:
                 return False
             self._write({"holder": self.holder,
                          "deadline": time.time() + self.ttl,
+                         "term": st.get("term", self.term),
                          "endpoint": list(endpoint) if endpoint else None})
             return True
         finally:
@@ -106,8 +117,10 @@ class FileLease:
     def release(self):
         lock = self._locked()
         try:
-            if self._read().get("holder") == self.holder:
-                self._write({})
+            st = self._read()
+            if st.get("holder") == self.holder:
+                # keep the term: the next holder must get a HIGHER one
+                self._write({"term": st.get("term", self.term)})
         finally:
             lock.close()
 
@@ -211,7 +224,12 @@ class ElectedMaster:
     def _become_leader(self):
         self.service = MasterService(
             snapshot_path=self._snapshot_path,
-            snapshot_fence=self.lease.fenced, **self._service_kwargs)
+            snapshot_fence=self.lease.fenced,
+            # stamp snapshots with OUR leadership term: a deposed leader's
+            # late commit loses by term comparison even if it slips past a
+            # check-then-commit fence (see MasterService._snapshot_locked)
+            snapshot_term=getattr(self.lease, "term", 0) or 0,
+            **self._service_kwargs)
         self.addr = self.service.serve(host=self._host, port=0)
         if not self.lease.renew(self.addr):
             # startup (snapshot recovery / bind) outlasted the TTL and a

@@ -25,6 +25,10 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+# v2 snapshot magic (see _snapshot_locked for the layout); files without
+# it are the legacy crc|payload format (term 0)
+_SNAP_MAGIC = b"PTSNAP2\x00"
+
 
 class MasterDeposed(Exception):
     """This master no longer holds the leadership lease: mutating RPCs and
@@ -68,7 +72,7 @@ class MasterService:
 
     def __init__(self, chunks_per_task: int = 1, lease_timeout: float = 60.0,
                  failure_max: int = 3, snapshot_path: Optional[str] = None,
-                 snapshot_fence=None):
+                 snapshot_fence=None, snapshot_term: int = 0):
         self._chunks_per_task = chunks_per_task
         self._timeout = lease_timeout
         self._failure_max = failure_max
@@ -77,6 +81,14 @@ class MasterService:
         # else raise MasterDeposed — prevents a stale leader overwriting
         # the new leader's snapshot (election.FileLease.fenced)
         self._snapshot_fence = snapshot_fence
+        # monotonic fencing term stamped into every snapshot this service
+        # writes (the lease term under which it was elected). The commit
+        # refuses to replace a snapshot carrying a HIGHER term, so a
+        # deposed leader that slipped past a check-then-commit fence
+        # (tcp_lease.TcpLease cannot hold the server mutex across the
+        # client-side rename the way FileLease holds flock) still cannot
+        # roll the new leader's state back. 0 = unelected/standalone use.
+        self._snapshot_term = int(snapshot_term)
         self._mu = threading.Lock()
         self._todo: List[Task] = []
         self._pending: Dict[int, _Pending] = {}
@@ -254,7 +266,16 @@ class MasterService:
             "pass": self._cur_pass,
         }
         payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
-        blob = struct.pack("<I", zlib.crc32(payload)) + payload
+        # v2 format: magic | term u64 | crc32(term) | crc32(payload) |
+        # payload. The term lives in a fixed-size, separately-checksummed
+        # header so the monotonic-write guard reads the 24-byte header — not the
+        # whole queue state — per commit, and a torn header can't fake a
+        # high term and wedge commits. Legacy (magic-less crc|payload)
+        # snapshots still recover, with term 0.
+        term8 = struct.pack("<Q", self._snapshot_term)
+        blob = (_SNAP_MAGIC + term8
+                + struct.pack("<II", zlib.crc32(term8), zlib.crc32(payload))
+                + payload)
         # per-process unique tmp: on shared storage a deposed leader writing
         # a FIXED tmp path could corrupt the new leader's in-flight commit
         # (the fence only guards the rename)
@@ -263,6 +284,24 @@ class MasterService:
             f.write(blob)
 
         def _commit():
+            # Monotonic-term guard: never replace a snapshot written under
+            # a NEWER leadership term. FileLease.fenced holds flock across
+            # this rename, closing the race completely; TcpLease.fenced is
+            # check-then-commit (the lease server cannot extend its mutex
+            # over a client-side rename), so a leader that stalls between
+            # check and commit could otherwise clobber its successor's
+            # state. With the term check, the stale rename is refused the
+            # moment the successor (higher term) has committed once — the
+            # residual window shrinks from the stall length to the
+            # read-compare-rename microseconds, and a write that does slip
+            # through is corrected by the successor's next snapshot (task
+            # leases it re-serves simply time out and requeue: the
+            # at-least-once semantics the queue already guarantees).
+            cur = self._read_snapshot_term()
+            if cur is not None and cur > self._snapshot_term:
+                raise MasterDeposed(
+                    f"snapshot already at term {cur} > ours "
+                    f"{self._snapshot_term}: refusing stale write")
             os.replace(tmp, self._snapshot_path)
 
         try:
@@ -277,13 +316,55 @@ class MasterService:
                 pass
             raise
 
+    def _read_snapshot_term(self) -> Optional[int]:
+        """Term of the current on-disk snapshot (24-byte header read, not
+        the whole state), or None if there is no readable/intact header.
+        Only an INTEGRITY-CHECKED term counts: a torn header must not be
+        able to fake a high term and wedge commits forever. Legacy
+        (pre-term) snapshots read as term 0."""
+        try:
+            with open(self._snapshot_path, "rb") as f:
+                head = f.read(len(_SNAP_MAGIC) + 16)
+        except OSError:
+            return None
+        if not head.startswith(_SNAP_MAGIC):
+            return 0  # legacy crc|payload format carried no term
+        m = len(_SNAP_MAGIC)
+        if len(head) < m + 16:
+            return None
+        term8 = head[m:m + 8]
+        (crc_t, _crc_p) = struct.unpack("<II", head[m + 8:m + 16])
+        if zlib.crc32(term8) != crc_t:
+            return None
+        return struct.unpack("<Q", term8)[0]
+
     def _recover(self):
         with open(self._snapshot_path, "rb") as f:
             blob = f.read()
-        (crc,) = struct.unpack("<I", blob[:4])
-        payload = blob[4:]
-        if zlib.crc32(payload) != crc:
-            raise IOError(f"{self._snapshot_path}: snapshot corrupt")
+        if blob.startswith(_SNAP_MAGIC):
+            m = len(_SNAP_MAGIC)
+            term8 = blob[m:m + 8]
+            (crc_t, crc_p) = struct.unpack("<II", blob[m + 8:m + 16])
+            payload = blob[m + 16:]
+            if zlib.crc32(term8) != crc_t or zlib.crc32(payload) != crc_p:
+                raise IOError(f"{self._snapshot_path}: snapshot corrupt")
+            recovered_term = struct.unpack("<Q", term8)[0]
+        else:
+            # legacy format: crc32(payload) | payload, no term
+            (crc,) = struct.unpack("<I", blob[:4])
+            payload = blob[4:]
+            if zlib.crc32(payload) != crc:
+                raise IOError(f"{self._snapshot_path}: snapshot corrupt")
+            recovered_term = 0
+        # Adopt the recovered term when it is higher than ours: a
+        # standalone service (term 0) or a leader elected from a
+        # RESTARTED lease server (terms reset to 1) must be able to keep
+        # committing over a higher-term snapshot rather than raising
+        # MasterDeposed on every mutation forever. The cost is that
+        # fencing between two post-restart leaders degrades to the
+        # check-fence until lease terms catch up — persistence on the
+        # LeaseServer side (state_path) avoids the reset entirely.
+        self._snapshot_term = max(self._snapshot_term, recovered_term)
         state = pickle.loads(payload)
         self._todo = state["todo"] + state["pending"]
         self._done = state["done"]

@@ -20,11 +20,14 @@ runs unchanged over either:
 Fencing: every successful acquire bumps a server-side monotonic term;
 `fenced(commit)` verifies holder+term+TTL server-side immediately before
 committing, so a deposed leader's late snapshot write raises
-MasterDeposed instead of clobbering the new leader's state (same
-semantics as FileLease.fenced, with the check serialized by the lease
-server instead of flock)."""
+MasterDeposed. The check cannot be held across the client-side commit
+the way FileLease holds flock, so the term doubles as a fencing TOKEN:
+snapshots are term-stamped and MasterService refuses to replace a
+higher-term snapshot (see TcpLease.fenced for the full story)."""
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from typing import Callable, Optional, Tuple
@@ -33,12 +36,44 @@ from .rpc import RpcClient, RpcServer
 
 
 class LeaseServer:
-    """In-memory named TTL leases with monotonic fencing terms."""
+    """In-memory named TTL leases with monotonic fencing terms.
 
-    def __init__(self):
+    `state_path` (optional) persists the per-name TERM counters (not the
+    ephemeral holders/deadlines) across server restarts. Without it a
+    restart resets terms to 1 while term-stamped snapshots on shared
+    storage keep their higher terms — recoverable (MasterService adopts
+    the higher on-disk term, see master._recover) but it degrades the
+    term fencing between post-restart leaders until the counters catch
+    up. With it, terms never regress (the role etcd's persisted revision
+    counter played)."""
+
+    def __init__(self, state_path: Optional[str] = None):
         self._mu = threading.Lock()
         self._leases = {}  # name -> {holder, deadline, term, endpoint}
         self._server: Optional[RpcServer] = None
+        self._state_path = state_path
+        if state_path:
+            try:
+                with open(state_path) as f:
+                    for name, term in (json.load(f) or {}).items():
+                        self._leases[name] = {"holder": None, "deadline": 0,
+                                              "term": int(term),
+                                              "endpoint": None}
+            except (OSError, ValueError):
+                pass  # no/corrupt state: terms restart (degraded fencing)
+
+    def _persist_terms_locked(self):
+        if not self._state_path:
+            return
+        tmp = self._state_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({n: st["term"] for n, st in self._leases.items()},
+                          f)
+            os.replace(tmp, self._state_path)
+        except OSError:
+            pass  # persistence is best-effort; the adopt-on-recover path
+            # in master._recover keeps the cluster available regardless
 
     # -- RPC methods ------------------------------------------------------
     def acquire(self, name, holder, ttl, endpoint=None):
@@ -52,6 +87,8 @@ class LeaseServer:
                     else (st["term"] + 1 if st else 1))
             self._leases[name] = {"holder": holder, "deadline": now + ttl,
                                   "term": term, "endpoint": endpoint}
+            if not st or term != st["term"]:
+                self._persist_terms_locked()
             return {"ok": True, "term": term}
 
     def renew(self, name, holder, ttl, endpoint=None):
@@ -121,6 +158,14 @@ class TcpLease:
         self._timeout = timeout
         self._term: Optional[int] = None
 
+    @property
+    def term(self) -> int:
+        """Server-issued fencing term of our current acquisition (0 if
+        never acquired). ElectedMaster stamps it into snapshots — the
+        backstop for the check-then-commit window documented in
+        fenced()."""
+        return self._term or 0
+
     def _call(self, method, *args):
         client = RpcClient(self.addr, timeout=self._timeout)
         try:
@@ -154,6 +199,19 @@ class TcpLease:
             pass  # TTL will expire it
 
     def fenced(self, commit: Callable[[], None]):
+        """Verify holder+term+TTL server-side, then commit.
+
+        Unlike FileLease.fenced — which holds flock ACROSS commit(), so a
+        competing acquire blocks until the commit lands — this is
+        check-then-commit: the lease server's mutex cannot extend over a
+        client-side commit. A leader that stalls between the check reply
+        and commit() can therefore still write after being deposed. That
+        residual window is closed by the snapshot TERM: ElectedMaster
+        stamps commits with `self.term` and
+        MasterService._snapshot_locked refuses to replace a higher-term
+        snapshot, so the deposed write loses by term comparison instead
+        of by timing (the fencing-token pattern etcd deployments use for
+        exactly this reason)."""
         from .master import MasterDeposed
 
         try:

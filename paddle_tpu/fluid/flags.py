@@ -19,10 +19,22 @@ FLAGS: Dict[str, Any] = {
     "benchmark": False,
     # donate state buffers to jit for in-place HBM updates
     "donate_state": True,
-    # hand-written Pallas kernels for hot ops (flash attention, fused
-    # layer norm): 'auto' = on when running on TPU; True forces them on
-    # (interpret-mode off-TPU, slow — tests only); False = plain XLA
+    # hand-written Pallas kernels for hot ops: 'auto' = measured-winner
+    # routing on TPU (flash attention at seq >= flash_min_seq, fused
+    # layer_norm; NOT the fused conv, which loses to XLA on every
+    # measured shape — see conv2d_bn_relu); True forces every kernel on
+    # regardless of the measured tables (interpret-mode off-TPU, slow —
+    # tests/A-B only; attention still honors flash_min_seq, so kernel
+    # tests at short seq also set flash_min_seq 0); False = plain XLA
     "use_pallas_kernels": "auto",
+    # minimum sequence length at which single-device attention routes to
+    # the Pallas flash kernel instead of XLA's dense path. Measured on
+    # TPU v5e (benchmarks/flash_attention_bench.py, slope-sync timing,
+    # bf16 fwd+bwd): flash is 0.58x XLA at S=2048 but 1.85x at S=4096 —
+    # XLA's dense attention wins while the S^2 score matrix still fits
+    # comfortably in HBM bandwidth, flash wins once it doesn't. 0 = always
+    # flash (and long-seq tests force it to exercise the kernel).
+    "flash_min_seq": 3072,
     # mixed precision: bf16 MXU operands with f32 accumulation for
     # conv/matmul (master weights and the rest of the graph stay f32) —
     # the standard TPU training configuration
@@ -83,4 +95,5 @@ def trace_flags() -> tuple:
     executor jit-cache key must include them, or toggling a flag after the
     first run of a program would be silently ignored."""
     return (FLAGS["matmul_precision"], FLAGS["use_pallas_kernels"],
-            FLAGS["amp"], FLAGS["count_while_step_evals"])
+            FLAGS["amp"], FLAGS["count_while_step_evals"],
+            FLAGS["flash_min_seq"])

@@ -37,9 +37,11 @@ def ring_attention(ctx, ins, attrs):
 
     mesh = current_mesh()
     if mesh is None or seq_axis not in mesh.axis_names:
-        from ..flags import pallas_enabled, pallas_interpret
+        from ..flags import get_flag, pallas_enabled, pallas_interpret
 
-        if pallas_enabled():
+        # route by measured crossover: XLA's dense path beats the flash
+        # kernel below flash_min_seq (see flags.py for the v5e table)
+        if pallas_enabled() and q.shape[1] >= int(get_flag("flash_min_seq")):
             from .pallas_kernels import flash_attention
 
             if mesh is None:

@@ -114,12 +114,19 @@ def _fused_conv_bwd(stride, padding, relu, block_m, block_f, interpret,
     p, p_vjp = jax.vjp(patch_fn, x)
     p32 = p.astype(jnp.float32)
     wt32 = w.reshape(f, -1).T.astype(jnp.float32)
-    g = p32 @ wt32  # pre-affine GEMM output
-    dscale = jnp.sum(dz * g, axis=0).astype(scale.dtype)
+    # One shared GEMM A = P^T dZ [K, F] yields both weight and scale
+    # cotangents without recomputing the forward GEMM g = P Wt:
+    #   dWt[k,f]    = sum_m P[m,k] dZ[m,f] scale[f] = A[k,f] * scale[f]
+    #   dscale[f]   = sum_m dZ[m,f] g[m,f]          = sum_k Wt[k,f] A[k,f]
+    # (column scaling commutes through the GEMM; the dscale identity is
+    # just reassociating the double sum). Exact for scale == 0 channels
+    # too — unlike recovering g from y = g*scale + shift.
+    a = p32.T @ dz  # [K, F]
+    dscale = jnp.sum(wt32 * a, axis=0).astype(scale.dtype)
     dshift = jnp.sum(dz, axis=0).astype(shift.dtype)
     dg = dz * scale.astype(jnp.float32)[None, :]
-    dwt = p32.T @ dg  # [K, F]
-    dw = dwt.T.reshape(w.shape).astype(w.dtype)
+    dw = (a * scale.astype(jnp.float32)[None, :]).T.reshape(
+        w.shape).astype(w.dtype)
     dp = (dg @ wt32.T).astype(p.dtype)
     (dx,) = p_vjp(dp)
     return dx.astype(x.dtype), dw, dscale, dshift

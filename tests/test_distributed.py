@@ -920,6 +920,99 @@ def test_tcp_lease_mutual_exclusion_expiry_and_fencing():
         srv.shutdown()
 
 
+def test_snapshot_term_guard_refuses_stale_leader_write(tmp_path):
+    """The fencing-TOKEN backstop for TcpLease's check-then-commit window:
+    a deposed leader whose fence check passed BEFORE it stalled cannot
+    replace the new leader's higher-term snapshot — the commit itself
+    compares terms (MasterService._snapshot_locked) and raises, and the
+    snapshot on disk keeps the new leader's state."""
+    from paddle_tpu.distributed.master import MasterDeposed, MasterService
+
+    snap = str(tmp_path / "m.snap")
+    # old leader elected at term 3: its fence never fires (simulating a
+    # check that passed before the stall — the exact race window)
+    old = MasterService(chunks_per_task=1, snapshot_path=snap,
+                        snapshot_term=3)
+    # new leader at term 5 recovers and commits its own state
+    new = MasterService(chunks_per_task=1, snapshot_path=snap,
+                        snapshot_term=5)
+    new.set_dataset(["s1", "s2"])  # snapshots at term 5
+    with pytest.raises(MasterDeposed):
+        old.set_dataset(["stale1"])  # stale rename refused by term guard
+    # disk still holds the term-5 state: a recovery sees the new leader's
+    # dataset, not the stale one
+    rec = MasterService(chunks_per_task=1, snapshot_path=snap,
+                        snapshot_term=6)
+    assert rec._dataset_paths == ["s1", "s2"]
+    # and equal/higher terms still commit (the guard is strictly >)
+    rec.set_dataset(["s1", "s2", "s3"])
+
+
+def test_legacy_snapshot_format_recovers_and_recommits(tmp_path):
+    """Pre-term (crc|payload) snapshots written by earlier releases must
+    recover (term 0) and remain committable — no manual file surgery on
+    upgrade."""
+    import pickle
+    import struct
+    import zlib
+
+    from paddle_tpu.distributed.master import MasterService
+
+    state = {"todo": [], "pending": [], "done": [], "dropped": [],
+             "next_id": 0, "epoch": 0, "dataset_paths": ["a", "b"],
+             "pass": 0}
+    payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+    snap = str(tmp_path / "legacy.snap")
+    with open(snap, "wb") as f:
+        f.write(struct.pack("<I", zlib.crc32(payload)) + payload)
+    svc = MasterService(chunks_per_task=1, snapshot_path=snap)
+    assert svc._dataset_paths == ["a", "b"]
+    svc.set_dataset(["a", "b", "c"])  # re-commits in the new format
+    svc2 = MasterService(chunks_per_task=1, snapshot_path=snap)
+    assert svc2._dataset_paths == ["a", "b", "c"]
+
+
+def test_standalone_service_adopts_higher_snapshot_term(tmp_path):
+    """A standalone (term 0) or post-lease-server-restart (low-term)
+    service over a higher-term snapshot adopts the on-disk term instead
+    of raising MasterDeposed on every mutation forever."""
+    from paddle_tpu.distributed.master import MasterService
+
+    snap = str(tmp_path / "m.snap")
+    leader = MasterService(chunks_per_task=1, snapshot_path=snap,
+                           snapshot_term=7)
+    leader.set_dataset(["x"])
+    standalone = MasterService(chunks_per_task=1, snapshot_path=snap)
+    assert standalone._snapshot_term == 7
+    standalone.set_dataset(["x", "y"])  # commits (adopted term)
+
+
+def test_lease_server_persists_terms_across_restart(tmp_path):
+    """LeaseServer(state_path=...) carries fencing terms across restarts,
+    so term-stamped snapshots never outrank a freshly-elected leader."""
+    from paddle_tpu.distributed.tcp_lease import LeaseServer, TcpLease
+
+    state = str(tmp_path / "leases.json")
+    srv = LeaseServer(state_path=state)
+    addr = srv.serve()
+    try:
+        a = TcpLease(addr, "m", "a", ttl=60)
+        assert a.try_acquire()
+        term_before = a.term
+        assert term_before >= 1
+    finally:
+        srv.shutdown()
+
+    srv2 = LeaseServer(state_path=state)
+    addr2 = srv2.serve()
+    try:
+        b = TcpLease(addr2, "m", "b", ttl=60)
+        assert b.try_acquire()
+        assert b.term == term_before + 1  # monotonic across restart
+    finally:
+        srv2.shutdown()
+
+
 def test_master_crash_takeover_over_tcp_lease(tmp_path):
     """End-to-end HA over the TCP lease backend: leader crash, standby
     takeover from the shared snapshot, client re-resolve through the

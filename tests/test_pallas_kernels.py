@@ -124,7 +124,13 @@ def test_layer_norm_op_uses_pallas_when_forced():
     from paddle_tpu.fluid.flags import set_flags
     from paddle_tpu.fluid.framework import Program, program_guard
 
-    set_flags({"use_pallas_kernels": True})
+    # flash_min_seq 0: the routing threshold (flags.py) would otherwise
+    # send these tiny sequences to the XLA path and stop exercising the
+    # kernel this test exists for
+    from paddle_tpu.fluid.flags import get_flag
+
+    prev_min_seq = get_flag("flash_min_seq")
+    set_flags({"use_pallas_kernels": True, "flash_min_seq": 0})
     try:
         main, startup, scope = Program(), Program(), fluid.Scope()
         with fluid.scope_guard(scope):
@@ -149,7 +155,8 @@ def test_layer_norm_op_uses_pallas_when_forced():
                          fetch_list=[cost])[0].item()
             assert np.isfinite(l0)
     finally:
-        set_flags({"use_pallas_kernels": "auto"})
+        set_flags({"use_pallas_kernels": "auto",
+                   "flash_min_seq": prev_min_seq})
 
 
 def test_flash_attention_non_multiple_of_8_lengths():
@@ -228,12 +235,17 @@ def test_flash_attention_reachable_under_parallel_executor():
         mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
         pe = fluid.ParallelExecutor(main_program=main, mesh=mesh)
         (xla_att,) = pe.run(feed=feed, fetch_list=[att])
-        set_flags({"use_pallas_kernels": True})  # interpret auto on CPU
+        from paddle_tpu.fluid.flags import get_flag
+
+        prev_min_seq = get_flag("flash_min_seq")
+        set_flags({"use_pallas_kernels": True,
+                   "flash_min_seq": 0})  # interpret auto on CPU
         try:
             pe2 = fluid.ParallelExecutor(main_program=main, mesh=mesh)
             (pl_att,) = pe2.run(feed=feed, fetch_list=[att])
         finally:
-            set_flags({"use_pallas_kernels": "auto"})
+            set_flags({"use_pallas_kernels": "auto",
+                       "flash_min_seq": prev_min_seq})
     np.testing.assert_allclose(np.asarray(pl_att), np.asarray(xla_att),
                                atol=3e-5)
 
