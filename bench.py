@@ -379,8 +379,8 @@ _EXTRA_BENCHES = [
     ("flash_bf16", "flash_attention_bench.py",
      {"FLASH_DTYPES": "bfloat16",
       "FLASH_BLOCKS": "128x128,256x256,512x256"}, 240, 480),
-    ("transformer", "transformer_bench.py", {}, 240, 420),
-    ("conv_pallas_vs_xla", "conv_fused_bench.py", {}, 200, 360),
+    ("transformer", "transformer_bench.py", {}, 240, 540),
+    ("conv_pallas_vs_xla", "conv_fused_bench.py", {}, 200, 480),
     ("input_pipeline", "input_pipeline_bench.py",
      {"PIPE_ITERS": "12"}, 200, 360),
     ("legacy_k40m", "legacy_conv_bench.py", {}, 200, 360),
@@ -662,8 +662,8 @@ def child_main():
         # method: (t(n2) - t(n1)) / (n2 - n1) with one fetch-sync per
         # run, cancelling the round trip. The first attach's bs8 number
         # (4589 imgs/s "52% MFU") was dispatch time and is superseded.
-        from benchmarks._timing import device_sync, step_time_s, \
-            sync_roundtrip_ms
+        from benchmarks._timing import device_sync, sample_indices, \
+            step_time_from_iters, sync_roundtrip_ms
 
         t0 = time.perf_counter()
         for i in range(WARMUP):
@@ -708,9 +708,8 @@ def child_main():
             # momentum) — syncing on it is the true end-of-step barrier
             return scope.find_var(a_param)
 
-        n1 = max(1, ITERS // 3)
-        n2 = max(ITERS, n1 + 1)
-        per_step_s, timing_ev = step_time_s(_dispatch, n1, n2, warmup=0)
+        per_step_s, timing_ev = step_time_from_iters(_dispatch, ITERS,
+                                                     warmup=0)
         timing_ev["sync_roundtrip_ms"] = round(sync_roundtrip_ms(), 1)
 
         # integrity evidence that real steps executed: fetched losses are
@@ -720,8 +719,6 @@ def child_main():
         if not losses:
             print(json.dumps({"error": "no steps executed"}))
             return 2
-        from benchmarks._timing import sample_indices
-
         idx = sample_indices(len(losses), k=8)
         loss_vals = [float(np.asarray(losses[i]).ravel()[0]) for i in idx]
         distinct = len({round(v, 6) for v in loss_vals})
@@ -768,7 +765,8 @@ def child_main():
             "data": data_mode,
             "step_ms": round(per_step_s * 1000, 3),
             "batch": BATCH,
-            "iters": ITERS,
+            "iters": ITERS,          # the requested knob (slope n2)
+            "steps_run": len(losses),  # actual timed steps = n1 + n2
             "timing": timing_ev,
             "flops_per_step_xla": flops_cost_analysis,
             "flops_per_step_analytic": analytic_step_flops,

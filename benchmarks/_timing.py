@@ -117,6 +117,16 @@ def step_time_s(dispatch, n1, n2, warmup=1):
     return per_step, evidence
 
 
+def step_time_from_iters(dispatch, iters, warmup):
+    """The shared policy every bench uses to map a user-facing ITERS knob
+    onto slope runs: n1 = iters//3 (>=1), n2 = iters (> n1). Keeping it
+    here means one edit changes every harness identically. NOTE the total
+    timed step count is n1 + n2 (~1.33x iters) — callers reporting
+    executed-step counts should report that, not iters."""
+    n1 = max(1, iters // 3)
+    return step_time_s(dispatch, n1, max(iters, n1 + 1), warmup=warmup)
+
+
 def sample_indices(n, k=8):
     """<= k+1 indices over range(n), always including 0 and n-1 — for
     integrity-sampling per-step losses when each device->host fetch costs
@@ -142,7 +152,8 @@ def kernel_time_ms(dispatch, target_s=0.3, max_iters=20000, warmup=2):
     """
     for i in range(warmup):
         out = dispatch(i)
-    device_sync(out)
+    if warmup:
+        device_sync(out)
     rt = sync_roundtrip_ms() / 1000.0
     n_cal = 16
     t_cal, _ = timed_run(dispatch, n_cal)

@@ -27,7 +27,7 @@ import numpy as np
 def _bench_program(exe, scope, prog, feed, fetch, iters, warmup):
     # slope-sync timing (benchmarks/_timing.py): block_until_ready does
     # not wait for the device through the axon tunnel
-    from benchmarks._timing import step_time_s
+    from benchmarks._timing import step_time_from_iters
 
     losses = []
     a_param = prog.global_block().all_parameters()[0].name
@@ -37,9 +37,7 @@ def _bench_program(exe, scope, prog, feed, fetch, iters, warmup):
         losses.append(out[0])
         return scope.find_var(a_param)
 
-    n1 = max(1, iters // 3)
-    per_step_s, _ev = step_time_s(_dispatch, n1, max(iters, n1 + 1),
-                                  warmup=warmup)
+    per_step_s, _ev = step_time_from_iters(_dispatch, iters, warmup)
     # sample a few losses for integrity evidence (each fetch is a ~75 ms
     # tunnel round trip); always includes first and last
     from benchmarks._timing import sample_indices

@@ -71,7 +71,7 @@ def _build_model(img, label):
 
 
 def _measure(exe, main, scope, cost, feed):
-    from benchmarks._timing import step_time_s
+    from benchmarks._timing import step_time_from_iters
 
     a_param = main.global_block().all_parameters()[0].name
 
@@ -79,9 +79,7 @@ def _measure(exe, main, scope, cost, feed):
         exe.run(main, feed=feed, fetch_list=[cost], return_numpy=False)
         return scope.find_var(a_param)
 
-    n1 = max(1, ITERS // 3)
-    per_step_s, _ev = step_time_s(_dispatch, n1, max(ITERS, n1 + 1),
-                                  warmup=WARMUP)
+    per_step_s, _ev = step_time_from_iters(_dispatch, ITERS, WARMUP)
     return per_step_s * 1000
 
 

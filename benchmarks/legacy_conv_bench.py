@@ -85,7 +85,7 @@ def _measure(main, startup, scope, feed, fetch, iters, warmup):
     import jax
 
     import paddle_tpu.fluid as fluid
-    from benchmarks._timing import step_time_s
+    from benchmarks._timing import step_time_from_iters
 
     exe = fluid.Executor()
     with fluid.scope_guard(scope):
@@ -103,9 +103,7 @@ def _measure(main, startup, scope, feed, fetch, iters, warmup):
             exe.run(main, feed=feed, fetch_list=[fetch], return_numpy=False)
             return scope.find_var(param)
 
-        n1 = max(1, iters // 3)
-        per_step_s, _ev = step_time_s(_dispatch, n1, max(iters, n1 + 1),
-                                      warmup=warmup)
+        per_step_s, _ev = step_time_from_iters(_dispatch, iters, warmup)
         return per_step_s * 1000.0
 
 

@@ -218,11 +218,16 @@ class _EndOfData:
 
 class DoubleBufferReader(_Decorated):
     """THE async input pipeline (reference
-    create_double_buffer_reader_op.cc): a daemon thread pulls batches from
-    the underlying reader and eagerly converts them to device arrays
-    (jnp.asarray starts the host->HBM copy), keeping up to `capacity`
-    batches in flight while the device computes. read_next() then costs a
-    queue pop instead of decode+transfer."""
+    create_double_buffer_reader_op.cc), as a TWO-stage daemon pipeline:
+    a decode thread pulls batches from the underlying reader and conforms
+    them (reshape/cast) on the host, and a transfer thread converts them
+    to device arrays (jnp.asarray starts the host->HBM copy). Decode of
+    batch N+1 therefore overlaps the TRANSFER of batch N as well as the
+    device's compute on batch N-1 — on a transfer-bound link (the axon
+    tunnel moves ~15-45 MB/s) a single worker would serialize
+    decode+transfer and cap throughput below the link's own floor.
+    read_next() costs a queue pop. Up to `capacity` batches sit in each
+    stage's queue."""
 
     def __init__(self, inner: HostReader, capacity: int = 2,
                  device_put: bool = True,
@@ -232,8 +237,10 @@ class DoubleBufferReader(_Decorated):
         self._device_put = device_put
         self._slots = slots  # declared {shape,dtype,...} per slot, if known
         self._q: "queue.Queue" = queue.Queue(maxsize=self._capacity)
+        self._hq: "queue.Queue" = queue.Queue(maxsize=self._capacity)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._xfer_thread: Optional[threading.Thread] = None
         self._start()
 
     def _conform(self, i: int, slot):
@@ -251,37 +258,64 @@ class DoubleBufferReader(_Decorated):
             slot = slot.astype(dtype)
         return slot
 
+    def _conform_sample(self, sample):
+        return tuple(
+            slot if isinstance(slot, tuple) else self._conform(i, slot)
+            for i, slot in enumerate(sample))
+
     def _to_device(self, sample):
         import jax.numpy as jnp
 
-        out = []
-        for i, slot in enumerate(sample):
-            if isinstance(slot, tuple):  # (padded, lengths) ragged pair
-                out.append(tuple(jnp.asarray(s) for s in slot)
-                           if self._device_put else slot)
-            else:
-                slot = self._conform(i, slot)
-                out.append(jnp.asarray(slot) if self._device_put else slot)
-        return tuple(out)
+        if not self._device_put:
+            return tuple(sample)
+        return tuple(
+            tuple(jnp.asarray(s) for s in slot)  # (padded, lengths) pair
+            if isinstance(slot, tuple) else jnp.asarray(slot)
+            for slot in sample)
 
-    def _worker(self):
+    def _decode_worker(self):
+        """Stage 1: read + conform on the host; never touches the device."""
         try:
             while not self._stop.is_set():
                 try:
                     sample = self.inner.read_next()
                 except StopIteration:
-                    self._put(_EndOfData)
+                    self._put(self._hq, _EndOfData)
                     return
-                self._put(self._to_device(sample))
+                self._put(self._hq, self._conform_sample(sample))
         except Exception as e:  # surface decode errors at read_next()
-            self._put(e)
+            self._put(self._hq, e)
 
-    def _put(self, item):
+    def _xfer_worker(self):
+        """Stage 2: host->device transfer, overlapping stage 1's decode of
+        the NEXT batch (and the device's compute on the previous one)."""
+        while not self._stop.is_set():
+            try:
+                item = self._hq.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is _EndOfData or isinstance(item, Exception):
+                self._put(self._q, item)
+                return
+            try:
+                self._put(self._q, self._to_device(item))
+            except Exception as e:  # device transfer failure
+                self._put(self._q, e)
+                # stop the DECODE stage too: with this stage dead nobody
+                # drains _hq, and the decoder would fill it then spin in
+                # _put until reset()/close() — an orphaned busy-polling
+                # daemon if the caller just abandons the reader. The
+                # error item is already enqueued; read_next() still
+                # receives it, and reset() clears the flag via _start().
+                self._stop.set()
+                return
+
+    def _put(self, q, item):
         """Queue put that gives up when reset/close asks the thread to stop
         (a plain blocking put would deadlock a full queue on teardown)."""
         while not self._stop.is_set():
             try:
-                self._q.put(item, timeout=0.1)
+                q.put(item, timeout=0.1)
                 return
             except queue.Full:
                 continue
@@ -290,24 +324,31 @@ class DoubleBufferReader(_Decorated):
         self._stop.clear()
         self._eof = False
         self._error: Optional[Exception] = None
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread = threading.Thread(target=self._decode_worker,
+                                        daemon=True)
         self._thread.start()
+        self._xfer_thread = threading.Thread(target=self._xfer_worker,
+                                             daemon=True)
+        self._xfer_thread.start()
 
     def _shutdown(self):
         self._stop.set()
-        if self._thread is not None:
-            while self._thread.is_alive():
-                try:  # drain so a blocked put can observe the stop flag
-                    self._q.get_nowait()
+        for attr, q in (("_xfer_thread", self._q), ("_thread", self._hq)):
+            t = getattr(self, attr)
+            if t is not None:
+                while t.is_alive():
+                    try:  # drain so a blocked put can observe the stop flag
+                        q.get_nowait()
+                    except queue.Empty:
+                        pass
+                    t.join(timeout=0.05)
+                setattr(self, attr, None)
+        for q in (self._q, self._hq):
+            while True:
+                try:
+                    q.get_nowait()
                 except queue.Empty:
-                    pass
-                self._thread.join(timeout=0.05)
-            self._thread = None
-        while True:
-            try:
-                self._q.get_nowait()
-            except queue.Empty:
-                break
+                    break
 
     def read_next(self):
         if self._eof:

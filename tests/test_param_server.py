@@ -728,9 +728,12 @@ def test_four_trainer_processes_16mb_sync_rounds():
         expect = w_before - 0.01 * 10.0 * steps
         np.testing.assert_allclose(ps.get_param("big.w"), expect, rtol=1e-5)
         # binary framing moves 16.8 MB frames at wire speed — base64 JSON
-        # lists topped out far below this (sanity floor, not a benchmark)
+        # lists topped out at ~1-3 MB/s, which is what this floor guards
+        # against (sanity floor, not a benchmark: 4 concurrent trainers on
+        # a loaded shared host have measured as low as 18 MB/s, so the
+        # floor sits well under that while still 3x the failure mode)
         print("per-trainer MB/s:", rates)
-        assert min(rates) > 20.0, rates
+        assert min(rates) > 6.0, rates
     finally:
         ps.shutdown()
 

@@ -228,6 +228,45 @@ def test_double_buffer_reset_and_error_propagation(tmp_path):
         assert n == 2
 
 
+def test_double_buffer_transfer_error_stops_both_stages():
+    """A failure in the TRANSFER stage must surface at read_next() AND
+    stop the decode stage — otherwise the decoder keeps draining the
+    inner reader and busy-polls a full queue forever after the caller
+    abandons the reader (two-stage pipeline regression guard)."""
+    from paddle_tpu.fluid.readers import DoubleBufferReader, HostReader
+
+    class Counting(HostReader):
+        def __init__(self):
+            self.n = 0
+
+        def read_next(self):
+            self.n += 1
+            # object arrays make jnp.asarray raise in the transfer stage
+            return (np.array([object()]),)
+
+        def reset(self):
+            self.n = 0
+
+    src = Counting()
+    db = DoubleBufferReader(src, capacity=2, device_put=True)
+    try:
+        with pytest.raises(Exception):
+            db.read_next()
+        # the decode stage observed the stop flag: it reads at most the
+        # in-flight capacity worth of extra samples, then halts
+        for _ in range(50):
+            if not db._thread or not db._thread.is_alive():
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("decode thread still alive after transfer error")
+        reads_after_error = src.n
+        time.sleep(0.2)
+        assert src.n == reads_after_error  # no further inner reads
+    finally:
+        db.close()
+
+
 def test_reader_program_desc_roundtrip(tmp_path):
     """Reader slots survive Program serialization (the reference's
     VarType.ReaderDesc round-trip)."""
