@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import core
 from .enforce import throw_on
-from .executor import Scope, _block_io, _lower, _next_key, global_scope
+from .executor import Scope, _block_io, _lower, _next_seed, global_scope
 from .framework import Program, Variable, default_main_program
 
 
@@ -180,7 +180,10 @@ class ParallelExecutor:
         )
         from .flags import trace_flags
 
-        cache_key = (id(program), program._version, feed_sig, fetch_names,
+        # random_seed participates for the same reason as Executor._entry:
+        # _lower bakes seed+salt into the trace
+        cache_key = (id(program), program._version,
+                     int(program.random_seed or 0), feed_sig, fetch_names,
                      trace_flags())
         entry = self._cache.get(cache_key)
         if entry is None:
@@ -227,7 +230,7 @@ class ParallelExecutor:
 
         state_ro = {n: _place(n, self._scope.find_var(n)) for n in ro_names}
         state_rw = {n: _place(n, self._scope.find_var(n)) for n in rw_names}
-        key = _next_key(program)
+        key = _next_seed(program)
         from ..parallel import mesh_context
 
         # emitters that need explicit SPMD (ring attention) see the mesh

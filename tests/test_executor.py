@@ -300,3 +300,46 @@ def test_tensor_save_load_layer_api(tmp_path):
         fluid.Executor().run(main2)
     np.testing.assert_allclose(np.asarray(scope2.find_var("tsl.w2")),
                                np.asarray(scope.find_var("tsl.w")))
+
+
+def test_random_seed_set_after_first_run_takes_effect():
+    """random_seed is baked into the lowered trace, so the jit cache must
+    key on it: setting prog.random_seed AFTER a cached run is a plain
+    attribute write (no version bump) and previously kept serving the
+    unseeded entry. Seeded runs must be reproducible tick-for-tick."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.fluid.framework import Program, program_guard
+
+    def build():
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = layers.data(name="x", shape=[8], dtype="float32")
+            h = layers.dropout(x, dropout_prob=0.5)
+            out = layers.mean(h)
+        return main, startup, out
+
+    feed = {"x": np.ones((4, 8), np.float32)}
+
+    main, startup, out = build()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[out])  # caches the UNSEEDED fn
+
+        def three_runs(seed):
+            main.random_seed = seed
+            main._rng_tick = 0  # rewind the deterministic run counter
+            return [float(np.asarray(
+                exe.run(main, feed=feed, fetch_list=[out])[0]).ravel()[0])
+                for _ in range(3)]
+
+        a = three_runs(123)
+        b = three_runs(123)
+        # the seed set AFTER the first (cached, unseeded) run governs
+        # later runs, tick-for-tick — previously the stale cache entry
+        # kept serving unseeded randomness and a == b failed
+        assert a == b, (a, b)
+        c = three_runs(321)
+        assert a != c, "different seeds must give different streams"
