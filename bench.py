@@ -103,9 +103,13 @@ STATUS_PATH = os.environ.get(
 )
 
 _PROBE_SRC = (
-    "import jax, jax.numpy as jnp; d = jax.devices();"
-    "x = jnp.ones((256, 256)); jax.block_until_ready(x @ x);"
-    "print('PROBE_OK', d[0].platform)"
+    # the matmul result is FETCHED: block_until_ready acks enqueue
+    # without device completion through the tunnel, so a block-based
+    # probe could declare a dead device attachable
+    "import numpy as np, jax, jax.numpy as jnp; d = jax.devices();"
+    "x = jnp.ones((256, 256));"
+    "s = float(np.asarray(jnp.sum(x @ x)));"
+    "print('PROBE_OK', d[0].platform, s)"
 )
 
 

@@ -518,25 +518,20 @@ def _block_io(block, feed_names: set, scope: Scope):
 def _lower(block, feed_names: Tuple[str, ...], fetch_names: Tuple[str, ...],
            state_in: Tuple[str, ...], state_out: Tuple[str, ...]):
     """Build the pure function feed, state_ro, state_rw, seed -> fetches,
-    new_state. `seed` is a SCALAR (uint32): the PRNG key derives from it
-    INSIDE the trace, so each run() costs one integer argument instead of
-    2-3 eager key/fold_in dispatches on the host + device (measured ~0.25
-    ms/step of pure-host time, and through the tunnelled TPU every eager
-    op is a remote enqueue). Key math is bit-identical to the old eager
-    path; random_seed/salt are trace-time constants (the jit cache keys
-    on program version, so a program edit retraces them)."""
+    new_state. `seed` is a uint32[3] = (root, salt, tick) vector (see
+    _next_seed): the PRNG key derives from it INSIDE the trace, so each
+    run() costs one small array argument instead of 2-3 eager
+    key/fold_in dispatches on the host + device (measured ~0.25 ms/step
+    of pure-host time, and through the tunnelled TPU every eager op is a
+    remote enqueue). All three components are traced values — changing
+    program.random_seed between runs reuses the SAME compiled executable
+    (no per-seed retrace through the slow remote-compile service), and
+    the seeded stream is bit-identical to the old eager
+    fold_in(fold_in(key(seed), salt), tick) chain."""
     program = block.program
     ops = [op.desc for op in block.ops if op.desc.type not in _SKIP_OP_TYPES]
     ro_names = tuple(n for n in state_in if n not in state_out)
     rw_names = tuple(n for n in state_in if n in state_out)
-    seeded = bool(program.random_seed) if program is not None else False
-    if seeded:
-        import zlib
-
-        if getattr(program, "_rng_salt_version", None) != program._version:
-            program._rng_salt = zlib.crc32(program.to_bytes())
-            program._rng_salt_version = program._version
-        static_seed, static_salt = int(program.random_seed), program._rng_salt
 
     def fn(feeds: Dict[str, Any], state_ro: Dict[str, Any],
            state_rw: Dict[str, Any], seed):
@@ -544,14 +539,9 @@ def _lower(block, feed_names: Tuple[str, ...], fetch_names: Tuple[str, ...],
             return _body(feeds, state_ro, state_rw, seed)
 
     def _body(feeds, state_ro, state_rw, seed):
-        if seeded:
-            # deterministic stream: salted root (see _next_seed docstring),
-            # folded with the per-run tick
-            key = jax.random.fold_in(
-                jax.random.fold_in(jax.random.key(static_seed), static_salt),
-                seed)
-        else:
-            key = jax.random.key(seed)
+        seed = jnp.asarray(seed)
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.key(seed[0]), seed[1]), seed[2])
         env: Dict[str, Any] = {}
         env.update(state_ro)
         env.update(state_rw)
@@ -705,13 +695,11 @@ class Executor:
         feed_sig = tuple(
             sorted((k, _feed_sig_entry(v)) for k, v in feed_arrays.items())
         )
-        # random_seed is in the key because _lower bakes it (and the
-        # program-content salt) into the trace: setting prog.random_seed
-        # after a run is a plain attribute write that doesn't bump
-        # _version, and a stale cached entry would silently keep the old
-        # seeding behavior
-        cache_key = (program._version, int(program.random_seed or 0),
-                     feed_sig, fetch_names, trace_flags())
+        # random_seed does NOT participate: the seed/salt/tick vector is
+        # a traced ARGUMENT (_lower), so one executable serves every seed
+        # and setting prog.random_seed after a cached run takes effect
+        # immediately (regression-tested)
+        cache_key = (program._version, feed_sig, fetch_names, trace_flags())
         prog_cache = self._cache.setdefault(program, {})
         entry = prog_cache.get(cache_key) if use_program_cache else None
         if entry is None:
@@ -758,7 +746,7 @@ class Executor:
             feed_arrays,
             {n: scope.find_var(n) for n in ro_names},
             {n: scope.find_var(n) for n in rw_names},
-            np.uint32(0),
+            np.zeros((3,), np.uint32),
         )
         return jfn, args
 
@@ -779,16 +767,23 @@ _step_counter = _StepCounter()
 
 
 def _next_seed(program: Program):
-    """Per-run RNG SEED scalar — the key derives from it inside the
-    jitted step (_lower._body). A seeded program is fully deterministic
-    (its own run counter); seed 0 draws from a process-global counter
-    (reference: seed 0 = fresh randomness each run).
+    """Per-run (root, salt, tick) uint32 vector — the key derives from it
+    inside the jitted step (_lower._body). A seeded program is fully
+    deterministic (its own run counter); seed 0 draws from a
+    process-global counter (reference: seed 0 = fresh randomness each
+    run).
 
-    The in-trace root key is salted with a content hash of the program so
-    that two *different* programs sharing one random_seed (e.g. startup +
-    main, whose op-seed counters both start at 1) draw from independent
+    The root key is salted with a content hash of the program so that two
+    *different* programs sharing one random_seed (e.g. startup + main,
+    whose op-seed counters both start at 1) draw from independent
     streams, while two identical builds still match bit-for-bit."""
     if program.random_seed:
+        import zlib
+
+        if getattr(program, "_rng_salt_version", None) != program._version:
+            program._rng_salt = zlib.crc32(program.to_bytes())
+            program._rng_salt_version = program._version
         program._rng_tick += 1
-        return np.uint32(program._rng_tick)
-    return np.uint32(_step_counter.next())
+        return np.asarray([program.random_seed, program._rng_salt,
+                           program._rng_tick], np.uint32)
+    return np.asarray([_step_counter.next(), 0, 0], np.uint32)

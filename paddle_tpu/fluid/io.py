@@ -248,7 +248,7 @@ def export_compiled_model(dirname, feeded_var_names, target_vars,
             feeds,
             {n: params[n] for n in ro_names},
             {n: params[n] for n in rw_names},
-            np.uint32(0),
+            np.zeros((3,), np.uint32),
         )
         return tuple(fetches)
 

@@ -180,10 +180,7 @@ class ParallelExecutor:
         )
         from .flags import trace_flags
 
-        # random_seed participates for the same reason as Executor._entry:
-        # _lower bakes seed+salt into the trace
-        cache_key = (id(program), program._version,
-                     int(program.random_seed or 0), feed_sig, fetch_names,
+        cache_key = (id(program), program._version, feed_sig, fetch_names,
                      trace_flags())
         entry = self._cache.get(cache_key)
         if entry is None:
@@ -230,7 +227,7 @@ class ParallelExecutor:
 
         state_ro = {n: _place(n, self._scope.find_var(n)) for n in ro_names}
         state_rw = {n: _place(n, self._scope.find_var(n)) for n in rw_names}
-        key = _next_seed(program)
+        seed = _next_seed(program)
         from ..parallel import mesh_context
 
         # emitters that need explicit SPMD (ring attention) see the mesh
@@ -239,7 +236,7 @@ class ParallelExecutor:
             if self._collect_cost:
                 if entry["compiled"] is None:
                     compiled = jfn.lower(
-                        feed_arrays, state_ro, state_rw, key).compile()
+                        feed_arrays, state_ro, state_rw, seed).compile()
                     ca = compiled.cost_analysis()
                     if isinstance(ca, (list, tuple)):
                         ca = ca[0] if ca else {}
@@ -251,9 +248,10 @@ class ParallelExecutor:
                     }
                 self.last_cost_analysis = entry["cost"]
                 fetches, new_state = entry["compiled"](
-                    feed_arrays, state_ro, state_rw, key)
+                    feed_arrays, state_ro, state_rw, seed)
             else:
-                fetches, new_state = jfn(feed_arrays, state_ro, state_rw, key)
+                fetches, new_state = jfn(feed_arrays, state_ro, state_rw,
+                                         seed)
         for n, v in new_state.items():
             self._scope.set_var(n, v)
         if return_numpy:

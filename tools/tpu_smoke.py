@@ -32,18 +32,22 @@ _STAGE_SRC = {
 import jax
 print("devices:", jax.devices())
 """,
+    # completion gates FETCH a scalar: through the axon tunnel
+    # block_until_ready acks the enqueue without waiting for the device,
+    # so a block-based gate could report ok for work that never ran
+    # (benchmarks/_timing.py has the measurements)
     "matmul": """
-import jax, jax.numpy as jnp
+import numpy as np, jax, jax.numpy as jnp
 x = jnp.ones((256, 256))
-jax.block_until_ready(x @ x)
+print("sum:", float(np.asarray(jnp.sum(x @ x))))
 """,
     "conv": """
-import jax, jax.numpy as jnp
+import numpy as np, jax, jax.numpy as jnp
 x = jnp.ones((8, 3, 64, 64))
 w = jnp.ones((16, 3, 3, 3))
 y = jax.lax.conv_general_dilated(x, w, (1, 1), "SAME",
                                  dimension_numbers=("NCHW", "OIHW", "NCHW"))
-jax.block_until_ready(jax.nn.relu(y))
+print("sum:", float(np.asarray(jnp.sum(jax.nn.relu(y)))))
 """,
     "lenet_train": """
 import numpy as np
