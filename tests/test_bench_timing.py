@@ -73,3 +73,18 @@ def test_step_time_s_rejects_bad_iter_counts():
         _timing.step_time_s(lambda i: None, 5, 5)
     with pytest.raises(ValueError):
         _timing.step_time_s(lambda i: None, 0, 5)
+
+
+def test_kernel_time_ms_accepts_warmup_zero(monkeypatch):
+    # warmup=0 is valid for an already-warm kernel; used to NameError
+    times = iter([0.08, 0.1, 0.3])  # cal, n1, n2
+
+    def fake_timed_run(dispatch, n):
+        return next(times), object()
+
+    monkeypatch.setattr(_timing, "timed_run", fake_timed_run)
+    monkeypatch.setattr(_timing, "device_sync", lambda x: 0.0)
+    monkeypatch.setattr(_timing, "sync_roundtrip_ms", lambda samples=3: 75.0)
+    ms, ev = _timing.kernel_time_ms(lambda i: object(), warmup=0)
+    assert ms > 0
+    assert ev["roundtrip_ms"] == 75.0
