@@ -16,7 +16,7 @@ size n_stages (shard that axis over `pp`).
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
