@@ -335,3 +335,49 @@ def test_double_buffer_dead_worker_reraises():
                 db.read_next()
     finally:
         db.close()
+
+
+def test_uint8_on_the_wire_with_in_graph_decode(tmp_path):
+    """The transfer-bound-link pipeline shape (input_pipeline_bench):
+    uint8 images stay uint8 through batching, the double-buffer stages,
+    and the device transfer; the f32 decode + 1/255 scale runs IN-GRAPH.
+    Trains end to end and the decoded values match the stored bytes."""
+    path = str(tmp_path / "u8.recordio")
+    rng = np.random.RandomState(3)
+    imgs = rng.randint(0, 256, size=(12, 6), dtype=np.uint8).astype(np.uint8)
+
+    def gen():
+        for i in range(12):
+            yield (imgs[i], np.array([i % 2], dtype=np.int64))
+
+    convert_reader_to_recordio_file(path, gen)
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        reader = layers.open_recordio_file(
+            path, shapes=[[6], [1]], dtypes=["uint8", "int64"])
+        reader = layers.batch(reader, batch_size=4, drop_last=True)
+        reader = layers.double_buffer(reader, capacity=2)
+        raw, label = layers.read_file(reader)
+        img = layers.scale(layers.cast(raw, "float32"), 1.0 / 255.0)
+        pred = layers.fc(input=img, size=2, act="softmax")
+        cost = layers.mean(layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        # fetch the decoded batch alongside the loss: values must equal
+        # bytes/255 for the first (in-order) batch
+        out = exe.run(main, fetch_list=[img, cost])
+        np.testing.assert_allclose(
+            np.asarray(out[0]), imgs[:4].astype(np.float32) / 255.0,
+            rtol=1e-6)
+        assert np.isfinite(np.asarray(out[1])).all()
+        n = 1
+        with pytest.raises(core.EOFException):
+            while True:
+                exe.run(main, fetch_list=[cost])
+                n += 1
+        assert n == 3  # 12 samples / bs 4
