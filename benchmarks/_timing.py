@@ -35,6 +35,21 @@ import jax
 import jax.numpy as jnp
 
 
+def framework_metrics():
+    """Compact snapshot of the paddle_tpu.observability registry (nonzero
+    counters/gauges, populated histograms) for embedding in BENCH_*.json
+    — the perf trajectory then carries framework-side numbers (jit
+    compiles vs cache hits, step-latency percentiles, RPC bytes), not
+    wall clock alone. Never raises: benches must survive a broken or
+    absent registry."""
+    try:
+        from paddle_tpu.observability import metrics
+
+        return metrics.snapshot(skip_zero=True)
+    except Exception:  # registry unavailable: report that, don't die
+        return {}
+
+
 def _first_leaf(tree):
     leaves = jax.tree_util.tree_leaves(tree)
     if not leaves:
@@ -105,6 +120,7 @@ def step_time_s(dispatch, n1, n2, warmup=1):
         "method": "slope_sync",
         "n1": n1, "n2": n2,
         "t1_s": round(t1, 4), "t2_s": round(t2, 4),
+        "framework_metrics": framework_metrics(),
     }
     if t2 > t1:
         per_step = (t2 - t1) / (n2 - n1)

@@ -17,6 +17,7 @@ Architecture (vs the reference):
 
 __version__ = "0.1.0"
 
+from . import observability  # noqa: F401  (no heavy deps; before fluid)
 from . import fluid  # noqa: F401
 from . import dataset, reader  # noqa: F401
 from .reader import batch  # noqa: F401

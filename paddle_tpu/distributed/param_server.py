@@ -25,13 +25,23 @@ see DistributeTranspiler.get_trainer_program(send_recv=True).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..observability import metrics as _metrics, tracing as _tracing
 from .rpc import RpcClient, RpcServer
 
 __all__ = ["ParameterServer", "ParameterClient", "get_client"]
+
+# ISSUE 1 instrumentation: push/pull volume counters plus the sync-mode
+# barrier wait-time histogram — the number that shows straggler trainers
+# (a fat p99 here IS the straggler, before anyone reads a timeline)
+_m_push = _metrics.counter("pserver.push_grad")
+_m_get = _metrics.counter("pserver.get_param")
+_m_get_rows = _metrics.counter("pserver.get_rows")
+_m_barrier_ms = _metrics.histogram("pserver.barrier_wait_ms")
 
 
 class ParameterServer:
@@ -168,6 +178,7 @@ class ParameterServer:
     def get_param(self, name: str):
         if name not in self._owned:
             raise KeyError(f"param '{name}' is not owned by this pserver")
+        _m_get.inc()
         v = self._scope.find_var(name)
         arr = np.asarray(v)
         with self._stats_mu:
@@ -181,6 +192,7 @@ class ParameterServer:
         trainer's memory train efficiently)."""
         if name not in self._owned:
             raise KeyError(f"param '{name}' is not owned by this pserver")
+        _m_get_rows.inc()
         rows = np.asarray(rows, dtype=np.int64).reshape(-1)
         table = np.asarray(self._scope.find_var(name))
         if rows.size and (rows.min() < 0 or rows.max() >= table.shape[0]):
@@ -195,6 +207,7 @@ class ParameterServer:
     def push_grad(self, name: str, grad, trainer_id: int = 0):
         if name not in self._owned:
             raise KeyError(f"param '{name}' is not owned by this pserver")
+        _m_push.inc()
         if not self._sync:
             # hogwild-style async with PER-PARAM atomicity: updates to one
             # param serialize (an unserialized read-modify-write would drop
@@ -234,9 +247,11 @@ class ParameterServer:
         if not self._sync or known_round is None:
             return {"round": self._round}
         target = int(known_round) + 1
-        with self._cv:
+        t0 = time.perf_counter()
+        with self._cv, _tracing.span("pserver.barrier", round=target):
             done = self._cv.wait_for(
                 lambda: self._round >= target, timeout=120)
+            _m_barrier_ms.observe((time.perf_counter() - t0) * 1e3)
             if not done:
                 raise TimeoutError(
                     f"sync round {known_round} incomplete after 120s — a "
@@ -268,8 +283,9 @@ class ParameterServer:
             if run_shared:
                 with self._shared_run_mu:
                     self._exe.run(self._shared_prog)
-            self._exe.run(self._per_param[name],
-                          feed={self._grad_name[name]: grad})
+            with _tracing.span("pserver.apply", param=name):
+                self._exe.run(self._per_param[name],
+                              feed={self._grad_name[name]: grad})
         with self._shared_mu:
             self._steps += 1
 

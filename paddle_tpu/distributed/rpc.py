@@ -18,14 +18,46 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
+
+from ..observability import metrics as _metrics, tracing as _tracing
+from ..observability.log import get_logger
+
+_log = get_logger("rpc")
 
 # the JSON header is small once tensors ride as segments: 16 MiB is roomy
 MAX_FRAME = 16 << 20
 # raw tensor segments per message: 1 GiB total
 MAX_SEGMENT_BYTES = 1 << 30
+
+
+class _ByteMeter(threading.local):
+    """Per-thread wire-byte tally. read_frame/write_frame credit it as
+    bytes cross the socket; RpcClient.call and the server handler
+    snapshot it around each message to attribute deltas to their side's
+    counters. Thread-local so concurrent client threads and server
+    handler threads never share (or contend on) an accumulator."""
+
+    def __init__(self):
+        self.read = 0
+        self.written = 0
+
+
+_meter = _ByteMeter()
+
+# client-side observability: per-method latency histograms are created on
+# first use (method sets are small); byte/retry/timeout counters are flat
+_m_cli_bytes_out = _metrics.counter("rpc.client.bytes_out")
+_m_cli_bytes_in = _metrics.counter("rpc.client.bytes_in")
+_m_cli_retries = _metrics.counter("rpc.client.connect_retries")
+_m_cli_timeouts = _metrics.counter("rpc.client.timeouts")
+_m_cli_errors = _metrics.counter("rpc.client.errors")
+_m_srv_bytes_out = _metrics.counter("rpc.server.bytes_out")
+_m_srv_bytes_in = _metrics.counter("rpc.server.bytes_in")
+_m_srv_errors = _metrics.counter("rpc.server.errors")
 
 
 def to_wire(obj, segs: Optional[list] = None):
@@ -95,6 +127,7 @@ def read_frame(rfile, max_frame: int = MAX_FRAME) -> Optional[dict]:
     body = rfile.read(n)
     if len(body) != n:
         return None
+    _meter.read += 4 + n
     return json.loads(body.decode("utf-8"))
 
 
@@ -110,6 +143,7 @@ def write_frame(wfile, obj: dict, max_frame: int = MAX_FRAME):
         )
     wfile.write(struct.pack("<I", len(out)) + out)
     wfile.flush()
+    _meter.written += 4 + len(out)
 
 
 def write_msg(wfile, obj, max_frame: int = MAX_FRAME):
@@ -132,6 +166,7 @@ def write_msg(wfile, obj, max_frame: int = MAX_FRAME):
     write_frame(wfile, wire, max_frame)
     for s in segs:
         wfile.write(s)
+        _meter.written += len(s)
     if segs:
         wfile.flush()
 
@@ -160,6 +195,7 @@ def read_msg(rfile, max_frame: int = MAX_FRAME):
             b = rfile.read(int(n))
             if len(b) != int(n):
                 return None
+            _meter.read += len(b)
             segs.append(b)
         if "__body__" in obj and len(obj) == 1:
             obj = obj["__body__"]
@@ -181,6 +217,7 @@ class RpcServer:
             def handle(self):
                 try:
                     while True:
+                        r0, w0 = _meter.read, _meter.written
                         try:
                             msg = read_msg(self.rfile)
                         except (json.JSONDecodeError, UnicodeDecodeError) as e:
@@ -189,6 +226,10 @@ class RpcServer:
                             # bytes are still on the wire and cannot be
                             # skipped — reading on would parse tensor bytes
                             # as the next length prefix and silently desync
+                            _m_srv_errors.inc()
+                            _log.error(
+                                "bad frame from %s: %s",
+                                self.client_address, e)
                             write_frame(self.wfile,
                                         {"ok": False,
                                          "error": f"bad frame: {e}"})
@@ -196,16 +237,36 @@ class RpcServer:
                         if msg is None:
                             return
                         req, segs = msg
-                        try:
-                            fn = methods.get(req["method"])
-                            if fn is None:
-                                raise ValueError(
-                                    f"unknown RPC method {req['method']!r}")
-                            result = fn(*from_wire(req.get("args", []), segs))
-                            resp = {"ok": True, "result": result}
-                        except Exception as e:  # report, keep serving
-                            resp = {"ok": False,
-                                    "error": f"{type(e).__name__}: {e}"}
+                        method = req.get("method", "?")
+                        t0 = time.perf_counter()
+                        with _tracing.span("rpc.server.handle",
+                                           method=method):
+                            try:
+                                fn = methods.get(method)
+                                if fn is None:
+                                    raise ValueError(
+                                        f"unknown RPC method {method!r}")
+                                result = fn(
+                                    *from_wire(req.get("args", []), segs))
+                                resp = {"ok": True, "result": result}
+                            except Exception as e:  # report, keep serving
+                                # handler failures used to surface only
+                                # client-side; name the method and peer so
+                                # the server's log carries the evidence
+                                _m_srv_errors.inc()
+                                _log.error(
+                                    "handler %r failed for peer %s: "
+                                    "%s: %s", method, self.client_address,
+                                    type(e).__name__, e)
+                                resp = {"ok": False,
+                                        "error": f"{type(e).__name__}: {e}"}
+                        if method in methods:
+                            # per-method only for REGISTERED methods — a
+                            # hostile peer must not mint unbounded metric
+                            # names into the process-wide registry
+                            _metrics.histogram(
+                                f"rpc.server.{method}.ms").observe(
+                                    (time.perf_counter() - t0) * 1e3)
                         try:
                             write_msg(self.wfile, resp)
                         except IOError as e:
@@ -213,9 +274,15 @@ class RpcServer:
                             # written): tell the CLIENT why instead of
                             # dropping the connection into an opaque
                             # "server closed mid-call"
+                            _m_srv_errors.inc()
+                            _log.error(
+                                "oversized response to %r for peer %s: %s",
+                                method, self.client_address, e)
                             write_frame(self.wfile,
                                         {"ok": False,
                                          "error": f"{type(e).__name__}: {e}"})
+                        _m_srv_bytes_in.inc(_meter.read - r0)
+                        _m_srv_bytes_out.inc(_meter.written - w0)
                 except (ConnectionError, EOFError, IOError):
                     return
 
@@ -256,7 +323,8 @@ class RpcClient:
         self._mu = threading.Lock()
 
     def call(self, method: str, *args):
-        with self._mu:
+        t0 = time.perf_counter()
+        with self._mu, _tracing.span("rpc.client.call", method=method):
             if self._sock is None:
                 # connecting is side-effect-free: retry once
                 for attempt in (0, 1):
@@ -265,21 +333,33 @@ class RpcClient:
                             self._addr, timeout=self._timeout)
                         break
                     except OSError:
-                        if attempt:
+                        if attempt:  # both attempts failed: a real error
+                            _m_cli_errors.inc()
                             raise
+                        _m_cli_retries.inc()
                 self._rfile = self._sock.makefile("rb")
                 self._wfile = self._sock.makefile("wb")
+            r0, w0 = _meter.read, _meter.written
             try:
                 write_msg(self._wfile, {"method": method, "args": list(args)})
                 msg = read_msg(self._rfile)
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as e:
+                (_m_cli_timeouts if isinstance(e, socket.timeout)
+                 else _m_cli_errors).inc()
                 self.close_locked()
                 raise
+            finally:
+                _m_cli_bytes_out.inc(_meter.written - w0)
+                _m_cli_bytes_in.inc(_meter.read - r0)
             if msg is None:
+                _m_cli_errors.inc()
                 self.close_locked()
                 raise ConnectionError("server closed mid-call")
             resp, segs = msg
+        _metrics.histogram(f"rpc.client.{method}.ms").observe(
+            (time.perf_counter() - t0) * 1e3)
         if not resp.get("ok"):
+            _m_cli_errors.inc()
             raise RuntimeError(f"RPC {method} failed: {resp.get('error')}")
         return from_wire(resp.get("result"), segs)
 

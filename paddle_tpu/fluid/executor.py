@@ -20,6 +20,7 @@ compiler client:
 from __future__ import annotations
 
 import functools
+import time as _time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -27,11 +28,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import core
+from ..observability import metrics as _metrics, tracing as _tracing
 from .flags import FLAGS
 from .framework import Program, Variable, default_main_program
 from .registry import EmitCtx, exec_op_descs
 
 from .readers import READER_CREATE_OP_TYPES, create_host_reader
+
+# observability handles (ISSUE 1): flat counters + the per-step latency
+# histogram. jit_compiles vs jit_cache_hits is the first-class signal that
+# a feed-shape or flag churn is retracing the program every step;
+# feed_sig_cache_miss isolates the misses caused by a NEW feed signature
+# against an already-compiled program version.
+_m_jit_compiles = _metrics.counter("executor.jit_compiles")
+_m_jit_cache_hits = _metrics.counter("executor.jit_cache_hits")
+_m_feed_sig_misses = _metrics.counter("executor.feed_sig_cache_miss")
+_m_step_ms = _metrics.histogram("executor.step_ms")
 
 # ops the device program never sees: feed/fetch plumbing, the host-side
 # reader stack (creation ops run in the startup pre-pass; `read` resolves to
@@ -582,6 +594,16 @@ class Executor:
         use_program_cache: bool = True,
     ):
         program = program or default_main_program()
+        t0 = _time.perf_counter()
+        with _tracing.span("executor.step",
+                           program_version=program._version):
+            out = self._run_body(program, feed, fetch_list, scope,
+                                 return_numpy, use_program_cache)
+        _m_step_ms.observe((_time.perf_counter() - t0) * 1000.0)
+        return out
+
+    def _run_body(self, program, feed, fetch_list, scope, return_numpy,
+                  use_program_cache):
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()
@@ -596,10 +618,12 @@ class Executor:
             # readers/io/transport still run; fetches resolve straight from
             # host values (a read-only program fetching its minibatch, or a
             # recv-only parameter pull)
-            host_feeds = _run_reader_host_ops(block, scope)
+            with _tracing.span("executor.reader"):
+                host_feeds = _run_reader_host_ops(block, scope)
             send_ops, recv_ops, _ = _dist_host_ops(block)
             if recv_ops:
-                _run_recv_ops(recv_ops, scope)
+                with _tracing.span("executor.recv"):
+                    _run_recv_ops(recv_ops, scope)
             if send_ops:
                 vals = {}
                 for op in send_ops:
@@ -610,7 +634,8 @@ class Executor:
                                 f"send op: var '{n}' has no value (no "
                                 "device ops produce it in this program)")
                         vals[n] = v
-                _run_send_ops(send_ops, vals, scope)
+                with _tracing.span("executor.send"):
+                    _run_send_ops(send_ops, vals, scope)
             _run_io_host_ops(io_post, scope)
             out = []
             for v in fetch_list or []:
@@ -622,7 +647,8 @@ class Executor:
                         "has no device ops")
                 out.append(np.asarray(val) if return_numpy else val)
             return out
-        reader_feeds = _run_reader_host_ops(block, scope)
+        with _tracing.span("executor.reader"):
+            reader_feeds = _run_reader_host_ops(block, scope)
         feed_arrays = {
             k: _as_feed(v) for k, v in {**feed, **reader_feeds}.items()
         }
@@ -632,9 +658,11 @@ class Executor:
         # Trailing saves of non-persistable temps ride the same mechanism.
         send_ops, recv_ops, prefetch_ops = _dist_host_ops(block)
         if recv_ops:
-            _run_recv_ops(recv_ops, scope)
+            with _tracing.span("executor.recv"):
+                _run_recv_ops(recv_ops, scope)
         if prefetch_ops:
-            _run_prefetch_ops(prefetch_ops, feed_arrays, scope)
+            with _tracing.span("executor.prefetch"):
+                _run_prefetch_ops(prefetch_ops, feed_arrays, scope)
         want: List[str] = []
         if send_ops:
             want += [n for op in send_ops
@@ -654,10 +682,20 @@ class Executor:
         state_ro = {n: scope.find_var(n) for n in ro_names}
         state_rw = {n: scope.find_var(n) for n in rw_names}
         seed = _next_seed(program)
-        import time as _time
-
         t0 = _time.perf_counter() if FLAGS["benchmark"] else 0.0
-        fetches, new_state = jfn(feed_arrays, state_ro, state_rw, seed)
+        if getattr(self, "_compiled_now", False):
+            # jax.jit is lazy: the actual trace + XLA compile happens on
+            # THIS first call, so the compile span must wrap it (the
+            # executor.lower span above only covers building the python
+            # callable) — otherwise a multi-second TPU compile hides
+            # inside the first executor.step and poisons step_ms's max
+            with _tracing.span("executor.jit_compile",
+                               program_version=program._version):
+                fetches, new_state = jfn(feed_arrays, state_ro, state_rw,
+                                         seed)
+            self._compiled_now = False
+        else:
+            fetches, new_state = jfn(feed_arrays, state_ro, state_rw, seed)
         if FLAGS["benchmark"]:
             jax.block_until_ready(fetches)
             print(f"[benchmark] run took {(_time.perf_counter()-t0)*1000:.3f} ms")
@@ -665,7 +703,8 @@ class Executor:
             scope.set_var(n, v)
         fetched_vals = dict(zip(fetch_names + extra_fetches, fetches))
         if send_ops:
-            _run_send_ops(send_ops, fetched_vals, scope)
+            with _tracing.span("executor.send"):
+                _run_send_ops(send_ops, fetched_vals, scope)
         fetches = fetches[:len(fetch_names)]
         # trailing save ops see the POST-step scope (reference in-order
         # save_op semantics: a train+checkpoint program saves updated
@@ -703,22 +742,36 @@ class Executor:
         prog_cache = self._cache.setdefault(program, {})
         entry = prog_cache.get(cache_key) if use_program_cache else None
         if entry is None:
-            state_in, state_out = _block_io(block, set(feed_arrays), scope)
-            missing = [n for n in state_in if not scope.has_var(n)]
-            if missing:
-                raise RuntimeError(
-                    f"vars {missing} are read by the program but not initialized in "
-                    "scope — run the startup program first or feed them"
+            # a miss against a program version that already has compiled
+            # entries means the FEED SIGNATURE (or fetch/flag set) churned
+            # — the retrace source the feed_sig counter isolates
+            if any(k[0] == program._version for k in prog_cache):
+                _m_feed_sig_misses.inc()
+            _m_jit_compiles.inc()
+            self._compiled_now = True
+            with _tracing.span("executor.lower",
+                               program_version=program._version):
+                state_in, state_out = _block_io(block, set(feed_arrays),
+                                                scope)
+                missing = [n for n in state_in if not scope.has_var(n)]
+                if missing:
+                    raise RuntimeError(
+                        f"vars {missing} are read by the program but not "
+                        "initialized in scope — run the startup program "
+                        "first or feed them"
+                    )
+                fn, ro_names, rw_names = _lower(
+                    block, tuple(feed_arrays), fetch_names, tuple(state_in),
+                    tuple(state_out),
                 )
-            fn, ro_names, rw_names = _lower(
-                block, tuple(feed_arrays), fetch_names, tuple(state_in),
-                tuple(state_out),
-            )
-            donate = (2,) if FLAGS["donate_state"] else ()
-            jfn = jax.jit(fn, donate_argnums=donate)
+                donate = (2,) if FLAGS["donate_state"] else ()
+                jfn = jax.jit(fn, donate_argnums=donate)
             entry = (jfn, ro_names, rw_names, tuple(state_out))
             if use_program_cache:
                 prog_cache[cache_key] = entry
+        else:
+            _m_jit_cache_hits.inc()
+            self._compiled_now = False
         return entry
 
     def lowered(

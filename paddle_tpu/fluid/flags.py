@@ -6,7 +6,26 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-FLAGS: Dict[str, Any] = {
+# the recorder parses PADDLE_TPU_TRACE / PADDLE_TPU_TRACE_BUFFER once at
+# import; FLAGS reads its LIVE state rather than re-parsing the env, so
+# one parser owns both views
+from ..observability import tracing as _tracing
+
+
+class _Flags(dict):
+    """FLAGS with read-through keys: 'trace'/'trace_buffer' always report
+    the live recorder (profiler() and trace_enable() toggle it without
+    going through set_flags, so a stored mirror would go stale)."""
+
+    def __getitem__(self, k):
+        if k == "trace":
+            return _tracing.trace_enabled()
+        if k == "trace_buffer":
+            return _tracing.buffer_capacity()
+        return dict.__getitem__(self, k)
+
+
+FLAGS: Dict[str, Any] = _Flags({
     # numeric precision of matmul/conv inside lowered blocks:
     #   'highest' = fp32 accumulate+multiply (reference fp32 CUDA parity)
     #   'high'    = bf16x3 on TPU
@@ -47,7 +66,15 @@ FLAGS: Dict[str, Any] = {
     # enforce semantics (shape_inference.h). CI enables this; the warn
     # default keeps a conservative emitter from bricking user programs.
     "strict_shape_inference": False,
-}
+    # record host spans into paddle_tpu.observability.tracing from process
+    # start (profiler()/trace_enable() also toggle at runtime). Purely a
+    # host-side recorder: does NOT affect what gets traced/compiled, so
+    # deliberately absent from trace_flags(). Reads are live (see _Flags);
+    # the stored values here only seed `k in FLAGS` / sorted(FLAGS).
+    "trace": _tracing.trace_enabled(),
+    # span ring-buffer capacity (oldest spans drop past it)
+    "trace_buffer": _tracing.buffer_capacity(),
+})
 
 
 def pallas_enabled() -> bool:
@@ -71,6 +98,17 @@ def set_flags(d: Dict[str, Any]):
         if k not in FLAGS:
             raise KeyError(f"unknown flag {k!r}; known: {sorted(FLAGS)}")
         FLAGS[k] = v
+        # propagate to the live recorder so set_flags is a complete
+        # control surface. Each key acts independently: resizing the
+        # buffer must not flip the enable bit (a profiler()-enabled
+        # session stays enabled), and vice versa.
+        if k == "trace":
+            if v:
+                _tracing.trace_enable(buffer_size=FLAGS["trace_buffer"])
+            else:
+                _tracing.trace_disable()
+        elif k == "trace_buffer":
+            _tracing.resize_buffer(int(v))
 
 
 def get_flag(name: str):

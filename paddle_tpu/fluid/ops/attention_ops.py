@@ -52,8 +52,9 @@ def ring_attention(ctx, ins, attrs):
             # shard batch (and heads) over the mesh with shard_map and run
             # the kernel per shard. Attention is embarrassingly parallel in
             # batch/heads, so no collectives are needed.
-            import jax
             from jax.sharding import PartitionSpec as P
+
+            from ...jax_compat import shard_map
 
             sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
             b_ax = attrs.get("batch_axis", "") or None
@@ -66,7 +67,7 @@ def ring_attention(ctx, ins, attrs):
                 h_ax = None
             if b_ax is not None or h_ax is not None:
                 spec = P(b_ax, None, h_ax, None)
-                fn = jax.shard_map(
+                fn = shard_map(
                     lambda qs, ks, vs: flash_attention(
                         qs, ks, vs, causal=causal, scale=scale,
                         interpret=pallas_interpret()),

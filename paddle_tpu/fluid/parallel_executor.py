@@ -18,6 +18,7 @@ API preserved: ParallelExecutor(use_cuda, loss_name).run(fetch_list, feed).
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Any, Dict, Optional, Sequence
 
 import jax
@@ -26,9 +27,22 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import core
+from ..observability import metrics as _metrics, tracing as _tracing
 from .enforce import throw_on
 from .executor import Scope, _block_io, _lower, _next_seed, global_scope
 from .framework import Program, Variable, default_main_program
+
+# per-step latency over the sharded executable. Under SPMD the gradient
+# all-reduce is INSIDE the step program (XLA inserts the ICI collectives
+# where the grad computation crosses the sharded batch dim), so
+# grad_allreduce_step_ms — observed only for runs dispatching a training
+# step (loss_name set) — is the collective-inclusive step time, the
+# number the reference's per-NCCLAllReduceOpHandle timers added up to.
+_m_pe_step_ms = _metrics.histogram("parallel_executor.step_ms")
+_m_pe_allreduce_ms = _metrics.histogram(
+    "parallel_executor.grad_allreduce_step_ms")
+_m_pe_compiles = _metrics.counter("parallel_executor.jit_compiles")
+_m_pe_cache_hits = _metrics.counter("parallel_executor.jit_cache_hits")
 
 
 def _as_name(v) -> str:
@@ -183,7 +197,10 @@ class ParallelExecutor:
         cache_key = (id(program), program._version, feed_sig, fetch_names,
                      trace_flags())
         entry = self._cache.get(cache_key)
+        if entry is not None:
+            _m_pe_cache_hits.inc()
         if entry is None:
+            _m_pe_compiles.inc()
             state_in, state_out = _block_io(block, set(feed_arrays), self._scope)
             missing = [n for n in state_in if not self._scope.has_var(n)]
             if missing:
@@ -232,7 +249,10 @@ class ParallelExecutor:
 
         # emitters that need explicit SPMD (ring attention) see the mesh
         # during tracing, which happens inside this first call
-        with mesh_context(mesh):
+        t0 = _time.perf_counter()
+        with mesh_context(mesh), _tracing.span(
+                "parallel_executor.step", devices=int(mesh.devices.size),
+                program_version=program._version):
             if self._collect_cost:
                 if entry["compiled"] is None:
                     compiled = jfn.lower(
@@ -252,6 +272,10 @@ class ParallelExecutor:
             else:
                 fetches, new_state = jfn(feed_arrays, state_ro, state_rw,
                                          seed)
+        step_ms = (_time.perf_counter() - t0) * 1e3
+        _m_pe_step_ms.observe(step_ms)
+        if self._loss_name:  # a training step: includes the grad all-reduce
+            _m_pe_allreduce_ms.observe(step_ms)
         for n, v in new_state.items():
             self._scope.set_var(n, v)
         if return_numpy:
@@ -267,6 +291,11 @@ class ParallelExecutor:
         through the local-shard contribution path, like run())."""
         mesh = self._mesh
         multiproc = _spans_processes(mesh)
+        with _tracing.span("parallel_executor.bcast_params",
+                           devices=int(mesh.devices.size)):
+            self._bcast_params_body(mesh, multiproc)
+
+    def _bcast_params_body(self, mesh, multiproc):
         for name in list(self._scope.var_names()):
             v = self._scope.find_var(name)
             if multiproc:

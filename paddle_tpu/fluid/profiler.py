@@ -1,12 +1,21 @@
 """Profiler (reference python/paddle/fluid/profiler.py + platform/profiler.cc):
-host RecordEvent table + TPU trace export.
+host RecordEvent table + chrome-trace export, re-implemented on top of
+paddle_tpu.observability.
 
 The reference aggregates per-op host/CUDA timings into a table and exports
-chrome://tracing JSON via CUPTI (device_tracer.cc, tools/timeline.py). Under
-XLA the per-op boundary is fused away, so the equivalents are:
-  - RecordEvent/profiler(): host-side named spans, aggregated table output
-  - jax.profiler traces (xplane) for device timelines, viewable in
-    TensorBoard/Perfetto — the chrome-trace role.
+chrome://tracing JSON via CUPTI (device_tracer.cc + tools/timeline.py).
+Here the observability trace recorder plays the device_tracer role: every
+RecordEvent (and every framework-internal span — executor steps, RPC
+calls, reader pops) lands in one ring buffer, and `profiler(profile_path=
+...)` exports it as chrome://tracing JSON loadable in Perfetto. The
+aggregated table output and the RecordEvent/profiler()/start_profiler()
+API are preserved exactly.
+
+Timing-loss fix (ISSUE 1 satellite): enable-state is captured at
+`__enter__`, not checked at `__exit__` — a span straddling
+stop_profiler() is counted in the table it STARTED under instead of being
+silently dropped, and start_profiler() resets aggregation state like the
+reference's profiler begin does.
 """
 from __future__ import annotations
 
@@ -15,27 +24,37 @@ import time
 from collections import defaultdict
 from typing import Optional
 
-import jax
+from ..observability import tracing
 
 _events = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])  # calls,total,min,max
 _enabled = False
 
 
 class RecordEvent:
-    """RAII span (reference platform/profiler.h:73)."""
+    """RAII span (reference platform/profiler.h:73). Feeds BOTH the
+    aggregated table and the observability trace buffer."""
 
     def __init__(self, name: str):
         self.name = name
         self._t0 = None
+        self._armed = False
+        self._span = None
 
     def __enter__(self):
+        # capture enable-state NOW: a span that straddles stop_profiler()
+        # belongs to the profile it started under (checking at __exit__
+        # lost it entirely — satellite fix)
+        self._armed = _enabled
+        self._span = tracing.span(self.name)
+        self._span.__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        if not _enabled:
-            return False
         dt = (time.perf_counter() - self._t0) * 1000.0
+        self._span.__exit__(*exc)
+        if not self._armed:
+            return False
         rec = _events[self.name]
         rec[0] += 1
         rec[1] += dt
@@ -66,36 +85,67 @@ def _print_table(sorted_key: Optional[str]):
 @contextlib.contextmanager
 def profiler(state: str = "All", sorted_key: Optional[str] = None,
              profile_path: Optional[str] = None):
-    """reference fluid/profiler.py:76. With profile_path, also captures a
-    jax.profiler device trace (xplane) into that directory."""
+    """reference fluid/profiler.py:76. With profile_path, exports the
+    scope's spans (RecordEvents + executor/RPC/reader instrumentation) as
+    chrome://tracing JSON to that path — open it in Perfetto
+    (ui.perfetto.dev) or chrome://tracing. A directory path gets
+    <dir>/trace.json (the old xplane-directory contract)."""
     global _enabled
     _enabled = True
     reset_profiler()
-    trace_ctx = (
-        jax.profiler.trace(profile_path) if profile_path else contextlib.nullcontext()
-    )
-    with trace_ctx:
-        try:
-            yield
-        finally:
-            _enabled = False
-            _print_table(sorted_key)
+    was_tracing = tracing.trace_enabled()
+    tracing.trace_enable()
+    if not was_tracing:
+        tracing.trace_reset()
+    try:
+        yield
+    finally:
+        _enabled = False
+        if profile_path:
+            tracing.trace_export(profile_path)
+        if not was_tracing:
+            tracing.trace_disable()
+        _print_table(sorted_key)
 
 
 @contextlib.contextmanager
 def cuda_profiler(output_file=None, output_mode=None, config=None):
     """Name kept for reference API parity (fluid/profiler.py:33); maps to a
-    device trace under JAX."""
+    device trace under JAX (xplane, viewable in TensorBoard/Perfetto)."""
+    import jax
+
     with jax.profiler.trace(output_file or "/tmp/paddle_tpu_trace"):
         yield
 
 
+# was span recording already on (env flag / explicit trace_enable) when
+# start_profiler turned it on? stop_profiler restores that state instead
+# of leaving the recorder running process-wide forever. None = no
+# start_profiler pending — an unpaired stop_profiler() must NOT touch a
+# session someone else (PADDLE_TPU_TRACE, trace_enable) started.
+_prev_tracing: Optional[bool] = None
+
+
 def start_profiler(state: str = "All"):
-    global _enabled
+    """reference fluid/profiler.py:51 — begins a fresh profile: resets
+    aggregation (the reference's EnableProfiler starts a new recording)
+    and turns span recording on."""
+    global _enabled, _prev_tracing
+    reset_profiler()
     _enabled = True
+    if _prev_tracing is None:  # nested starts keep the OUTERMOST state
+        _prev_tracing = tracing.trace_enabled()
+    tracing.trace_enable()
+    if not _prev_tracing:
+        tracing.trace_reset()
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
-    global _enabled
+    global _enabled, _prev_tracing
     _enabled = False
+    if profile_path:
+        tracing.trace_export(profile_path)
+    if _prev_tracing is False:
+        tracing.trace_disable()
+    _prev_tracing = None
     _print_table(sorted_key)

@@ -27,11 +27,47 @@ import pickle
 import queue
 import random
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..native.recordio import RecordIOReader, multi_file_reader
+from ..observability import metrics as _metrics, tracing as _tracing
+
+
+# process-wide totals are exact and shared; the RATE is tracked
+# PER PIPELINE (per _Throughput instance) — a train reader and an eval
+# reader interleaving must not measure each other's inter-batch gaps
+_m_reader_gauge = _metrics.gauge("reader.records_per_sec")
+_m_reader_batches = _metrics.counter("reader.batches")
+_m_reader_records = _metrics.counter("reader.records")
+
+
+class _Throughput:
+    """Pipeline throughput -> `reader.records_per_sec` gauge (+ exact
+    batch/record counters). One instance per BatchReader — the
+    batch-assembly boundary, where every record of the pipeline passes
+    exactly once whatever decorators wrap it. EWMA over instantaneous
+    batch-to-batch rates so one slow disk seek doesn't zero the gauge;
+    the shared gauge reports the most recently active pipeline's rate."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._last: Optional[float] = None
+        self._rate = 0.0
+
+    def batch(self, n: int):
+        now = time.perf_counter()
+        _m_reader_batches.inc()
+        _m_reader_records.inc(n)
+        with self._mu:
+            if self._last is not None and now > self._last:
+                inst = n / (now - self._last)
+                self._rate = inst if self._rate == 0.0 else (
+                    0.8 * self._rate + 0.2 * inst)
+                _m_reader_gauge.set(self._rate)
+            self._last = now
 
 __all__ = [
     "HostReader", "RecordIOFileReader", "MultiFileReader", "ShuffleReader",
@@ -159,16 +195,19 @@ class BatchReader(_Decorated):
         self._batch_size = batch_size
         self._drop_last = drop_last
         self._lod = [int(s.get("lod_level", 0)) for s in (slots or [])]
+        self._throughput = _Throughput()
 
     def read_next(self):
         samples = []
-        try:
-            while len(samples) < self._batch_size:
-                samples.append(self.inner.read_next())
-        except StopIteration:
-            if not samples or (self._drop_last
-                               and len(samples) < self._batch_size):
-                raise StopIteration from None
+        with _tracing.span("reader.batch"):
+            try:
+                while len(samples) < self._batch_size:
+                    samples.append(self.inner.read_next())
+            except StopIteration:
+                if not samples or (self._drop_last
+                                   and len(samples) < self._batch_size):
+                    raise StopIteration from None
+            self._throughput.batch(len(samples))
         slots = []
         for i, vals in enumerate(zip(*samples)):
             arrs = [np.asarray(v) for v in vals]

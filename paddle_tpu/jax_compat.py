@@ -1,0 +1,19 @@
+"""jax version shims. This image ships jax 0.4.37, where shard_map lives
+in jax.experimental and the replication-check kwarg is `check_rep`; newer
+jax exports `jax.shard_map` with `check_vma`. Callers import from here so
+one file owns the skew."""
+from __future__ import annotations
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: check_vma})

@@ -1,0 +1,41 @@
+"""paddle_tpu.observability — framework-wide tracing, metrics, logging.
+
+The substrate every perf PR reports against (ISSUE 1):
+
+  - `tracing`: thread-safe span recorder -> chrome://tracing JSON
+    (`span()`, `trace_export()`), near-zero cost when disabled. The role
+    the reference's platform/profiler.cc + device_tracer.cc played.
+  - `metrics`: always-on counter/gauge/histogram registry with dict
+    snapshot + Prometheus text export. BENCH_*.json embeds a snapshot so
+    the perf trajectory carries framework counters (jit compiles, cache
+    hits, RPC bytes), not just wall clock.
+  - `log`: the `paddle_tpu.*` logger tree (PADDLE_TPU_LOG_LEVEL).
+  - `timeline`: `python -m paddle_tpu.observability.timeline trace.json`
+    prints a top-N span summary (tools/timeline.py's role);
+    `--selftest` round-trips a synthetic trace and is wired into tier-1.
+
+Env flags: PADDLE_TPU_TRACE=1 enables span recording at import;
+PADDLE_TPU_TRACE_BUFFER sizes the ring buffer (default 65536 spans).
+`fluid.profiler.profiler(profile_path=...)` also enables tracing for its
+scope and exports on exit, so the legacy API gained the exporter for
+free.
+"""
+from . import metrics, tracing  # noqa: F401
+from .log import get_logger  # noqa: F401
+from .metrics import (  # noqa: F401
+    counter,
+    gauge,
+    histogram,
+    prometheus_text,
+    reset_metrics,
+    snapshot,
+)
+from .tracing import (  # noqa: F401
+    span,
+    trace_enable,
+    trace_disable,
+    trace_enabled,
+    trace_events,
+    trace_export,
+    trace_reset,
+)

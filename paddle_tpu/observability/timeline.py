@@ -1,0 +1,144 @@
+"""Timeline CLI — the tools/timeline.py role for exported traces.
+
+  python -m paddle_tpu.observability.timeline trace.json [--top N]
+      print a per-span-name summary (calls, total/avg/max ms, % of
+      wall) of a chrome://tracing JSON file, heaviest first.
+
+  python -m paddle_tpu.observability.timeline --selftest
+      record a synthetic multi-thread trace through the real recorder,
+      export it, and validate the JSON round-trips with well-formed
+      ph/ts/dur fields and correct cross-thread nesting. Exit 0 on
+      success — tier-1 runs this so a broken exporter fails fast.
+
+Traces open in Perfetto (https://ui.perfetto.dev) or chrome://tracing.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: no traceEvents list")
+    return events
+
+
+def summarize(events: List[Dict[str, Any]], top: int = 20) -> str:
+    """Top-N table by total duration. Only complete ("X") events carry
+    dur; B/E pairs from foreign tools are ignored rather than guessed."""
+    agg = defaultdict(lambda: [0, 0.0, 0.0])  # calls, total_us, max_us
+    t_min, t_max = float("inf"), float("-inf")
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        dur = float(ev.get("dur", 0.0))
+        ts = float(ev.get("ts", 0.0))
+        rec = agg[ev.get("name", "?")]
+        rec[0] += 1
+        rec[1] += dur
+        rec[2] = max(rec[2], dur)
+        t_min = min(t_min, ts)
+        t_max = max(t_max, ts + dur)
+    wall_us = (t_max - t_min) if t_max > t_min else 0.0
+    rows = sorted(agg.items(), key=lambda kv: kv[1][1], reverse=True)[:top]
+    lines = [
+        f"{'Span':<44}{'Calls':>7}{'Total(ms)':>11}{'Avg(ms)':>10}"
+        f"{'Max(ms)':>10}{'%Wall':>8}"
+    ]
+    for name, (calls, total, mx) in rows:
+        pct = (total / wall_us * 100.0) if wall_us else 0.0
+        lines.append(
+            f"{name[:44]:<44}{calls:>7}{total / 1e3:>11.3f}"
+            f"{total / calls / 1e3:>10.3f}{mx / 1e3:>10.3f}{pct:>7.1f}%"
+        )
+    lines.append(
+        f"-- {sum(r[0] for r in agg.values())} spans, "
+        f"{len(agg)} distinct names, wall {wall_us / 1e3:.3f} ms"
+    )
+    return "\n".join(lines)
+
+
+def _selftest() -> int:
+    """End-to-end recorder -> exporter -> parser check on a synthetic
+    workload with nested and cross-thread spans."""
+    import os
+    import tempfile
+    import threading
+    import time
+
+    from . import tracing
+
+    tracing.trace_enable(buffer_size=4096)
+    tracing.trace_reset()
+    try:
+        with tracing.span("selftest.parent", step=1):
+            with tracing.span("selftest.child"):
+                time.sleep(0.002)
+            with tracing.span("selftest.child"):
+                time.sleep(0.001)
+
+        def worker():
+            with tracing.span("selftest.worker"):
+                time.sleep(0.001)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        with tempfile.TemporaryDirectory() as d:
+            path = tracing.trace_export(os.path.join(d, "trace.json"))
+            events = load_events(path)
+    finally:
+        tracing.trace_disable()
+        tracing.trace_reset()
+
+    by_name = defaultdict(list)
+    for ev in events:
+        for field in ("name", "ph", "ts", "dur", "pid", "tid"):
+            assert field in ev, f"event missing {field!r}: {ev}"
+        assert ev["ph"] == "X", ev
+        assert ev["dur"] >= 0 and ev["ts"] >= 0, ev
+        by_name[ev["name"]].append(ev)
+    assert len(by_name["selftest.parent"]) == 1, by_name
+    assert len(by_name["selftest.child"]) == 2, by_name
+    assert len(by_name["selftest.worker"]) == 1, by_name
+    parent = by_name["selftest.parent"][0]
+    assert parent["args"] == {"step": 1}, parent
+    p0, p1 = parent["ts"], parent["ts"] + parent["dur"]
+    for child in by_name["selftest.child"]:
+        assert p0 <= child["ts"] and child["ts"] + child["dur"] <= p1, \
+            (parent, child)
+        assert child["tid"] == parent["tid"]
+    assert by_name["selftest.worker"][0]["tid"] != parent["tid"]
+    print(summarize(events))
+    print("timeline selftest ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.timeline",
+        description="Summarize a chrome://tracing JSON exported by "
+                    "paddle_tpu (trace_export / profiler profile_path).")
+    ap.add_argument("trace", nargs="?", help="path to trace JSON")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows in the summary table (default 20)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="validate the recorder/exporter round trip")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.trace:
+        ap.error("need a trace file (or --selftest)")
+    print(summarize(load_events(args.trace), top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

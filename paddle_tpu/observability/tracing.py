@@ -1,0 +1,203 @@
+"""Thread-safe trace recorder: named host spans into a bounded ring
+buffer, exported as chrome://tracing JSON (the role the reference's
+device_tracer.cc + tools/timeline.py played — see ISSUE 1).
+
+Design constraints:
+  - Near-zero cost when disabled: `span()` checks one module-level bool
+    and returns a shared no-op context manager; no allocation, no clock
+    read, no lock.
+  - Thread-safe when enabled: each completed span appends ONE tuple to a
+    `collections.deque(maxlen=...)` — an atomic operation under the GIL,
+    so concurrent executor / RPC handler / reader worker threads never
+    contend on a lock in the hot path. Overflow drops the OLDEST spans
+    (ring-buffer semantics) and counts the drops.
+  - Complete events ("ph": "X"): one record per finished span carrying
+    ts + dur. Chrome/Perfetto reconstruct nesting per (pid, tid) from
+    the intervals, so cross-thread nesting needs no begin/end pairing.
+
+Control surface: FLAGS["trace"] / FLAGS["trace_buffer"] (env
+PADDLE_TPU_TRACE / PADDLE_TPU_TRACE_BUFFER) seed the initial state;
+`trace_enable()` / `trace_disable()` toggle at runtime (fluid.profiler
+drives these so the legacy profiler() API records traces too).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "span", "trace_enable", "trace_disable", "trace_enabled",
+    "trace_reset", "trace_export", "trace_events", "dropped_spans",
+    "resize_buffer", "buffer_capacity",
+]
+
+# epoch for ts fields: chrome trace wants monotonically comparable
+# microseconds; perf_counter is monotonic and high-resolution
+_EPOCH = time.perf_counter()
+
+_enabled = False
+_buf: "collections.deque" = collections.deque(maxlen=65536)
+_dropped = 0
+_mu = threading.Lock()  # guards enable/reset/export, NOT the append path
+
+
+def _env_flag(name: str, default: str = "0") -> bool:
+    return os.environ.get(name, default).lower() in ("1", "true", "yes", "on")
+
+
+def _configure_from_env():
+    global _enabled, _buf
+    cap = int(os.environ.get("PADDLE_TPU_TRACE_BUFFER", "65536") or 65536)
+    _buf = collections.deque(maxlen=max(16, cap))
+    _enabled = _env_flag("PADDLE_TPU_TRACE")
+
+
+_configure_from_env()
+
+
+class _NullSpan:
+    """Shared no-op context for the disabled path: __enter__/__exit__ do
+    nothing, `set_arg` swallows; one instance serves every call site."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_arg(self, key, value):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """RAII host span. Records a complete event at __exit__ — begin time,
+    duration, thread id, and optional args — into the ring buffer."""
+
+    __slots__ = ("name", "args", "_t0")
+
+    def __init__(self, name: str, args: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.args = args
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        global _dropped
+        t1 = time.perf_counter()
+        if len(_buf) == _buf.maxlen:
+            _dropped += 1  # GIL-atomic enough for a diagnostics counter
+        _buf.append((
+            self.name,
+            (self._t0 - _EPOCH) * 1e6,      # ts, µs
+            (t1 - self._t0) * 1e6,          # dur, µs
+            threading.get_ident(),
+            self.args,
+        ))
+        return False
+
+    def set_arg(self, key, value):
+        if self.args is None:
+            self.args = {}
+        self.args[key] = value
+
+
+def span(name: str, **args):
+    """`with span("executor.step", step=3): ...` — the one tracing entry
+    point every instrumented layer uses. Disabled: one bool check, a
+    shared no-op object, and (unavoidably) the kwargs dict the caller
+    built; hot paths that can't afford even that should guard with
+    `if trace_enabled():`."""
+    if not _enabled:
+        return _NULL_SPAN
+    return Span(name, args or None)
+
+
+def trace_enabled() -> bool:
+    return _enabled
+
+
+def trace_enable(buffer_size: Optional[int] = None):
+    global _enabled
+    with _mu:
+        if buffer_size is not None:
+            _resize_locked(buffer_size)
+        _enabled = True
+
+
+def trace_disable():
+    global _enabled
+    with _mu:
+        _enabled = False
+
+
+def _resize_locked(capacity: int):
+    global _buf
+    if capacity != _buf.maxlen:
+        _buf = collections.deque(_buf, maxlen=max(16, int(capacity)))
+
+
+def resize_buffer(capacity: int):
+    """Change ring capacity, keeping buffered spans (newest win) and the
+    current enable state."""
+    with _mu:
+        _resize_locked(capacity)
+
+
+def buffer_capacity() -> int:
+    return _buf.maxlen or 0
+
+
+def trace_reset():
+    global _dropped
+    with _mu:
+        _buf.clear()
+        _dropped = 0
+
+
+def dropped_spans() -> int:
+    return _dropped
+
+
+def trace_events() -> List[Dict[str, Any]]:
+    """The buffered spans as chrome trace event dicts (oldest first)."""
+    pid = os.getpid()
+    out = []
+    for name, ts, dur, tid, args in list(_buf):
+        ev = {"name": name, "ph": "X", "ts": ts, "dur": dur,
+              "pid": pid, "tid": tid, "cat": "host"}
+        if args:
+            ev["args"] = args
+        out.append(ev)
+    return out
+
+
+def trace_export(path: str) -> str:
+    """Write the buffer as a chrome://tracing / Perfetto-loadable JSON
+    object. `path` may be a directory (the legacy profiler profile_path
+    contract allowed one); then the file is <path>/trace.json. Returns
+    the path actually written."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "trace.json")
+    doc = {
+        "traceEvents": trace_events(),
+        "displayTimeUnit": "ms",
+        "otherData": {"dropped_spans": _dropped},
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path

@@ -18,7 +18,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # newer jax spells the device-count knob as a config option; on
+    # older versions (this image ships 0.4.37) the XLA_FLAGS fallback
+    # above already did the job before backend init
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 assert len(jax.devices()) == 8, jax.devices()
 
 

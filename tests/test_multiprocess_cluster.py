@@ -21,7 +21,10 @@ _WORKER = textwrap.dedent("""
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:  # jax 0.4.x: XLA_FLAGS above already did it
+        pass
 
     sys.path.insert(0, os.environ["REPO_ROOT"])
     from paddle_tpu.distributed import init_distributed, global_mesh
@@ -57,6 +60,16 @@ _WORKER = textwrap.dedent("""
 """)
 
 
+_OLD_JAX = tuple(
+    int(x) for x in __import__("jax").__version__.split(".")[:2]) < (0, 5)
+_NEEDS_CPU_COLLECTIVES = pytest.mark.skipif(
+    _OLD_JAX,
+    reason="jax 0.4.x CPU backend: 'Multiprocess computations aren't "
+           "implemented on the CPU backend'",
+)
+
+
+@_NEEDS_CPU_COLLECTIVES
 def test_two_process_cpu_cluster(tmp_path):
     # pick a free port for the coordinator
     s = socket.socket()
@@ -99,7 +112,10 @@ _FLUID_WORKER = textwrap.dedent("""
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:  # jax 0.4.x: XLA_FLAGS above already did it
+        pass
 
     sys.path.insert(0, os.environ["REPO_ROOT"])
     import numpy as np
@@ -229,6 +245,7 @@ _FLUID_WORKER = textwrap.dedent("""
 """)
 
 
+@_NEEDS_CPU_COLLECTIVES
 def test_multihost_fluid_parallel_executor(tmp_path):
     """VERDICT r2 item 4: each process builds the SAME fluid Program and
     trains through ParallelExecutor over the global jax.distributed mesh,

@@ -1,0 +1,374 @@
+"""paddle_tpu.observability — tracing ring buffer, chrome-trace export,
+metrics registry, and the instrumentation wired through the executor,
+RPC, parameter-server, and reader layers (ISSUE 1)."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import metrics, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    """Each test starts with tracing off+empty and a zeroed registry, and
+    leaves the process the same way."""
+    tracing.trace_disable()
+    tracing.trace_reset()
+    metrics.reset_metrics()
+    yield
+    tracing.trace_disable()
+    tracing.trace_reset()
+    metrics.reset_metrics()
+
+
+# --- tracing -----------------------------------------------------------
+
+
+def test_spans_nest_correctly_across_threads():
+    tracing.trace_enable()
+    with tracing.span("parent", step=7):
+        with tracing.span("child"):
+            time.sleep(0.001)
+
+    def worker():
+        with tracing.span("worker_span"):
+            time.sleep(0.001)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    events = {e["name"]: e for e in tracing.trace_events()}
+    parent, child, worker_ev = (
+        events["parent"], events["child"], events["worker_span"])
+    # child interval nests inside parent, same thread
+    assert parent["ts"] <= child["ts"]
+    assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"]
+    assert child["tid"] == parent["tid"]
+    # the worker thread's span carries its own tid
+    assert worker_ev["tid"] != parent["tid"]
+    assert parent["args"] == {"step": 7}
+
+
+def test_chrome_trace_json_roundtrip(tmp_path):
+    tracing.trace_enable()
+    with tracing.span("a"):
+        with tracing.span("b"):
+            pass
+    path = tracing.trace_export(str(tmp_path / "trace.json"))
+    doc = json.loads(open(path).read())
+    assert isinstance(doc["traceEvents"], list) and len(doc["traceEvents"]) == 2
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["ts"], float) and ev["ts"] >= 0
+        assert isinstance(ev["dur"], float) and ev["dur"] >= 0
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+    # directory path gets <dir>/trace.json (old profile_path contract)
+    d = tmp_path / "out"
+    d.mkdir()
+    assert tracing.trace_export(str(d)) == str(d / "trace.json")
+
+
+def test_ring_buffer_drops_oldest_and_counts():
+    tracing.trace_enable(buffer_size=16)
+    for i in range(40):
+        with tracing.span(f"s{i}"):
+            pass
+    events = tracing.trace_events()
+    assert len(events) == 16
+    assert events[0]["name"] == "s24"  # oldest 24 dropped
+    assert tracing.dropped_spans() == 24
+    tracing.trace_enable(buffer_size=65536)  # restore default capacity
+
+
+def test_disabled_tracing_records_nothing_and_is_noop():
+    assert not tracing.trace_enabled()
+    s = tracing.span("never")
+    with s:
+        pass
+    # the shared null span: no allocation per call site
+    assert s is tracing.span("never_either")
+    assert tracing.trace_events() == []
+
+
+# --- metrics -----------------------------------------------------------
+
+
+def test_counter_gauge_basognostics():
+    c = metrics.counter("t.hits")
+    c.inc()
+    c.inc(4)
+    assert c.value() == 5
+    assert metrics.counter("t.hits") is c  # find-or-create caches
+    g = metrics.gauge("t.depth")
+    g.set(3.5)
+    assert metrics.snapshot(prefix="t.")["t.depth"] == 3.5
+    with pytest.raises(TypeError):
+        metrics.gauge("t.hits")  # kind mismatch is an error, not a clobber
+
+
+def test_histogram_percentiles_on_known_distribution():
+    h = metrics.histogram("t.lat")
+    for v in range(1, 101):  # 1..100, uniform
+        h.observe(float(v))
+    v = h.value()
+    assert v["count"] == 100 and v["min"] == 1.0 and v["max"] == 100.0
+    assert v["avg"] == pytest.approx(50.5)
+    assert v["p50"] == pytest.approx(50.0, abs=1.0)
+    assert v["p95"] == pytest.approx(95.0, abs=1.0)
+    assert v["p99"] == pytest.approx(99.0, abs=1.0)
+
+
+def test_histogram_reservoir_bounds_memory():
+    h = metrics.histogram("t.big", reservoir=64)
+    for v in range(10000):
+        h.observe(float(v))
+    assert h.value()["count"] == 10000
+    assert len(h._vals) == 64
+    # reservoir percentiles stay in the observed range and ordered
+    v = h.value()
+    assert 0 <= v["p50"] <= v["p95"] <= v["p99"] <= 9999
+
+
+def test_counters_work_with_tracing_disabled():
+    """The zero-cost-path contract: metrics are independent of the trace
+    recorder — counting while tracing is off neither fails nor records
+    spans."""
+    assert not tracing.trace_enabled()
+    c = metrics.counter("t.cold")
+    for _ in range(1000):
+        c.inc()
+    assert c.value() == 1000
+    assert tracing.trace_events() == []
+
+
+def test_prometheus_text_format():
+    metrics.counter("t.reqs").inc(3)
+    metrics.gauge("t.qps").set(1.5)
+    h = metrics.histogram("t.ms")
+    h.observe(10.0)
+    text = metrics.prometheus_text()
+    assert "# TYPE t_reqs counter" in text
+    assert "t_reqs 3" in text
+    assert "# TYPE t_qps gauge" in text
+    assert '# TYPE t_ms summary' in text
+    assert 't_ms{quantile="0.5"} 10.0' in text
+    assert "t_ms_count 1" in text
+
+
+# --- instrumentation through the stack ---------------------------------
+
+
+def _build_sgd_program():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.fc(input=x, size=3)
+        loss = layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_executor_run_under_profiler_exports_trace(tmp_path, capsys):
+    """The ISSUE acceptance criterion: profiler(profile_path=...) around a
+    3-step Executor.run loop exports chrome-trace JSON with executor step
+    + reader spans, and the registry reports jit compiles=1, cache
+    hits=2 for the repeated program."""
+    import paddle_tpu.fluid as fluid
+
+    main, startup, loss = _build_sgd_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        metrics.reset_metrics()
+        path = str(tmp_path / "trace.json")
+        with fluid.profiler.profiler(profile_path=path):
+            for _ in range(3):
+                exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                        fetch_list=[loss])
+    snap = metrics.snapshot()
+    assert snap["executor.jit_compiles"] == 1
+    assert snap["executor.jit_cache_hits"] == 2
+    assert snap["executor.step_ms"]["count"] == 3
+    doc = json.loads(open(path).read())
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert names.count("executor.step") == 3
+    assert "executor.reader" in names  # the reader pre-pass span
+    # profiler() leaves tracing the way it found it
+    assert not tracing.trace_enabled()
+    capsys.readouterr()  # swallow the profiler table
+
+
+def test_feed_signature_miss_counter():
+    import paddle_tpu.fluid as fluid
+
+    main, startup, loss = _build_sgd_program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        metrics.reset_metrics()
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+        exe.run(main, feed={"x": np.ones((8, 4), np.float32)},
+                fetch_list=[loss])  # new batch shape: feed-sig miss
+    snap = metrics.snapshot()
+    assert snap["executor.jit_compiles"] == 2
+    assert snap["executor.feed_sig_cache_miss"] == 1
+
+
+def test_record_event_straddling_stop_profiler_is_counted(capsys):
+    """Satellite fix: a RecordEvent that begins inside the profile but
+    ends after stop_profiler() must still land in the table (enable-state
+    captured at __enter__, not checked at __exit__)."""
+    from paddle_tpu.fluid import profiler as prof
+
+    prof.start_profiler()
+    ev = prof.RecordEvent("straddler")
+    ev.__enter__()
+    prof.stop_profiler()
+    ev.__exit__(None, None, None)
+    assert "straddler" in prof._events
+    assert prof._events["straddler"][0] == 1
+    # and start_profiler resets aggregation state like the reference
+    prof.start_profiler()
+    assert "straddler" not in prof._events
+    prof.stop_profiler()
+    capsys.readouterr()
+
+
+def test_rpc_client_server_metrics_and_error_logging(caplog):
+    import logging
+
+    from paddle_tpu.distributed.rpc import RpcClient, RpcServer
+
+    def ok(x):
+        return {"echo": x}
+
+    def boom():
+        raise ValueError("intentional")
+
+    server = RpcServer({"ok": ok, "boom": boom})
+    addr = server.serve()
+    client = RpcClient(addr)
+    try:
+        out = client.call("ok", np.arange(6, dtype=np.float32))
+        assert np.allclose(out["echo"], np.arange(6))
+        with caplog.at_level(logging.ERROR, logger="paddle_tpu.rpc"):
+            with pytest.raises(RuntimeError, match="intentional"):
+                client.call("boom")
+        # server-side log names the method and the peer (satellite)
+        assert any("boom" in r.message and "127.0.0.1" in r.message
+                   for r in caplog.records)
+    finally:
+        client.close()
+        server.shutdown()
+    snap = metrics.snapshot()
+    assert snap["rpc.client.bytes_out"] > 0
+    assert snap["rpc.client.bytes_in"] > 0
+    assert snap["rpc.server.bytes_in"] > 0
+    assert snap["rpc.server.errors"] == 1
+    assert snap["rpc.client.errors"] == 1
+    assert snap["rpc.client.ok.ms"]["count"] == 1
+    assert snap["rpc.server.boom.ms"]["count"] == 1
+
+
+def test_reader_throughput_gauge():
+    from paddle_tpu.fluid.readers import BatchReader, HostReader
+
+    class Tiny(HostReader):
+        def __init__(self):
+            self.n = 0
+
+        def read_next(self):
+            if self.n >= 40:
+                raise StopIteration
+            self.n += 1
+            return (np.zeros((3,), np.float32),)
+
+        def reset(self):
+            self.n = 0
+
+    r = BatchReader(Tiny(), batch_size=8)
+    for _ in range(5):
+        r.read_next()
+    snap = metrics.snapshot()
+    assert snap["reader.batches"] == 5
+    assert snap["reader.records"] == 40
+    assert snap["reader.records_per_sec"] > 0
+
+
+def test_set_flags_buffer_resize_keeps_session_alive():
+    """Resizing trace_buffer mid-profile must not flip the enable bit
+    (and must actually apply the new capacity)."""
+    from paddle_tpu.fluid.flags import FLAGS, set_flags
+
+    old_cap = tracing.buffer_capacity()
+    tracing.trace_enable()  # profiler-style session; FLAGS["trace"] False
+    try:
+        set_flags({"trace_buffer": 128})
+        assert tracing.trace_enabled()  # session survived
+        assert tracing.buffer_capacity() == 128
+        with tracing.span("after_resize"):
+            pass
+        assert [e["name"] for e in tracing.trace_events()] == ["after_resize"]
+    finally:
+        set_flags({"trace_buffer": old_cap, "trace": False})
+        FLAGS["trace"] = False
+
+
+def test_stop_profiler_restores_tracing_state(capsys):
+    from paddle_tpu.fluid import profiler as prof
+
+    assert not tracing.trace_enabled()
+    prof.start_profiler()
+    assert tracing.trace_enabled()
+    prof.stop_profiler()
+    assert not tracing.trace_enabled()  # recorder not left on forever
+    # ...but a pre-existing session is left running
+    tracing.trace_enable()
+    prof.start_profiler()
+    prof.stop_profiler()
+    assert tracing.trace_enabled()
+    capsys.readouterr()
+
+
+# --- timeline CLI ------------------------------------------------------
+
+
+def test_timeline_selftest_cli():
+    """The tier-1 lint step: a broken recorder/exporter fails here fast."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.observability.timeline",
+         "--selftest"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "timeline selftest ok" in proc.stdout
+
+
+def test_timeline_summary_of_exported_trace(tmp_path, capsys):
+    tracing.trace_enable()
+    for _ in range(3):
+        with tracing.span("alpha"):
+            pass
+    with tracing.span("beta"):
+        pass
+    path = tracing.trace_export(str(tmp_path / "t.json"))
+    from paddle_tpu.observability import timeline
+
+    assert timeline.main([path, "--top", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "alpha" in out and "beta" in out
+    assert "4 spans" in out

@@ -38,6 +38,13 @@ from paddle_tpu.fluid.framework import Program, program_guard
 CONFIG_DIR = ("/root/reference/python/paddle/trainer_config_helpers/"
               "tests/configs")
 
+# the reference tree is a read-only mount that not every container has;
+# without it there is nothing to exec — skip (not fail) the whole module
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(CONFIG_DIR),
+    reason="reference tree not mounted at /root/reference",
+)
+
 N, T = 4, 5  # synthetic batch / max sequence length
 
 
