@@ -45,7 +45,14 @@ def framework_metrics():
     try:
         from paddle_tpu.observability import metrics
 
-        return metrics.snapshot(skip_zero=True)
+        snap = metrics.snapshot(skip_zero=True)
+        # fault-tolerance counters ride along even at zero: an artifact
+        # from a distributed run must SHOW that no retransmit was
+        # double-applied and no trainer was evicted, not omit the lane
+        for name in ("rpc.server.dedup_hits", "pserver.evicted_trainers",
+                     "elastic.resumes"):
+            snap.setdefault(name, metrics.counter(name).value())
+        return snap
     except Exception:  # registry unavailable: report that, don't die
         return {}
 

@@ -15,8 +15,15 @@ TPU-native replacement for the reference's distributed stack (SURVEY.md
     re-resolution (role of go/master/etcd_client.go's campaign +
     go/pserver/etcd_client.go's TTL-lease registration),
   - `fluid.DistributeTranspiler` — API-parity facade mapping the pserver
-    program-rewrite world onto mesh+sharding-plan SPMD.
+    program-rewrite world onto mesh+sharding-plan SPMD,
+  - `ElasticTrainer` — checkpoint-resume task loop (kill a trainer,
+    restart it, training continues from the last intact checkpoint),
+  - `faults` — deterministic fault injection (PADDLE_TPU_FAULTS) that
+    the RPC layer and master consult; docs/FAULT_TOLERANCE.md covers
+    the spec grammar and the retry/idempotency/eviction semantics.
 """
+from . import faults  # noqa: F401
+from .elastic import ElasticTrainer  # noqa: F401
 from .election import (  # noqa: F401
     ElectedMaster,
     FileLease,

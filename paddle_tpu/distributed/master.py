@@ -25,6 +25,11 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from . import faults as _faults
+from ..observability.log import get_logger
+
+_log = get_logger("master")
+
 # v2 snapshot magic (see _snapshot_locked for the layout); files without
 # it are the legacy crc|payload format (term 0)
 _SNAP_MAGIC = b"PTSNAP2\x00"
@@ -98,8 +103,48 @@ class MasterService:
         self._next_id = 0
         self._dataset_paths: Optional[List[str]] = None
         self._cur_pass = 0
+        self._sweep_stop: Optional[threading.Event] = None
         if snapshot_path and os.path.exists(snapshot_path):
             self._recover()
+
+    # -- lease sweeper -----------------------------------------------------
+    def start_timeout_sweeper(self, interval: Optional[float] = None):
+        """Expire leases on a TIMER, not only piggybacked on other calls:
+        _check_timeouts_locked used to fire solely inside get_task/
+        all_done/new_pass, so with no client polling (every trainer dead
+        or wedged) a lapsed lease stayed pending forever. Off by default
+        for in-process use; serve() turns it on. Idempotent; stopped by
+        shutdown()/stop_timeout_sweeper()."""
+        if self._sweep_stop is not None:
+            return
+        stop = self._sweep_stop = threading.Event()
+        interval = interval if interval is not None else \
+            max(0.05, self._timeout / 3.0)
+
+        def _sweep():
+            while not stop.wait(interval):
+                try:
+                    with self._mu:
+                        self._check_timeouts_locked()
+                except MasterDeposed:
+                    # a deposed leader must stop mutating state — and
+                    # must not leave the stale stop-event wedging a
+                    # future start_timeout_sweeper after re-election
+                    if self._sweep_stop is stop:
+                        self._sweep_stop = None
+                    return
+                except Exception as e:  # never die silently mid-job
+                    _log.error("lease sweeper: %s: %s",
+                               type(e).__name__, e)
+
+        t = threading.Thread(target=_sweep, daemon=True,
+                             name="master-lease-sweeper")
+        t.start()
+
+    def stop_timeout_sweeper(self):
+        if self._sweep_stop is not None:
+            self._sweep_stop.set()
+            self._sweep_stop = None
 
     # -- dataset ----------------------------------------------------------
     def set_dataset(self, shard_paths: Sequence[str]):
@@ -284,6 +329,10 @@ class MasterService:
             f.write(blob)
 
         def _commit():
+            # chaos hook: a crash HERE (tmp written, rename not yet done)
+            # is the classic torn-checkpoint window — recovery must see
+            # the intact previous snapshot, never the tmp
+            _faults.fire("master.snapshot")
             # Monotonic-term guard: never replace a snapshot written under
             # a NEWER leadership term. FileLease.fenced holds flock across
             # this rename, closing the race completely; TcpLease.fenced is
@@ -449,12 +498,16 @@ class MasterService:
         self._server = Server((host, port), Handler)
         t = threading.Thread(target=self._server.serve_forever, daemon=True)
         t.start()
+        # a SERVED master owns lease expiry itself: remote clients may all
+        # be dead, and dead clients are exactly when expiry matters
+        self.start_timeout_sweeper()
         return self._server.server_address
 
     def shutdown(self):
         """Stop the listener AND sever established connections — a deposed
         leader must not keep serving clients that still hold open sockets
         (they would never re-resolve to the new leader: split-brain)."""
+        self.stop_timeout_sweeper()
         srv = getattr(self, "_server", None)
         if srv is not None:
             srv.shutdown()
@@ -607,13 +660,32 @@ class MasterClient:
             except GeneratorExit:
                 # consumer abandoned the pass (gen.close()): hand the
                 # lease back NOW so the task re-serves immediately instead
-                # of after lease_timeout — and without a failure mark
-                self.task_released(task.id, task.epoch)
+                # of after lease_timeout — and without a failure mark.
+                # An unreachable master amounts to the same thing: the
+                # lease expires and the task re-serves.
+                try:
+                    self.task_released(task.id, task.epoch)
+                except (ConnectionError, OSError):
+                    pass
                 raise
             except Exception:
-                self.task_failed(task.id, task.epoch)
+                try:
+                    self.task_failed(task.id, task.epoch)
+                except (ConnectionError, OSError):
+                    # can't report the failure: the lease will expire and
+                    # requeue the task anyway — surface the ORIGINAL error
+                    _log.warning("task_failed(%d) unreachable; letting "
+                                 "the lease expire", task.id)
                 raise
-            self.task_finished(task.id, task.epoch)
+            try:
+                self.task_finished(task.id, task.epoch)
+            except (ConnectionError, OSError) as e:
+                # RPC failure is NOT a trainer crash: the master (or its
+                # successor) re-serves this task when the lease lapses —
+                # at-least-once delivery, same as a death mid-task. Keep
+                # training on the next lease instead of dying here.
+                _log.warning("task_finished(%d) unreachable (%s); task "
+                             "re-serves via lease expiry", task.id, e)
 
     def close(self):
         if self._sock is not None:

@@ -89,6 +89,18 @@ class WorkerRegistry:
         return (self.slot is not None and self._lease is not None
                 and self._lease.current().get("holder") == self._token)
 
+    def ensure_registered(self, timeout: float = 30.0) -> int:
+        """Re-claim a slot if ours lapsed (a GC pause past the TTL makes
+        the heartbeat thread give the slot up — see _heartbeat). Elastic
+        loops call this each iteration so a worker that is actually
+        alive never stays invisible to the membership view. No-op when
+        the current lease is healthy."""
+        if self.is_registered():
+            return self.slot
+        if self._lease is not None:  # stale thread/lease: tear down first
+            self.deregister()
+        return self.register(timeout=timeout)
+
     # -- listing ----------------------------------------------------------
     def members(self) -> Dict[int, str]:
         """Live workers only: expired leases are invisible (the elastic

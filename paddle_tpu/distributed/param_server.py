@@ -31,9 +31,12 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..observability import metrics as _metrics, tracing as _tracing
+from ..observability.log import get_logger
 from .rpc import RpcClient, RpcServer
 
 __all__ = ["ParameterServer", "ParameterClient", "get_client"]
+
+_log = get_logger("pserver")
 
 # ISSUE 1 instrumentation: push/pull volume counters plus the sync-mode
 # barrier wait-time histogram — the number that shows straggler trainers
@@ -42,22 +45,49 @@ _m_push = _metrics.counter("pserver.push_grad")
 _m_get = _metrics.counter("pserver.get_param")
 _m_get_rows = _metrics.counter("pserver.get_rows")
 _m_barrier_ms = _metrics.histogram("pserver.barrier_wait_ms")
+# ISSUE 2: trainers whose heartbeat lease lapsed and were dropped from
+# the sync barrier — each eviction is a round that DEGRADED instead of
+# deadlocking
+_m_evicted = _metrics.counter("pserver.evicted_trainers")
 
 
 class ParameterServer:
     """Runs the optimize slice of a pserver program behind RPC."""
 
     def __init__(self, pserver_program, startup_program=None,
-                 trainers: int = 1, sync_mode: bool = False, scope=None):
+                 trainers: int = 1, sync_mode: bool = False, scope=None,
+                 heartbeat_timeout: Optional[float] = None,
+                 barrier_timeout: float = 120.0):
         """startup_program initializes a fresh scope; alternatively pass an
         already-populated `scope` (the ListenAndServ in-process form, where
-        the server shares the builder's state)."""
+        the server shares the builder's state).
+
+        `heartbeat_timeout`: failure-detection lease in seconds. When set,
+        a sync-mode trainer that has made contact (a push or a heartbeat
+        RPC) and then goes silent for longer than this is EVICTED from the
+        barrier: the round completes over the surviving trainers instead
+        of deadlocking on the dead one. None (default) keeps the classic
+        behavior — barrier waits the full `barrier_timeout`, then raises."""
         import paddle_tpu.fluid as fluid
 
         if startup_program is None and scope is None:
             raise ValueError("need startup_program or a populated scope")
         self._trainers = max(1, int(trainers))
         self._sync = bool(sync_mode)
+        self._hb_timeout = (None if heartbeat_timeout is None
+                            else float(heartbeat_timeout))
+        self._barrier_timeout = float(barrier_timeout)
+        # failure detection: trainer_id -> last-contact monotonic time
+        # (pushes piggyback a beat; ParameterClient can also run a
+        # dedicated heartbeat thread), plus the evicted set. Guarded by
+        # the big _cv lock like the rest of the sync bookkeeping.
+        self._beats: Dict[int, float] = {}
+        self._evicted: set = set()
+        # trainer_id -> lifetime eviction count, echoed in barrier
+        # replies so the EVICTED side learns its round was degraded (it
+        # otherwise sees a successful barrier and never knows its
+        # in-flight pushes were withdrawn)
+        self._evict_count: Dict[int, int] = {}
         self._scope = scope if scope is not None else fluid.Scope()
         self._exe = fluid.Executor()
         self._program = pserver_program
@@ -159,8 +189,14 @@ class ParameterServer:
             "get_rows": self.get_rows,
             "push_grad": self.push_grad,
             "barrier": self.barrier,
+            "heartbeat": self.heartbeat,
             "owned_params": self.owned_params,
             "stats": self.stats,
+        }, idempotent={
+            # reads + beats: re-execution on retransmit is harmless, and
+            # keeping their (large, for get_param) responses OUT of the
+            # dedup cache bounds its memory to small push/barrier acks
+            "get_param", "get_rows", "owned_params", "stats", "heartbeat",
         })
 
     # --- RPC methods ---------------------------------------------------
@@ -169,11 +205,28 @@ class ParameterServer:
 
     def stats(self) -> Dict[str, int]:
         """Evidence of server-side work: optimize steps applied + round +
-        rows served via full pulls vs row-granular prefetches."""
-        return {"steps": self._steps, "round": self._round,
-                "sync": self._sync, "trainers": self._trainers,
-                "full_pull_rows": self._full_pull_rows,
-                "prefetch_rows": self._prefetch_rows}
+        rows served via full pulls vs row-granular prefetches. Under the
+        _cv lock: barrier threads mutate _evicted concurrently, and
+        iterating a set mid-mutation raises."""
+        with self._cv:
+            return {"steps": self._steps, "round": self._round,
+                    "sync": self._sync, "trainers": self._trainers,
+                    "evicted": sorted(self._evicted),
+                    "full_pull_rows": self._full_pull_rows,
+                    "prefetch_rows": self._prefetch_rows}
+
+    def heartbeat(self, trainer_id: int = 0):
+        """Failure-detection beat (reference go/pserver etcd TTL-lease
+        keepalive). Refreshes the trainer's lease; deliberately does NOT
+        resurrect an evicted trainer — only a fresh push_grad (evidence
+        of forward progress) rejoins it, so a paused process whose
+        heartbeat thread wakes first can't re-wedge the barrier it was
+        evicted from. The reply tells the trainer its own standing."""
+        with self._cv:
+            tid = int(trainer_id)
+            if self._hb_timeout is not None and tid not in self._evicted:
+                self._beats[tid] = time.monotonic()
+            return {"round": self._round, "evicted": tid in self._evicted}
 
     def get_param(self, name: str):
         if name not in self._owned:
@@ -218,46 +271,123 @@ class ParameterServer:
                 self._apply(name, grad)
             return {"step": self._steps, "round": self._round}
         with self._cv:
+            tid = int(trainer_id)
+            self._note_push_locked(tid)
             # the round this grad belongs to, BEFORE any completion this
             # push might trigger — the trainer barriers on it (its whole
             # step's pushes share it: a round cannot complete without this
             # trainer's last push, so it can't advance mid-step)
             round_of_push = self._round
-            self._pending.setdefault(name, {})[int(trainer_id)] = grad
-            if len(self._pending[name]) >= self._trainers:
-                merged = _merge_grads(list(self._pending.pop(name).values()))
-                self._apply(name, merged)
-                self._applied_round.add(name)
-            # a round completes when EVERY owned param applied its merge
-            # (an empty pending map alone is not enough — params not yet
-            # pushed this round leave it empty too)
-            if self._applied_round >= set(self._owned):
-                self._applied_round.clear()
-                self._round += 1
-                self._cv.notify_all()
+            self._pending.setdefault(name, {})[tid] = grad
+            self._try_complete_locked(name)
             return {"step": self._steps, "round": round_of_push}
 
-    def barrier(self, known_round: Optional[int] = None):
+    def barrier(self, known_round: Optional[int] = None,
+                trainer_id: Optional[int] = None):
         """Sync mode: block until round `known_round` (the value push_grad
         returned for this trainer's sends) has completed (reference
         send_barrier_op: send, barrier, recv). Waiting on a round NUMBER —
         not on queue emptiness — keeps a fast trainer's next-round pushes
         from wedging a slow trainer's barrier. known_round=None just
-        reports the current round."""
+        reports the current round.
+
+        With heartbeat_timeout set, the wait loop doubles as the failure
+        detector: each wake-up evicts trainers whose lease lapsed, which
+        can complete the round over the survivors — one dead trainer
+        degrades the round instead of deadlocking it. `trainer_id` names
+        the CALLER so its own lease refreshes while it is parked here (a
+        waiting trainer is alive by definition)."""
         if not self._sync or known_round is None:
             return {"round": self._round}
         target = int(known_round) + 1
         t0 = time.perf_counter()
+        deadline = time.monotonic() + self._barrier_timeout
+        # wake often enough to evict promptly; without heartbeats one
+        # full-length wait preserves the classic single-sleep behavior
+        step = (self._barrier_timeout if self._hb_timeout is None
+                else max(0.05, self._hb_timeout / 4.0))
         with self._cv, _tracing.span("pserver.barrier", round=target):
-            done = self._cv.wait_for(
-                lambda: self._round >= target, timeout=120)
+            while self._round < target:
+                if (trainer_id is not None and self._hb_timeout is not None
+                        and int(trainer_id) not in self._evicted):
+                    self._beats[int(trainer_id)] = time.monotonic()
+                self._evict_dead_locked()
+                if self._round >= target:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    _m_barrier_ms.observe((time.perf_counter() - t0) * 1e3)
+                    raise TimeoutError(
+                        f"sync round {known_round} incomplete after "
+                        f"{self._barrier_timeout:.0f}s — a trainer died "
+                        f"mid-round (pending: {list(self._pending)})")
+                self._cv.wait(min(step, remaining))
             _m_barrier_ms.observe((time.perf_counter() - t0) * 1e3)
-            if not done:
-                raise TimeoutError(
-                    f"sync round {known_round} incomplete after 120s — a "
-                    f"trainer died mid-round (pending: {list(self._pending)})"
-                )
-            return {"round": self._round}
+            out = {"round": self._round}
+            if trainer_id is not None:
+                out["evictions"] = self._evict_count.get(int(trainer_id), 0)
+            return out
+
+    # --- failure detection (all under the _cv lock) ----------------------
+    def _live_count_locked(self) -> int:
+        return max(1, self._trainers - len(self._evicted))
+
+    def _note_push_locked(self, tid: int):
+        """A push is evidence of forward progress: refresh the lease AND
+        rejoin an evicted trainer (elastic restart — its resumed step's
+        grads count toward rounds again)."""
+        if self._hb_timeout is None:
+            return
+        self._beats[tid] = time.monotonic()
+        if tid in self._evicted:
+            self._evicted.discard(tid)
+            _log.warning("pserver: trainer %d rejoined after eviction "
+                         "(round %d)", tid, self._round)
+
+    def _evict_dead_locked(self) -> bool:
+        """Drop trainers whose heartbeat lease lapsed, withdraw their
+        partial-round pushes (they belong to a step the trainer never
+        finished), and re-check round completion at the reduced quorum.
+        Only trainers that made contact at least once are evictable —
+        the detector can't distinguish 'never started' from 'dead', and
+        startup must not race the lease."""
+        if self._hb_timeout is None:
+            return False
+        now = time.monotonic()
+        newly = [tid for tid, t in self._beats.items()
+                 if tid not in self._evicted and now - t > self._hb_timeout]
+        if not newly:
+            return False
+        for tid in newly:
+            self._evicted.add(tid)
+            self._evict_count[tid] = self._evict_count.get(tid, 0) + 1
+            _m_evicted.inc()
+            _log.warning(
+                "pserver: evicting trainer %d — no heartbeat for %.2fs "
+                "(lease %.2fs); round %d degrades to %d live trainers",
+                tid, now - self._beats[tid], self._hb_timeout, self._round,
+                self._live_count_locked())
+            for d in self._pending.values():
+                d.pop(tid, None)
+        self._try_complete_locked()
+        return True
+
+    def _try_complete_locked(self, name: Optional[str] = None):
+        """Apply every pending param whose DISTINCT live pushes reach the
+        live-trainer quorum; advance the round when every owned param has
+        applied (an empty pending map alone is not enough — params not
+        yet pushed this round leave it empty too)."""
+        live = self._live_count_locked()
+        for n in ([name] if name is not None else list(self._pending)):
+            d = self._pending.get(n)
+            if d and len(d) >= live:
+                merged = _merge_grads(list(self._pending.pop(n).values()))
+                self._apply(n, merged)
+                self._applied_round.add(n)
+        if self._applied_round >= set(self._owned):
+            self._applied_round.clear()
+            self._round += 1
+            self._cv.notify_all()
 
     # --- internals -----------------------------------------------------
     def _apply(self, name: str, grad):
@@ -322,13 +452,60 @@ class ParameterClient:
     send_op/recv_op): push grads to / pull params from the pserver that
     owns each variable."""
 
-    def __init__(self, assignment: Dict[str, str], trainer_id: int = 0):
+    def __init__(self, assignment: Dict[str, str], trainer_id: int = 0,
+                 heartbeat_interval: Optional[float] = None):
         """assignment: param name -> "host:port" endpoint
-        (DistributeTranspiler.param_assignment)."""
+        (DistributeTranspiler.param_assignment).
+
+        `heartbeat_interval`: when set, a daemon thread beats every
+        assigned pserver this often so the server's failure detector can
+        tell 'slow' from 'dead' (set it well under the server's
+        heartbeat_timeout — a third is the usual lease ratio). Without
+        it, pushes still piggyback a beat, so a trainer that dies
+        between steps is detected either way."""
         self._assignment = dict(assignment)
         self._trainer_id = int(trainer_id)
         # endpoint -> round of this step's first send, consumed by barrier()
         self._send_round: Dict[str, int] = {}
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        if heartbeat_interval:
+            self._hb_interval = float(heartbeat_interval)
+            # dedicated FAIL-FAST clients, one per endpoint: a beat must
+            # never queue behind a large push on the shared data
+            # connection, and — since the loop visits endpoints
+            # sequentially — a single dead pserver must not hold the
+            # thread through a long timeout/retry budget while HEALTHY
+            # pservers miss this trainer's beats and falsely evict it.
+            # The next interval is the retry.
+            self._hb_clients = {
+                ep: RpcClient(ep, timeout=max(1.0, 2 * self._hb_interval),
+                              retries=0)
+                for ep in set(self._assignment.values())}
+            self._hb_thread = threading.Thread(
+                target=self._beat_loop, daemon=True,
+                name=f"pserver-heartbeat-t{self._trainer_id}")
+            self._hb_thread.start()
+
+    def _beat_loop(self):
+        while not self._hb_stop.wait(self._hb_interval):
+            for c in self._hb_clients.values():
+                try:
+                    c.call("heartbeat", self._trainer_id)
+                except Exception:
+                    pass  # an unreachable pserver must not kill the beat
+
+    def stop_heartbeat(self):
+        """Stop beating (tests use this to simulate a silent death)."""
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+            for c in self._hb_clients.values():
+                c.close()
+
+    def close(self):
+        self.stop_heartbeat()
 
     def _client(self, name: str) -> RpcClient:
         ep = self._assignment.get(name)
@@ -366,7 +543,14 @@ class ParameterClient:
         done = {}
         for ep in set(self._assignment.values()):
             r = rounds.get(ep) if isinstance(rounds, dict) else rounds
-            done[ep] = get_client(ep, channel="barrier").call("barrier", r)
+            # per-trainer channel: two in-process trainers must not
+            # serialize their (long) barrier waits on one shared
+            # connection; trainer_id rides along so the server refreshes
+            # the caller's heartbeat lease while it is parked
+            done[ep] = get_client(
+                ep, channel=f"barrier.{self._trainer_id}").call(
+                    "barrier", r, self._trainer_id)
+            note_barrier_reply(ep, self._trainer_id, done[ep])
         if known_round is None:
             self._send_round = {}
         return done
@@ -384,18 +568,69 @@ class ParameterClient:
         return out
 
 
+_eviction_seen: Dict[Tuple[str, int], int] = {}
+_eviction_seen_mu = threading.Lock()
+
+
+def note_barrier_reply(endpoint: str, trainer_id: int, resp) -> bool:
+    """Client-side eviction detector, shared by ParameterClient.barrier
+    and the executor's send_barrier host op: a growing `evictions` count
+    in the barrier reply means THIS trainer was declared dead mid-round
+    and its in-flight pushes were withdrawn — a successful-looking
+    barrier that silently degraded the round. Warn loudly (the fix is a
+    heartbeat_timeout above worst-case step time), return True if a new
+    eviction was seen."""
+    if not isinstance(resp, dict) or "evictions" not in resp:
+        return False
+    key = (endpoint, int(trainer_id))
+    with _eviction_seen_mu:
+        prev = _eviction_seen.get(key, 0)
+        cur = int(resp["evictions"])
+        _eviction_seen[key] = cur
+    if cur > prev:
+        _log.warning(
+            "trainer %d was EVICTED by pserver %s %d time(s) since last "
+            "seen: a round completed without this trainer's gradients "
+            "(step time likely exceeded the server's heartbeat_timeout "
+            "— raise it above the worst-case step, or beat via "
+            "ParameterClient(heartbeat_interval=...))",
+            trainer_id, endpoint, cur - prev)
+        return True
+    return False
+
+
 _clients: Dict[Tuple[str, str], RpcClient] = {}
 _clients_mu = threading.Lock()
 
+# barrier channels wait for a whole sync round to complete server-side:
+# their socket timeout must comfortably exceed ANY configurable server
+# barrier_timeout, or a legitimately slow round reads as a dead
+# connection and the healthy trainer dies retrying (a truly dead server
+# still surfaces instantly as a connection reset, not a timeout)
+BARRIER_CLIENT_TIMEOUT = 3600.0
 
-def get_client(endpoint: str, channel: str = "data") -> RpcClient:
+
+def get_client(endpoint: str, channel: str = "data",
+               timeout: Optional[float] = None) -> RpcClient:
     """Process-wide client cache, one connection per (endpoint, channel)
     (the reference's grpc channel cache). Blocking calls (barrier) use
     their own channel so they can't starve data-plane pushes that share
-    the endpoint."""
+    the endpoint. `timeout` applies only when the channel's client is
+    first created; barrier channels default to BARRIER_CLIENT_TIMEOUT."""
     with _clients_mu:
         key = (endpoint, channel)
         c = _clients.get(key)
         if c is None:
-            c = _clients[key] = RpcClient(endpoint)
+            kw = {}
+            if channel.startswith("barrier"):
+                # long reads (a whole slow round), but: fast dial (the
+                # default connect_timeout), and a single reconnect —
+                # retrying a barrier that already waited out a long
+                # timeout is useless (that round is ancient history)
+                kw = {"timeout": (BARRIER_CLIENT_TIMEOUT
+                                  if timeout is None else timeout),
+                      "retries": 1}
+            elif timeout is not None:
+                kw = {"timeout": timeout}
+            c = _clients[key] = RpcClient(endpoint, **kw)
         return c

@@ -14,15 +14,19 @@ from __future__ import annotations
 
 import base64
 import json
+import random
 import socket
 import socketserver
 import struct
 import threading
 import time
+import uuid
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from . import faults as _faults
 from ..observability import metrics as _metrics, tracing as _tracing
 from ..observability.log import get_logger
 
@@ -32,6 +36,13 @@ _log = get_logger("rpc")
 MAX_FRAME = 16 << 20
 # raw tensor segments per message: 1 GiB total
 MAX_SEGMENT_BYTES = 1 << 30
+
+
+class FrameTooLargeError(IOError):
+    """A payload failed the SENDER-side size pre-flight (nothing hit the
+    wire). Deterministic and actionable ("shard the tensor") — the retry
+    loop must re-raise it untouched, never burn its budget re-sending
+    the same oversized payload and bury the cause in a ConnectionError."""
 
 
 class _ByteMeter(threading.local):
@@ -52,12 +63,19 @@ _meter = _ByteMeter()
 # first use (method sets are small); byte/retry/timeout counters are flat
 _m_cli_bytes_out = _metrics.counter("rpc.client.bytes_out")
 _m_cli_bytes_in = _metrics.counter("rpc.client.bytes_in")
-_m_cli_retries = _metrics.counter("rpc.client.connect_retries")
+_m_cli_conn_retries = _metrics.counter("rpc.client.connect_retries")
+# retransmissions: retry attempts after a prior attempt began writing the
+# request frame (the server MAY have received it — the dedup cache is what
+# makes resending correct). For plans that only drop RESPONSE frames this
+# equals rpc.server.dedup_hits exactly: every such drop implies delivery,
+# and every retransmit of a delivered frame is answered from the cache.
+_m_cli_retries = _metrics.counter("rpc.client.retries")
 _m_cli_timeouts = _metrics.counter("rpc.client.timeouts")
 _m_cli_errors = _metrics.counter("rpc.client.errors")
 _m_srv_bytes_out = _metrics.counter("rpc.server.bytes_out")
 _m_srv_bytes_in = _metrics.counter("rpc.server.bytes_in")
 _m_srv_errors = _metrics.counter("rpc.server.errors")
+_m_srv_dedup = _metrics.counter("rpc.server.dedup_hits")
 
 
 def to_wire(obj, segs: Optional[list] = None):
@@ -137,7 +155,7 @@ def write_frame(wfile, obj: dict, max_frame: int = MAX_FRAME):
         # fail HERE with the cause — the receiver would just drop the
         # connection, and the sender would retry the same oversized
         # payload forever behind an opaque ConnectionError
-        raise IOError(
+        raise FrameTooLargeError(
             f"frame of {len(out)} bytes exceeds the {max_frame}-byte cap "
             "(tensor too large for one RPC — shard it)"
         )
@@ -155,7 +173,7 @@ def write_msg(wfile, obj, max_frame: int = MAX_FRAME):
     wire = to_wire(obj, segs)
     total = sum(len(s) for s in segs)
     if total > MAX_SEGMENT_BYTES:
-        raise IOError(
+        raise FrameTooLargeError(
             f"message tensors total {total} bytes, exceeding the "
             f"{MAX_SEGMENT_BYTES}-byte cap (shard the tensor)"
         )
@@ -202,16 +220,101 @@ def read_msg(rfile, max_frame: int = MAX_FRAME):
     return obj, segs
 
 
-class RpcServer:
-    """Threaded JSON-RPC server over a method dispatch table."""
+class _DedupCache:
+    """Bounded (client_id, seq) -> response cache — the server half of
+    the idempotency-token protocol that makes client retransmits SAFE.
 
-    def __init__(self, methods: Dict[str, Callable]):
+    `begin(rid)` either claims the id (first delivery: the caller must
+    run the handler, then `finish` with the response) or returns the
+    existing entry (retransmit: the caller waits for the original
+    in-flight execution to finish and resends ITS response — the
+    handler must not run twice, which for push_grad is the whole
+    point). In-flight entries carry an Event so a retransmit that races
+    the original's (slow) execution blocks instead of re-executing.
+
+    Bounded: past `cap` entries, COMPLETED responses older than
+    `min_age` seconds are dropped, oldest first. A retransmit arriving
+    after eviction would re-execute, so eviction must never outrun the
+    client's retry window — which is dominated by the PER-ATTEMPT
+    socket timeout, not the backoff sleeps: a black-holed response
+    means the client parks in read for its full timeout (180 s default)
+    before retransmitting the same token. In-flight entries are never
+    evicted (a racing retransmit must find the original, not re-run
+    the handler), and completed ones are held for at least `min_age` —
+    sized past 4 default-timeout attempts — even if that temporarily
+    overshoots `cap` under a burst. A hard limit of 4x cap is the
+    memory safety valve: past it the oldest completed entries go
+    regardless of age (a retransmit landing after THAT is the
+    documented residual risk; entries are small because large reads
+    are declared idempotent and skip this cache entirely)."""
+
+    def __init__(self, cap: int = 1024, min_age: float = 900.0):
+        self._cap = cap
+        self._min_age = float(min_age)
+        self._mu = threading.Lock()
+        self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
+
+    def begin(self, rid):
+        with self._mu:
+            e = self._entries.get(rid)
+            if e is not None:
+                self._entries.move_to_end(rid)
+                return e, False
+            e = {"ev": threading.Event(), "resp": None, "t": None}
+            self._entries[rid] = e
+            n = len(self._entries)
+            if n > self._cap:
+                now = time.monotonic()
+                aged = [k for k, v in self._entries.items()
+                        if v["ev"].is_set()
+                        and now - v["t"] >= self._min_age]
+                drop = aged[:n - self._cap]
+                if n - len(drop) > 4 * self._cap:  # safety valve
+                    done = [k for k, v in self._entries.items()
+                            if v["ev"].is_set()]
+                    drop = done[:n - self._cap]
+                for k in drop:
+                    del self._entries[k]
+            return e, True
+
+    @staticmethod
+    def finish(entry, resp):
+        entry["resp"] = resp
+        entry["t"] = time.monotonic()
+        entry["ev"].set()
+
+    @staticmethod
+    def wait(entry, timeout: float = 3600.0):
+        # generous: the original may legitimately be a barrier parked for
+        # a whole slow sync round — a waiter giving up earlier than the
+        # barrier channel's client timeout would manufacture failures
+        if entry["ev"].wait(timeout):
+            return entry["resp"]
+        return {"ok": False,
+                "error": "duplicate call: original still executing"}
+
+
+class RpcServer:
+    """Threaded JSON-RPC server over a method dispatch table.
+
+    `idempotent`: method names whose re-execution is harmless (reads
+    like get_param). Their responses skip the dedup cache — retransmits
+    just re-run them — so a server streaming large tensors never pins
+    up to `dedup_cap` response arrays in the cache. Everything else
+    (push_grad!) goes through the exactly-once dedup protocol."""
+
+    def __init__(self, methods: Dict[str, Callable], dedup_cap: int = 1024,
+                 idempotent: Optional[set] = None):
         self._methods = dict(methods)
         self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._dedup = _DedupCache(dedup_cap)
+        self._idempotent = frozenset(idempotent or ())
 
     def serve(self, host: str = "127.0.0.1", port: int = 0
               ) -> Tuple[str, int]:
         methods = self._methods
+        dedup = self._dedup
+        idempotent = self._idempotent
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
@@ -238,10 +341,32 @@ class RpcServer:
                             return
                         req, segs = msg
                         method = req.get("method", "?")
+                        # idempotency token: [client_id, seq] stamped by
+                        # RpcClient; frames without one (legacy/foreign
+                        # peers) execute unconditionally as before
+                        rid = req.get("id")
+                        entry = first = None
+                        if (isinstance(rid, list) and len(rid) == 2
+                                and isinstance(rid[1], int)
+                                and method not in idempotent):
+                            entry, first = dedup.begin(
+                                (str(rid[0]), rid[1]))
+                            if not first:
+                                # retransmit: ack from the cache — the
+                                # handler already ran (or is running)
+                                _m_srv_dedup.inc()
+                                _log.info(
+                                    "dedup hit for %r id=%s from %s",
+                                    method, rid, self.client_address)
+                                self._respond(method, dedup.wait(entry))
+                                _m_srv_bytes_in.inc(_meter.read - r0)
+                                _m_srv_bytes_out.inc(_meter.written - w0)
+                                continue
                         t0 = time.perf_counter()
                         with _tracing.span("rpc.server.handle",
                                            method=method):
                             try:
+                                _faults.fire(f"handler.{method}")
                                 fn = methods.get(method)
                                 if fn is None:
                                     raise ValueError(
@@ -260,6 +385,11 @@ class RpcServer:
                                     type(e).__name__, e)
                                 resp = {"ok": False,
                                         "error": f"{type(e).__name__}: {e}"}
+                        if entry is not None:
+                            # cache BEFORE responding: a response lost on
+                            # the wire must find its answer here when the
+                            # client retransmits
+                            dedup.finish(entry, resp)
                         if method in methods:
                             # per-method only for REGISTERED methods — a
                             # hostile peer must not mint unbounded metric
@@ -267,24 +397,27 @@ class RpcServer:
                             _metrics.histogram(
                                 f"rpc.server.{method}.ms").observe(
                                     (time.perf_counter() - t0) * 1e3)
-                        try:
-                            write_msg(self.wfile, resp)
-                        except IOError as e:
-                            # oversized response (caught before any byte was
-                            # written): tell the CLIENT why instead of
-                            # dropping the connection into an opaque
-                            # "server closed mid-call"
-                            _m_srv_errors.inc()
-                            _log.error(
-                                "oversized response to %r for peer %s: %s",
-                                method, self.client_address, e)
-                            write_frame(self.wfile,
-                                        {"ok": False,
-                                         "error": f"{type(e).__name__}: {e}"})
+                        self._respond(method, resp)
                         _m_srv_bytes_in.inc(_meter.read - r0)
                         _m_srv_bytes_out.inc(_meter.written - w0)
                 except (ConnectionError, EOFError, IOError):
                     return
+
+            def _respond(self, method, resp):
+                try:
+                    write_msg(self.wfile, resp)
+                except IOError as e:
+                    # oversized response (caught before any byte was
+                    # written): tell the CLIENT why instead of
+                    # dropping the connection into an opaque
+                    # "server closed mid-call"
+                    _m_srv_errors.inc()
+                    _log.error(
+                        "oversized response to %r for peer %s: %s",
+                        method, self.client_address, e)
+                    write_frame(self.wfile,
+                                {"ok": False,
+                                 "error": f"{type(e).__name__}: {e}"})
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -307,55 +440,86 @@ class RpcServer:
 
 
 class RpcClient:
-    """Blocking client. Reconnects a broken socket before the NEXT call,
-    but never retransmits a frame that may already have been delivered —
-    push_grad is not idempotent, and a retransmitted gradient would be
-    applied twice. The timeout exceeds the server's 120s sync-barrier
-    wait so a slow round can't masquerade as a dead connection."""
+    """Blocking client with SAFE retries. Every request frame carries an
+    idempotency token ``id = [client_id, seq]``; the server's dedup
+    cache answers a retransmitted frame from the original response
+    without re-running the handler, so resending a push_grad whose
+    response was lost cannot apply the gradient twice — which is what
+    makes retrying on a dropped connection correct at all (the old
+    client reconnected but never retransmitted, so ONE lost frame
+    failed the whole step). Connection failures retry with exponential
+    backoff + jitter up to a bounded budget; application errors
+    (``ok: false`` responses) are delivered results and never retried.
+    The default timeout exceeds the server's default 120s sync-barrier
+    wait so a slow round can't masquerade as a dead connection; barrier
+    channels use param_server.BARRIER_CLIENT_TIMEOUT, which outlasts
+    any configurable barrier_timeout."""
 
-    def __init__(self, addr: Tuple[str, int], timeout: float = 180.0):
+    def __init__(self, addr: Tuple[str, int], timeout: float = 180.0,
+                 retries: int = 3, backoff: float = 0.05,
+                 connect_timeout: Optional[float] = None):
+        """`timeout` bounds each read/write; `connect_timeout` bounds the
+        DIAL only (default: min(timeout, 30s)) — a channel that
+        legitimately waits hours for a response (barrier) must still
+        discover a black-holed host in seconds, not inherit the long
+        read timeout into every SYN."""
         if isinstance(addr, str):
             host, _, port = addr.rpartition(":")
             addr = (host or "127.0.0.1", int(port))
         self._addr = tuple(addr)
         self._timeout = timeout
+        self._connect_timeout = (min(timeout, 30.0)
+                                 if connect_timeout is None
+                                 else float(connect_timeout))
+        self._retries = max(0, int(retries))
+        self._backoff = float(backoff)
         self._sock: Optional[socket.socket] = None
+        self._rfile = self._wfile = None
         self._mu = threading.Lock()
+        # token namespace: unique per client INSTANCE (uuid, not addr) —
+        # two clients to one server must never collide in its dedup cache
+        self._client_id = uuid.uuid4().hex[:16]
+        self._seq = 0
 
     def call(self, method: str, *args):
         t0 = time.perf_counter()
         with self._mu, _tracing.span("rpc.client.call", method=method):
-            if self._sock is None:
-                # connecting is side-effect-free: retry once
-                for attempt in (0, 1):
-                    try:
-                        self._sock = socket.create_connection(
-                            self._addr, timeout=self._timeout)
-                        break
-                    except OSError:
-                        if attempt:  # both attempts failed: a real error
-                            _m_cli_errors.inc()
-                            raise
-                        _m_cli_retries.inc()
-                self._rfile = self._sock.makefile("rb")
-                self._wfile = self._sock.makefile("wb")
-            r0, w0 = _meter.read, _meter.written
-            try:
-                write_msg(self._wfile, {"method": method, "args": list(args)})
-                msg = read_msg(self._rfile)
-            except (ConnectionError, OSError) as e:
-                (_m_cli_timeouts if isinstance(e, socket.timeout)
-                 else _m_cli_errors).inc()
-                self.close_locked()
-                raise
-            finally:
-                _m_cli_bytes_out.inc(_meter.written - w0)
-                _m_cli_bytes_in.inc(_meter.read - r0)
-            if msg is None:
-                _m_cli_errors.inc()
-                self.close_locked()
-                raise ConnectionError("server closed mid-call")
-            resp, segs = msg
+            self._seq += 1
+            req = {"method": method, "args": list(args),
+                   "id": [self._client_id, self._seq]}
+            sent_any = False
+            last_err: Optional[Exception] = None
+            for attempt in range(self._retries + 1):
+                if attempt:
+                    if sent_any:
+                        _m_cli_retries.inc()  # a true retransmission
+                    else:
+                        _m_cli_conn_retries.inc()
+                    # exponential backoff with jitter, capped: spreads a
+                    # thundering herd of trainers re-dialing a restarted
+                    # pserver without stretching recovery into minutes
+                    delay = min(self._backoff * (2 ** (attempt - 1)), 2.0)
+                    time.sleep(delay * (0.5 + random.random() * 0.5))
+                try:
+                    resp, segs = self._attempt(method, req)
+                    break
+                except FrameTooLargeError:
+                    # deterministic sender-side pre-flight failure:
+                    # resending the same payload can never succeed —
+                    # surface the "shard it" diagnosis directly
+                    _m_cli_errors.inc()
+                    raise
+                except (ConnectionError, OSError) as e:
+                    (_m_cli_timeouts if isinstance(e, socket.timeout)
+                     else _m_cli_errors).inc()
+                    sent_any = sent_any or getattr(e, "_after_send", False)
+                    self.close_locked()
+                    last_err = e
+            else:
+                raise ConnectionError(
+                    f"RPC {method} to {self._addr} failed after "
+                    f"{self._retries + 1} attempts: {last_err}"
+                ) from last_err
         _metrics.histogram(f"rpc.client.{method}.ms").observe(
             (time.perf_counter() - t0) * 1e3)
         if not resp.get("ok"):
@@ -363,7 +527,62 @@ class RpcClient:
             raise RuntimeError(f"RPC {method} failed: {resp.get('error')}")
         return from_wire(resp.get("result"), segs)
 
+    def _attempt(self, method: str, req: dict):
+        """One connect+send+recv try. Exceptions are tagged with
+        `_after_send` once the request frame started down the wire, so
+        the retry loop can tell a retransmission (counts toward
+        rpc.client.retries, may hit the server's dedup cache) from a
+        never-sent re-dial."""
+        if self._sock is None:
+            _faults.fire("connect")
+            self._sock = socket.create_connection(
+                self._addr, timeout=self._connect_timeout)
+            self._sock.settimeout(self._timeout)
+            self._rfile = self._sock.makefile("rb")
+            self._wfile = self._sock.makefile("wb")
+        r0, w0 = _meter.read, _meter.written
+        sent = False
+        try:
+            _faults.fire(f"call.{method}")  # delay rules sleep here
+            try:
+                _faults.fire(f"send.{method}")
+            except _faults.InjectedFault:
+                # simulate a MID-FRAME disconnect: a dangling length
+                # prefix with a truncated body, then the connection dies
+                # — the server must discard it without desyncing
+                try:
+                    self._wfile.write(struct.pack("<I", 64) + b"\x7f")
+                    self._wfile.flush()
+                except OSError:
+                    pass
+                raise
+            write_msg(self._wfile, req)
+            sent = True
+            _faults.fire(f"recv.{method}")  # response lost after delivery
+            msg = read_msg(self._rfile)
+        except (ConnectionError, OSError) as e:
+            e._after_send = sent
+            raise
+        finally:
+            _m_cli_bytes_out.inc(_meter.written - w0)
+            _m_cli_bytes_in.inc(_meter.read - r0)
+        if msg is None:
+            err = ConnectionError("server closed mid-call")
+            err._after_send = True
+            raise err
+        return msg
+
     def close_locked(self):
+        # close the makefile objects too: they hold their own references
+        # to the socket's fd, and a client that cycles through many
+        # broken connections would otherwise leak both wrappers per cycle
+        for f in (self._rfile, self._wfile):
+            if f is not None:
+                try:
+                    f.close()
+                except (OSError, ValueError):
+                    pass
+        self._rfile = self._wfile = None
         if self._sock is not None:
             try:
                 self._sock.close()

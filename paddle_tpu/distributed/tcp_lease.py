@@ -137,6 +137,13 @@ class LeaseServer:
             "acquire": self.acquire, "renew": self.renew,
             "release": self.release, "check": self.check,
             "current": self.current,
+        }, idempotent={
+            # all safe to re-run (acquire/renew/release are holder-
+            # guarded state convergence, check/current are reads) — and
+            # the single-use fail-fast clients TcpLease makes per call
+            # can never retransmit anyway, so caching their responses
+            # would only grow the dedup cache on the renew hot path
+            "acquire", "renew", "release", "check", "current",
         })
         return self._server.serve(host=host, port=port)
 
@@ -167,7 +174,11 @@ class TcpLease:
         return self._term or 0
 
     def _call(self, method, *args):
-        client = RpcClient(self.addr, timeout=self._timeout)
+        # retries=0: lease calls must FAIL FAST. A renew that can't reach
+        # the server within one timeout means "can't prove we still hold
+        # it" — step down NOW; burning a multi-attempt backoff budget
+        # here would delay deposition detection far past the TTL.
+        client = RpcClient(self.addr, timeout=self._timeout, retries=0)
         try:
             return client.call(method, *args)
         finally:
@@ -237,7 +248,9 @@ def tcp_endpoint_resolver(addr: Tuple[str, int],
     re-listing in the reference's pserver clients)."""
 
     def resolve() -> Tuple[str, int]:
-        client = RpcClient(addr, timeout=10.0)
+        # fail-fast for the same reason as TcpLease._call: the caller
+        # (MasterClient) has its own reconnect/backoff loop around this
+        client = RpcClient(addr, timeout=10.0, retries=0)
         try:
             st = client.call("current", name)
         finally:

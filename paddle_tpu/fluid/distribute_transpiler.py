@@ -250,7 +250,12 @@ class DistributeTranspiler:
         if getattr(self, "sync_mode", True) and self.trainers > 1:
             barrier = Operator(
                 block, "send_barrier", inputs={}, outputs={},
-                attrs={"endpoints": sorted(set(self.param_assignment.values()))},
+                attrs={"endpoints": sorted(set(self.param_assignment.values())),
+                       # the barrier names its CALLER so a heartbeat-enabled
+                       # pserver refreshes this trainer's lease while it is
+                       # parked waiting (a waiting trainer is alive — without
+                       # this it could be evicted mid-wait and lose its round)
+                       "trainer_id": self.trainer_id},
             )
             block.ops.append(barrier)
         prog._bump_version()
@@ -297,10 +302,13 @@ class DistributeTranspiler:
         return pruned
 
     def start_pserver(self, endpoint: str, host: str = "127.0.0.1",
-                      port: int = 0, sync_mode: Optional[bool] = None):
+                      port: int = 0, sync_mode: Optional[bool] = None,
+                      **server_kwargs):
         """Build this endpoint's pserver program pair and serve it
         (reference listen_and_serv_op.cc:78 behind trainer RPC). Returns
-        the running ParameterServer; its .address is what trainers dial."""
+        the running ParameterServer; its .address is what trainers dial.
+        Extra kwargs (heartbeat_timeout, barrier_timeout, ...) pass
+        through to the ParameterServer constructor."""
         from ..distributed.param_server import ParameterServer
 
         pp = self.get_pserver_program(endpoint)
@@ -309,6 +317,7 @@ class DistributeTranspiler:
             self.get_startup_program(endpoint, pp),
             trainers=self.trainers,
             sync_mode=self.sync_mode if sync_mode is None else sync_mode,
+            **server_kwargs,
         )
         ps.serve(host, port)
         return ps

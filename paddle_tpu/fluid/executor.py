@@ -307,15 +307,22 @@ def _run_send_ops(send_ops, values: Dict[str, Any],
     DEDICATED connection — on the shared channel a blocking barrier would
     starve other trainer threads' pushes to the same endpoint."""
     from .selected_rows import is_selected_rows
-    from ..distributed.param_server import get_client
+    from ..distributed.param_server import (get_client,
+                                            note_barrier_reply)
 
     push_round: Dict[str, int] = {}  # endpoint -> round of this step's sends
     for op in send_ops:
         attrs = op.desc.attrs
         if op.desc.type == "send_barrier":
+            tid = int(attrs.get("trainer_id", 0))
             for ep in attrs.get("endpoints", []):
-                get_client(ep, channel="barrier").call(
-                    "barrier", push_round.get(ep))
+                # trainer_id rides along so the pserver's failure detector
+                # refreshes THIS trainer's heartbeat lease while it waits —
+                # a parked trainer must never be evicted as dead, or its
+                # pending pushes would be withdrawn from the round
+                resp = get_client(ep, channel=f"barrier.{tid}").call(
+                    "barrier", push_round.get(ep), tid)
+                note_barrier_reply(ep, tid, resp)
             continue
         eps = attrs.get("endpoints", {})
         params = attrs.get("params", {})
@@ -373,8 +380,8 @@ def _run_send_ops(send_ops, values: Dict[str, Any],
                 raise RuntimeError("send op with get_vars needs a scope")
             for ep in {recv_eps[n] for n in out_names}:
                 if ep in push_round:
-                    get_client(ep, channel="barrier").call(
-                        "barrier", push_round[ep])
+                    get_client(ep, channel=f"barrier.{trainer_id}").call(
+                        "barrier", push_round[ep], trainer_id)
             for name in out_names:
                 scope.set_var(name, jnp.asarray(
                     get_client(recv_eps[name]).call("get_param", name)))

@@ -15,13 +15,20 @@ from ..observability import tracing as _tracing
 class _Flags(dict):
     """FLAGS with read-through keys: 'trace'/'trace_buffer' always report
     the live recorder (profiler() and trace_enable() toggle it without
-    going through set_flags, so a stored mirror would go stale)."""
+    going through set_flags, so a stored mirror would go stale), and
+    'faults' reports the live fault-injection plan the same way
+    (faults.install()/scoped() toggle it without going through
+    set_flags)."""
 
     def __getitem__(self, k):
         if k == "trace":
             return _tracing.trace_enabled()
         if k == "trace_buffer":
             return _tracing.buffer_capacity()
+        if k == "faults":
+            from ..distributed import faults as _faults
+
+            return _faults.active_spec()
         return dict.__getitem__(self, k)
 
 
@@ -74,6 +81,10 @@ FLAGS: Dict[str, Any] = _Flags({
     "trace": _tracing.trace_enabled(),
     # span ring-buffer capacity (oldest spans drop past it)
     "trace_buffer": _tracing.buffer_capacity(),
+    # deterministic fault-injection plan (distributed/faults.py spec
+    # string, e.g. 'seed=7;drop@recv.push_grad:1,3'); None/'' = off.
+    # Seeded from PADDLE_TPU_FAULTS; reads are live (see _Flags).
+    "faults": None,
 })
 
 
@@ -109,6 +120,13 @@ def set_flags(d: Dict[str, Any]):
                 _tracing.trace_disable()
         elif k == "trace_buffer":
             _tracing.resize_buffer(int(v))
+        elif k == "faults":
+            from ..distributed import faults as _faults
+
+            if v:
+                _faults.install(v)
+            else:
+                _faults.uninstall()
 
 
 def get_flag(name: str):

@@ -23,7 +23,7 @@ __all__ = [
     "save_vars", "save_params", "save_persistables",
     "load_vars", "load_params", "load_persistables",
     "save_inference_model", "load_inference_model", "get_inference_program",
-    "save_checkpoint", "load_checkpoint",
+    "save_checkpoint", "load_checkpoint", "latest_checkpoint_step",
     "export_compiled_model", "load_exported_model",
 ]
 
@@ -328,6 +328,18 @@ def save_checkpoint(dirname, main_program=None, step: int = 0,
         for old in ckpts[:-(max_to_keep - 1) or len(ckpts)]:
             os.remove(os.path.join(dirname, old))
     return payload_path
+
+
+def latest_checkpoint_step(dirname) -> Optional[int]:
+    """Step of the checkpoint META points to, or None when the directory
+    holds no (intact) checkpoint — the restart-time probe ElasticTrainer
+    uses to decide between resume and fresh start without risking
+    load_checkpoint's IOError on an empty dir."""
+    try:
+        with open(os.path.join(dirname, "META")) as f:
+            return int(json.load(f)["step"])
+    except (OSError, ValueError, KeyError):
+        return None
 
 
 def load_checkpoint(dirname, main_program=None, scope: Optional[Scope] = None):
