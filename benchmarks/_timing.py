@@ -57,6 +57,21 @@ def framework_metrics():
         return {}
 
 
+def compile_cost_report():
+    """The executor's per-compiled-executable XLA cost records (ISSUE 3:
+    cost_analysis flops/bytes, memory_analysis under compile_stats=
+    'full') for embedding in evidence dicts — BENCH artifacts then carry
+    what the COMPILER says a step costs, not wall clock alone. Empty
+    when the run never went through the fluid executor (raw-jax benches)
+    or compile_stats is off. Never raises."""
+    try:
+        from paddle_tpu.fluid.executor import compile_report
+
+        return compile_report()
+    except Exception:
+        return []
+
+
 def _first_leaf(tree):
     leaves = jax.tree_util.tree_leaves(tree)
     if not leaves:
@@ -128,6 +143,7 @@ def step_time_s(dispatch, n1, n2, warmup=1):
         "n1": n1, "n2": n2,
         "t1_s": round(t1, 4), "t2_s": round(t2, 4),
         "framework_metrics": framework_metrics(),
+        "compile_report": compile_cost_report(),
     }
     if t2 > t1:
         per_step = (t2 - t1) / (n2 - n1)

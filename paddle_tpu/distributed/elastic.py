@@ -26,7 +26,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, Optional
 
-from ..observability import metrics as _metrics
+from ..observability import metrics as _metrics, tracing as _tracing
 from ..observability.log import get_logger
 from .master import MasterClient
 
@@ -106,7 +106,8 @@ class ElasticTrainer:
     def _checkpoint(self):
         from ..fluid.io import save_checkpoint
 
-        with self._scoped():
+        with self._scoped(), _tracing.span("elastic.checkpoint",
+                                           step=self.step):
             save_checkpoint(self._ckpt_dir, self._program, step=self.step,
                             scope=self._scope,
                             max_to_keep=self._max_to_keep)
@@ -162,7 +163,12 @@ class ElasticTrainer:
                 continue
             idle_since = None
             try:
-                train_on_task(task)
+                # one span per leased task: the master RPCs (finish/fail)
+                # and the user's training steps nest under it, so a
+                # merged timeline shows task boundaries per trainer
+                with _tracing.span("elastic.task", task=task.id,
+                                   epoch=task.epoch):
+                    train_on_task(task)
             except Exception:
                 # the task is bad or training broke: requeue with a
                 # failure mark (failure_max drops poisoned shards), and

@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import faults as _faults
+from ..observability import tracing as _tracing
 from ..observability.log import get_logger
 
 _log = get_logger("master")
@@ -470,12 +471,24 @@ class MasterService:
                             return  # protocol violation: drop the peer
                         if req is None:
                             return
+                        # trace-context propagation (ISSUE 3): same
+                        # adopt-and-answer protocol as distributed/rpc.py
+                        # — the master's frames are plain JSON, so the
+                        # header rides as a request key
+                        wire_tr = req.pop("__trace__", None) \
+                            if isinstance(req, dict) else None
                         try:
                             method = req["method"]
                             if method not in MasterService._RPC_METHODS:
                                 raise ValueError(
                                     f"unknown RPC method {method!r}")
-                            result = getattr(service, method)(*req["args"])
+                            with _tracing.adopt(wire_tr), \
+                                    _tracing.span(f"master.{method}",
+                                                  method=method):
+                                if wire_tr:
+                                    _tracing.flow_end(wire_tr.get("f"))
+                                result = getattr(service, method)(
+                                    *req["args"])
                             resp = {"ok": True, "result": _to_wire(result)}
                         except MasterDeposed:
                             # this master lost its lease mid-call: sever the
@@ -501,12 +514,38 @@ class MasterService:
         # a SERVED master owns lease expiry itself: remote clients may all
         # be dead, and dead clients are exactly when expiry matters
         self.start_timeout_sweeper()
-        return self._server.server_address
+        addr = self._server.server_address
+        if _tracing.process_label() is None:
+            _tracing.set_process_label(f"master:{addr[1]}")
+        # live introspection (ISSUE 3): PADDLE_TPU_DEBUG_PORT attaches
+        # the process-shared debug HTTP server and registers this
+        # service's queue state under /statusz
+        from ..observability import debug_server as _dbg
+
+        self._debug_key = f"master:{addr[1]}"
+        if _dbg.maybe_serve_from_env() is not None:
+            _dbg.add_status(self._debug_key, self._debug_status)
+        return addr
+
+    def _debug_status(self):
+        """Queue-state view for /statusz (never blocks long: stats()
+        takes the service lock briefly)."""
+        return {
+            "stats": self.stats(),
+            "lease_timeout_s": self._timeout,
+            "failure_max": self._failure_max,
+            "snapshot_path": self._snapshot_path,
+            "snapshot_term": self._snapshot_term,
+            "sweeper_running": self._sweep_stop is not None,
+        }
 
     def shutdown(self):
         """Stop the listener AND sever established connections — a deposed
         leader must not keep serving clients that still hold open sockets
         (they would never re-resolve to the new leader: split-brain)."""
+        from ..observability import debug_server as _dbg
+
+        _dbg.remove_status(getattr(self, "_debug_key", None))
         self.stop_timeout_sweeper()
         srv = getattr(self, "_server", None)
         if srv is not None:
@@ -575,7 +614,15 @@ class MasterClient:
     def _call_once(self, method: str, *args):
         from .rpc import read_frame, write_frame
 
-        with self._lock:
+        with self._lock, _tracing.span(f"master.client.{method}",
+                                       method=method):
+            req = {"method": method, "args": list(args)}
+            if _tracing.trace_enabled():
+                fid = _tracing.new_flow_id()
+                wire_tr = _tracing.wire_context(fid)
+                if wire_tr is not None:
+                    req["__trace__"] = wire_tr
+                    _tracing.flow_start(fid)
             try:
                 if self._sock is None:
                     addr = self._resolver() if self._resolver else self._addr
@@ -589,8 +636,7 @@ class MasterClient:
                     self._wfile = self._sock.makefile("wb")
                 # sender-side cap must match the SERVER's read cap, or an
                 # oversized request dies as an opaque dropped connection
-                write_frame(self._wfile,
-                            {"method": method, "args": list(args)},
+                write_frame(self._wfile, req,
                             max_frame=MasterService._MAX_FRAME)
                 resp = read_frame(self._rfile)
                 if resp is None:

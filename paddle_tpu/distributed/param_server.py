@@ -419,16 +419,60 @@ class ParameterServer:
         with self._shared_mu:
             self._steps += 1
 
+    # --- introspection (ISSUE 3) ----------------------------------------
+    def _debug_status(self) -> Dict[str, Any]:
+        """The /statusz view: param table, round/step progress, and the
+        failure detector's live heartbeat ages — the page an operator
+        reads to tell a straggler from a dead trainer without attaching
+        a debugger. Under the _cv lock like stats()."""
+        now = time.monotonic()
+        params = {}
+        for p in self._owned:
+            v = self._scope.find_var(p)
+            arr = np.asarray(v) if v is not None else None
+            params[p] = ({"shape": list(arr.shape), "dtype": str(arr.dtype)}
+                         if arr is not None else None)
+        with self._cv:
+            beats = {str(tid): round(now - t, 3)
+                     for tid, t in self._beats.items()}
+            out = {
+                "sync": self._sync,
+                "trainers": self._trainers,
+                "round": self._round,
+                "steps": self._steps,
+                "heartbeat_timeout_s": self._hb_timeout,
+                "heartbeat_age_s": beats,
+                "evicted": sorted(self._evicted),
+                "pending_params": {n: sorted(d)
+                                   for n, d in self._pending.items()},
+            }
+        out["params"] = params
+        out["rpc"] = self._server.stats()  # dedup-cache occupancy
+        return out
+
     # --- lifecycle -----------------------------------------------------
     def serve(self, host: str = "127.0.0.1", port: int = 0
               ) -> Tuple[str, int]:
-        return self._server.serve(host, port)
+        addr = self._server.serve(host, port)
+        if _tracing.process_label() is None:
+            _tracing.set_process_label(f"pserver:{addr[1]}")
+        # PADDLE_TPU_DEBUG_PORT attaches the process-shared debug HTTP
+        # server; this pserver's state appears under /statusz
+        from ..observability import debug_server as _dbg
+
+        self._debug_key = f"pserver:{addr[1]}"
+        if _dbg.maybe_serve_from_env() is not None:
+            _dbg.add_status(self._debug_key, self._debug_status)
+        return addr
 
     @property
     def address(self):
         return self._server.address
 
     def shutdown(self):
+        from ..observability import debug_server as _dbg
+
+        _dbg.remove_status(getattr(self, "_debug_key", None))
         self._server.shutdown()
 
 

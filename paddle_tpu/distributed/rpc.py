@@ -253,12 +253,23 @@ class _DedupCache:
         self._min_age = float(min_age)
         self._mu = threading.Lock()
         self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._hits = 0  # lifetime retransmit answers, for stats()
+
+    def stats(self) -> Dict[str, int]:
+        """Introspection for /statusz: size, in-flight count, lifetime
+        hits. O(n) over a bounded cache, only on debug-server scrapes."""
+        with self._mu:
+            in_flight = sum(1 for e in self._entries.values()
+                            if not e["ev"].is_set())
+            return {"entries": len(self._entries), "in_flight": in_flight,
+                    "hits": self._hits, "cap": self._cap}
 
     def begin(self, rid):
         with self._mu:
             e = self._entries.get(rid)
             if e is not None:
                 self._entries.move_to_end(rid)
+                self._hits += 1
                 return e, False
             e = {"ev": threading.Event(), "resp": None, "t": None}
             self._entries[rid] = e
@@ -310,6 +321,15 @@ class RpcServer:
         self._dedup = _DedupCache(dedup_cap)
         self._idempotent = frozenset(idempotent or ())
 
+    def stats(self) -> Dict[str, Any]:
+        """Transport introspection for the debug server's /statusz:
+        registered methods, idempotent set, and dedup-cache occupancy."""
+        return {
+            "methods": sorted(self._methods),
+            "idempotent": sorted(self._idempotent),
+            "dedup": self._dedup.stats(),
+        }
+
     def serve(self, host: str = "127.0.0.1", port: int = 0
               ) -> Tuple[str, int]:
         methods = self._methods
@@ -341,6 +361,13 @@ class RpcServer:
                             return
                         req, segs = msg
                         method = req.get("method", "?")
+                        # trace context stamped by a tracing-enabled
+                        # client: adopt it so this handler's span (and
+                        # everything under it) joins the client's trace,
+                        # and answer the flow event so Perfetto draws the
+                        # client→server arrow. Popped BEFORE dispatch so
+                        # handlers never see the header as an argument.
+                        wire_tr = req.pop("__trace__", None)
                         # idempotency token: [client_id, seq] stamped by
                         # RpcClient; frames without one (legacy/foreign
                         # peers) execute unconditionally as before
@@ -358,13 +385,22 @@ class RpcServer:
                                 _log.info(
                                     "dedup hit for %r id=%s from %s",
                                     method, rid, self.client_address)
-                                self._respond(method, dedup.wait(entry))
+                                self._respond(method, dedup.wait(entry),
+                                              traced=wire_tr is not None)
                                 _m_srv_bytes_in.inc(_meter.read - r0)
                                 _m_srv_bytes_out.inc(_meter.written - w0)
                                 continue
                         t0 = time.perf_counter()
-                        with _tracing.span("rpc.server.handle",
-                                           method=method):
+                        # span named per-method ("rpc.server.push_grad")
+                        # so the merged timeline reads without arg
+                        # inspection; the metric-name hostile-peer concern
+                        # doesn't apply — spans land in a bounded ring,
+                        # not the process-wide registry
+                        with _tracing.adopt(wire_tr), \
+                                _tracing.span(f"rpc.server.{method}",
+                                              method=method):
+                            if wire_tr:
+                                _tracing.flow_end(wire_tr.get("f"))
                             try:
                                 _faults.fire(f"handler.{method}")
                                 fn = methods.get(method)
@@ -397,13 +433,24 @@ class RpcServer:
                             _metrics.histogram(
                                 f"rpc.server.{method}.ms").observe(
                                     (time.perf_counter() - t0) * 1e3)
-                        self._respond(method, resp)
+                        self._respond(method, resp,
+                                      traced=wire_tr is not None)
                         _m_srv_bytes_in.inc(_meter.read - r0)
                         _m_srv_bytes_out.inc(_meter.written - w0)
                 except (ConnectionError, EOFError, IOError):
                     return
 
-            def _respond(self, method, resp):
+            def _respond(self, method, resp, traced=False):
+                if traced:
+                    # clock handshake for `timeline merge`: the server's
+                    # wall time rides the response; the client brackets
+                    # it with its own send/recv times (NTP-style) and
+                    # feeds tracing.note_clock_offset. A COPY — the
+                    # dedup cache must keep the unstamped original
+                    resp = {**resp, "__ts_srv__": time.time() * 1e6}
+                self._respond_raw(method, resp)
+
+            def _respond_raw(self, method, resp):
                 try:
                     write_msg(self.wfile, resp)
                 except IOError as e:
@@ -483,10 +530,20 @@ class RpcClient:
 
     def call(self, method: str, *args):
         t0 = time.perf_counter()
-        with self._mu, _tracing.span("rpc.client.call", method=method):
+        with self._mu, _tracing.span(f"rpc.client.{method}",
+                                     method=method):
             self._seq += 1
             req = {"method": method, "args": list(args),
                    "id": [self._client_id, self._seq]}
+            if _tracing.trace_enabled():
+                # one flow id per LOGICAL call (retransmits share it —
+                # the server answers whichever delivery executed): the
+                # idempotency token already names the call uniquely
+                fid = f"{self._client_id}:{self._seq}"
+                wire_tr = _tracing.wire_context(fid)
+                if wire_tr is not None:
+                    req["__trace__"] = wire_tr
+                    _tracing.flow_start(fid)
             sent_any = False
             last_err: Optional[Exception] = None
             for attempt in range(self._retries + 1):
@@ -556,6 +613,7 @@ class RpcClient:
                 except OSError:
                     pass
                 raise
+            t_send = time.time()
             write_msg(self._wfile, req)
             sent = True
             _faults.fire(f"recv.{method}")  # response lost after delivery
@@ -570,7 +628,16 @@ class RpcClient:
             err = ConnectionError("server closed mid-call")
             err._after_send = True
             raise err
-        return msg
+        obj, segs = msg
+        if isinstance(obj, dict) and "__ts_srv__" in obj:
+            # NTP-style offset sample: the server stamped its wall time
+            # mid-round-trip; the midpoint of our send/recv brackets it,
+            # so (server - midpoint) estimates the clock skew `timeline
+            # merge` corrects for. Popped so callers never see it.
+            srv_us = obj.pop("__ts_srv__")
+            _tracing.note_clock_offset(
+                float(srv_us) - (t_send + time.time()) / 2.0 * 1e6)
+        return obj, segs
 
     def close_locked(self):
         # close the makefile objects too: they hold their own references

@@ -73,6 +73,17 @@ FLAGS: Dict[str, Any] = _Flags({
     # enforce semantics (shape_inference.h). CI enables this; the warn
     # default keeps a conservative emitter from bricking user programs.
     "strict_shape_inference": False,
+    # XLA cost accounting per compiled executable (ISSUE 3):
+    #   'auto'/True = after each jit-cache miss, re-lower the program
+    #                 (pure tracing, NO second XLA compile) and record
+    #                 cost_analysis() flops/bytes into gauges + the
+    #                 executor.compile_report() ring
+    #   'full'      = additionally AOT-compile for memory_analysis()
+    #                 (argument/temp/code bytes) — a REAL second XLA
+    #                 compile per executable; benches opt in, training
+    #                 loops shouldn't
+    #   False       = off (no extra lowering at all)
+    "compile_stats": "auto",
     # record host spans into paddle_tpu.observability.tracing from process
     # start (profiler()/trace_enable() also toggle at runtime). Purely a
     # host-side recorder: does NOT affect what gets traced/compiled, so

@@ -255,11 +255,11 @@ class ParallelExecutor:
                 program_version=program._version):
             if self._collect_cost:
                 if entry["compiled"] is None:
+                    from ..jax_compat import cost_analysis_dict
+
                     compiled = jfn.lower(
                         feed_arrays, state_ro, state_rw, seed).compile()
-                    ca = compiled.cost_analysis()
-                    if isinstance(ca, (list, tuple)):
-                        ca = ca[0] if ca else {}
+                    ca = cost_analysis_dict(compiled)
                     entry["compiled"] = compiled
                     entry["cost"] = {
                         "flops": float(ca.get("flops", -1.0)),

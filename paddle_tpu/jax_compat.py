@@ -17,3 +17,43 @@ except ImportError:  # jax 0.4.x
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
     return _shard_map(f, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, **{_CHECK_KW: check_vma})
+
+
+def cost_analysis_dict(stage) -> dict:
+    """Normalize `.cost_analysis()` across jax versions and stage kinds.
+
+    On this image (jax 0.4.37) `Lowered.cost_analysis()` returns a flat
+    dict (and costs only an HLO walk — no XLA compile), while
+    `Compiled.cost_analysis()` returns a ONE-ELEMENT LIST of per-device
+    dicts; newer jax returns a dict from both. Returns {} when the
+    backend offers no analysis — callers treat cost accounting as
+    best-effort evidence, never a hard dependency.
+    """
+    try:
+        ca = stage.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    return {str(k): float(v) for k, v in ca.items()
+            if isinstance(v, (int, float))}
+
+
+def memory_analysis_dict(compiled) -> dict:
+    """`Compiled.memory_analysis()` -> plain byte-count dict ({} when the
+    backend doesn't implement it). Field names follow the XLA
+    CompiledMemoryStats attributes present on this jaxlib."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    out = {}
+    for k in ("generated_code_size_in_bytes", "argument_size_in_bytes",
+              "output_size_in_bytes", "temp_size_in_bytes",
+              "alias_size_in_bytes", "host_temp_size_in_bytes"):
+        v = getattr(ma, k, None)
+        if v is not None:
+            out[k] = int(v)
+    return out

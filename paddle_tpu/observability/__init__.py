@@ -11,11 +11,23 @@ The substrate every perf PR reports against (ISSUE 1):
     hits, RPC bytes), not just wall clock.
   - `log`: the `paddle_tpu.*` logger tree (PADDLE_TPU_LOG_LEVEL).
   - `timeline`: `python -m paddle_tpu.observability.timeline trace.json`
-    prints a top-N span summary (tools/timeline.py's role);
-    `--selftest` round-trips a synthetic trace and is wired into tier-1.
+    prints a top-N span summary (tools/timeline.py's role); `merge`
+    combines per-process shards into ONE clock-aligned timeline
+    (ISSUE 3); `--selftest` round-trips both and is wired into tier-1.
+  - `debug_server`: stdlib HTTP live introspection (/metrics /healthz
+    /tracez /statusz) — PADDLE_TPU_DEBUG_PORT attaches it to any
+    serving pserver/master without code changes.
+
+Cross-process tracing (ISSUE 3): spans carry trace_id/span_id/parent,
+the RPC layers stamp a `__trace__` header into every frame, server
+handlers adopt it and answer chrome flow events, so a merged timeline
+draws client→server arrows across processes.
 
 Env flags: PADDLE_TPU_TRACE=1 enables span recording at import;
-PADDLE_TPU_TRACE_BUFFER sizes the ring buffer (default 65536 spans).
+PADDLE_TPU_TRACE_BUFFER sizes the ring buffer (default 65536 spans);
+PADDLE_TPU_TRACE_DIR=<dir> exports this process's shard to
+<dir>/trace-<pid>.json at exit; PADDLE_TPU_DEBUG_PORT starts the debug
+HTTP server when a pserver/master serves.
 `fluid.profiler.profiler(profile_path=...)` also enables tracing for its
 scope and exports on exit, so the legacy API gained the exporter for
 free.
@@ -27,6 +39,7 @@ from .metrics import (  # noqa: F401
     gauge,
     histogram,
     prometheus_text,
+    reset_all,
     reset_metrics,
     snapshot,
 )

@@ -1,0 +1,256 @@
+"""Live introspection HTTP server (ISSUE 3): inspect a running
+pserver/master/trainer WITHOUT killing it.
+
+Stdlib-only (http.server on an ephemeral port), serving:
+
+  /healthz   liveness probe — 200 "ok"
+  /metrics   the whole paddle_tpu.observability registry in Prometheus
+             exposition text (RPC latency histograms, jit compile
+             counters, tracing.dropped_spans, ...)
+  /tracez    recent spans from the trace ring buffer as JSON
+             (?n=100 bounds the tail; includes enable state + drops)
+  /statusz   process status JSON: flags, jax backend/devices, uptime,
+             plus every registered status provider (the pserver adds
+             its param table + heartbeat ages, the master its queue
+             stats, the RPC server its dedup-cache occupancy)
+
+Two ways in:
+
+  - explicit: ``DebugServer().start(port=0)`` → (host, port)
+  - env flag: ``PADDLE_TPU_DEBUG_PORT=0`` (ephemeral) or ``=8321``
+    makes ``ParameterServer.serve()`` / ``MasterService.serve()`` start
+    the PROCESS-SHARED server via ``maybe_serve_from_env()`` and attach
+    their status providers; the bound address is logged at WARNING so
+    operators find it in any log tail.
+
+Read-only by design: every endpoint is a GET with no side effects, so
+exposing it on localhost during an incident can't corrupt training
+state. It binds 127.0.0.1 by default — the introspection surface is for
+the operator on the box (or a port-forward), not the open network.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from . import metrics as _metrics, tracing as _tracing
+from .log import get_logger
+
+__all__ = ["DebugServer", "maybe_serve_from_env", "shared_server",
+           "add_status", "remove_status"]
+
+_log = get_logger("debug")
+
+_START_TIME = time.time()
+
+
+def _json_safe(v):
+    """Best-effort JSON coercion: status providers return whatever is
+    handy (numpy ints, tuples, sets); the wire format must never raise."""
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set, frozenset)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, (str, bool)) or v is None:
+        return v
+    if isinstance(v, (int, float)):
+        return v
+    try:  # numpy scalars expose item(); anything else degrades to repr
+        return v.item()
+    except AttributeError:
+        return repr(v)
+
+
+def _flags_view() -> Dict[str, Any]:
+    from ..fluid.flags import FLAGS
+
+    return {k: _json_safe(FLAGS[k]) for k in sorted(FLAGS)}
+
+
+def _jax_view() -> Dict[str, Any]:
+    try:
+        import jax
+
+        return {"backend": jax.default_backend(),
+                "device_count": jax.device_count(),
+                "devices": [str(d) for d in jax.devices()]}
+    except Exception as e:  # jax may be mid-init or absent in tools
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+class DebugServer:
+    """One HTTP introspection server; `add_status(name, fn)` registers a
+    zero-arg callable whose (JSON-safe-coerced) return value appears
+    under that name in /statusz. Provider failures are reported inline
+    per provider — one broken subsystem must not blank the whole page."""
+
+    def __init__(self):
+        self._providers: Dict[str, Callable[[], Any]] = {}
+        self._mu = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    def add_status(self, name: str, fn: Callable[[], Any]):
+        with self._mu:
+            self._providers[str(name)] = fn
+
+    def remove_status(self, name: Optional[str]):
+        if name is None:
+            return
+        with self._mu:
+            self._providers.pop(str(name), None)
+
+    # -- endpoint payloads -------------------------------------------------
+    def _statusz(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "pid": os.getpid(),
+            "argv": list(sys.argv),
+            "uptime_s": round(time.time() - _START_TIME, 3),
+            "process_label": _tracing.process_label(),
+            "flags": _flags_view(),
+            "jax": _jax_view(),
+            "tracing": {
+                "enabled": _tracing.trace_enabled(),
+                "buffer_capacity": _tracing.buffer_capacity(),
+                "dropped_spans": _tracing.dropped_spans(),
+            },
+        }
+        with self._mu:
+            providers = dict(self._providers)
+        for name, fn in sorted(providers.items()):
+            try:
+                out[name] = _json_safe(fn())
+            except Exception as e:
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    @staticmethod
+    def _tracez(n: int) -> Dict[str, Any]:
+        events = _tracing.trace_events()
+        return {
+            "enabled": _tracing.trace_enabled(),
+            "buffer_capacity": _tracing.buffer_capacity(),
+            "buffered": len(events),
+            "dropped_spans": _tracing.dropped_spans(),
+            "recent": events[-n:] if n > 0 else [],
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, host: str = "127.0.0.1", port: int = 0
+              ) -> Tuple[str, int]:
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                u = urlparse(self.path)
+                try:
+                    if u.path == "/healthz":
+                        self._send(200, "text/plain; charset=utf-8", "ok\n")
+                    elif u.path == "/metrics":
+                        self._send(200, "text/plain; version=0.0.4",
+                                   _metrics.prometheus_text())
+                    elif u.path == "/tracez":
+                        q = parse_qs(u.query)
+                        n = int(q.get("n", ["100"])[0])
+                        self._send(200, "application/json",
+                                   json.dumps(srv._tracez(n)))
+                    elif u.path == "/statusz":
+                        self._send(200, "application/json",
+                                   json.dumps(srv._statusz()))
+                    else:
+                        self._send(404, "text/plain; charset=utf-8",
+                                   "not found; try /healthz /metrics "
+                                   "/tracez /statusz\n")
+                except (BrokenPipeError, ConnectionError):
+                    pass  # scraper went away mid-response
+
+            def _send(self, code: int, ctype: str, body: str):
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, fmt, *args):  # stdlib logs to stderr
+                _log.debug("debug-server %s", fmt % args)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        t = threading.Thread(target=self._server.serve_forever,
+                             daemon=True, name="paddle-tpu-debug-http")
+        t.start()
+        return self._server.server_address
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+# --- the process-shared instance the env flag controls -------------------
+
+_shared: Optional[DebugServer] = None
+_shared_mu = threading.Lock()
+
+
+def shared_server() -> Optional[DebugServer]:
+    """The env-flag-started process-wide server (None if never started)."""
+    return _shared
+
+
+def ensure_shared(port: int = 0, host: str = "127.0.0.1") -> DebugServer:
+    """Start (once) and return the process-shared server. Subsequent
+    calls — a second pserver in the same process, the master — reuse it:
+    one port per process, many status providers."""
+    global _shared
+    with _shared_mu:
+        if _shared is None:
+            s = DebugServer()
+            addr = s.start(host, port)
+            _shared = s
+            _log.warning("debug server listening on http://%s:%d "
+                         "(/healthz /metrics /tracez /statusz)", *addr)
+        return _shared
+
+
+def maybe_serve_from_env() -> Optional[DebugServer]:
+    """PADDLE_TPU_DEBUG_PORT unset/empty → None; "0" → shared server on
+    an ephemeral port; "<port>" → that port. Called by every serve()
+    entry point so attaching introspection needs no code changes.
+
+    Never raises: a malformed port value or a bind failure (fixed port
+    already taken by another process on the host) degrades to a logged
+    error — the OPTIONAL introspection layer must not take down the
+    data-plane server that asked for it."""
+    port = os.environ.get("PADDLE_TPU_DEBUG_PORT")
+    if port is None or port.strip() == "":
+        return None
+    try:
+        return ensure_shared(int(port))
+    except (ValueError, OSError) as e:
+        _log.error("debug server disabled: PADDLE_TPU_DEBUG_PORT=%r "
+                   "unusable (%s: %s)", port, type(e).__name__, e)
+        return None
+
+
+def add_status(name: str, fn: Callable[[], Any]):
+    """Register on the shared server if it is running (no-op otherwise —
+    callers don't need to care whether the operator enabled the flag)."""
+    if _shared is not None:
+        _shared.add_status(name, fn)
+
+
+def remove_status(name: Optional[str]):
+    if _shared is not None:
+        _shared.remove_status(name)

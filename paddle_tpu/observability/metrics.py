@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional, Union
 __all__ = [
     "Counter", "Gauge", "Histogram",
     "counter", "gauge", "histogram",
-    "snapshot", "prometheus_text", "reset_metrics",
+    "snapshot", "prometheus_text", "reset_metrics", "reset_all",
 ]
 
 _registry: Dict[str, "_Metric"] = {}
@@ -259,3 +259,17 @@ def reset_metrics(prefix: str = ""):
         if prefix and not name.startswith(prefix):
             continue
         m.reset()
+
+
+def reset_all():
+    """Test-isolation helper: zero every registered metric AND the trace
+    recorder's buffer/drop counter in one call, so module-level counter
+    handles created by an earlier test (or an earlier PROCESS phase)
+    can't bleed absolute values into the next test's assertions.
+    Registrations survive — only values reset — so cached handles keep
+    feeding the same (now-zeroed) metrics. tests/conftest.py runs this
+    autouse before every test."""
+    reset_metrics()
+    from . import tracing
+
+    tracing.trace_reset()

@@ -53,7 +53,12 @@ def test_spans_nest_correctly_across_threads():
     assert child["tid"] == parent["tid"]
     # the worker thread's span carries its own tid
     assert worker_ev["tid"] != parent["tid"]
-    assert parent["args"] == {"step": 7}
+    assert parent["args"]["step"] == 7
+    # trace context rides along: same-thread child joins the parent's
+    # trace; the worker thread's root span starts its own
+    assert child["args"]["trace_id"] == parent["args"]["trace_id"]
+    assert child["args"]["parent_span_id"] == parent["args"]["span_id"]
+    assert worker_ev["args"]["trace_id"] != parent["args"]["trace_id"]
 
 
 def test_chrome_trace_json_roundtrip(tmp_path):
@@ -63,12 +68,21 @@ def test_chrome_trace_json_roundtrip(tmp_path):
             pass
     path = tracing.trace_export(str(tmp_path / "trace.json"))
     doc = json.loads(open(path).read())
-    assert isinstance(doc["traceEvents"], list) and len(doc["traceEvents"]) == 2
-    for ev in doc["traceEvents"]:
-        assert ev["ph"] == "X"
+    assert isinstance(doc["traceEvents"], list)
+    # one process_name metadata event + the two spans
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(metas) == 1 and metas[0]["name"] == "process_name"
+    assert len(spans) == 2
+    for ev in spans:
         assert isinstance(ev["ts"], float) and ev["ts"] >= 0
         assert isinstance(ev["dur"], float) and ev["dur"] >= 0
         assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+    # shard-alignment anchors for `timeline merge` (ISSUE 3)
+    other = doc["otherData"]
+    assert other["pid"] == os.getpid()
+    assert other["wall_epoch_us"] > 0
+    assert "rpc_clock_offset_us" in other
     # directory path gets <dir>/trace.json (old profile_path contract)
     d = tmp_path / "out"
     d.mkdir()
@@ -372,3 +386,294 @@ def test_timeline_summary_of_exported_trace(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "alpha" in out and "beta" in out
     assert "4 spans" in out
+
+
+# --- trace-context propagation (ISSUE 3) --------------------------------
+
+
+def test_span_trace_context_parent_child_and_roots():
+    tracing.trace_enable()
+    with tracing.span("root_a") as a:
+        with tracing.span("kid") as k:
+            assert k.trace_id == a.trace_id
+            assert k.parent_id == a.span_id
+    with tracing.span("root_b") as b:
+        pass
+    assert b.trace_id != a.trace_id  # each root starts its own trace
+    assert tracing.wire_context() is None  # no open span -> no header
+
+
+def test_wire_context_and_adopt_roundtrip():
+    tracing.trace_enable()
+    with tracing.span("client_side"):
+        wire = tracing.wire_context("flow-1")
+    assert wire["f"] == "flow-1" and "t" in wire and "s" in wire
+    with tracing.adopt(wire), tracing.span("server_side") as s:
+        assert s.trace_id == wire["t"]
+        assert s.parent_id == wire["s"]
+    # adoption is scoped: after the with, new roots are fresh traces
+    with tracing.span("later") as later:
+        assert later.trace_id != wire["t"]
+    # disabled: wire_context yields nothing, adopt is a no-op
+    tracing.trace_disable()
+    assert tracing.wire_context() is None
+    with tracing.adopt(wire):
+        pass
+
+
+def test_rpc_trace_propagation_client_server_flow():
+    """The tentpole acceptance shape, in-process: a traced RPC's client
+    span and server handler span share a trace_id, the server span's
+    parent is the client span, and a flow start/finish pair with one id
+    links them for Perfetto's arrow."""
+    from paddle_tpu.distributed.rpc import RpcClient, RpcServer
+
+    tracing.trace_enable()
+    server = RpcServer({"poke": lambda: {"ok": 1}})
+    addr = server.serve()
+    client = RpcClient(addr)
+    try:
+        client.call("poke")
+    finally:
+        client.close()
+        server.shutdown()
+    evs = tracing.trace_events()
+    cl = [e for e in evs if e["name"] == "rpc.client.poke"]
+    sv = [e for e in evs if e["name"] == "rpc.server.poke"]
+    assert len(cl) == 1 and len(sv) == 1, [e["name"] for e in evs]
+    assert cl[0]["args"]["trace_id"] == sv[0]["args"]["trace_id"]
+    assert sv[0]["args"]["parent_span_id"] == cl[0]["args"]["span_id"]
+    starts = [e for e in evs if e.get("ph") == "s"]
+    ends = [e for e in evs if e.get("ph") == "f"]
+    assert len(starts) == 1 and len(ends) == 1
+    assert starts[0]["id"] == ends[0]["id"]
+    # the clock handshake fed an offset estimate (same host: ~0)
+    assert tracing.clock_offset_us() is not None
+    # the handshake stamp never leaks into results (popped client-side)
+
+
+def test_rpc_frames_clean_when_tracing_disabled():
+    """No tracing -> no __trace__ header, no server timestamp stamp; the
+    handler sees exactly its declared arguments."""
+    from paddle_tpu.distributed.rpc import RpcClient, RpcServer
+
+    seen = {}
+
+    def echo(*args):
+        seen["args"] = args
+        return list(args)
+
+    assert not tracing.trace_enabled()
+    server = RpcServer({"echo": echo})
+    addr = server.serve()
+    client = RpcClient(addr)
+    try:
+        out = client.call("echo", 1, "two")
+    finally:
+        client.close()
+        server.shutdown()
+    assert out == [1, "two"] and seen["args"] == (1, "two")
+    assert tracing.trace_events() == []
+
+
+def test_master_rpc_trace_propagation():
+    from paddle_tpu.distributed.master import MasterClient, MasterService
+
+    tracing.trace_enable()
+    svc = MasterService(chunks_per_task=1, lease_timeout=5.0)
+    addr = svc.serve()
+    try:
+        cli = MasterClient(addr)
+        cli.set_dataset(["s1", "s2"])
+        task = cli.get_task()
+        assert task is not None
+        cli.close()
+    finally:
+        svc.shutdown()
+    evs = tracing.trace_events()
+    cl = [e for e in evs if e["name"] == "master.client.get_task"]
+    sv = [e for e in evs if e["name"] == "master.get_task"]
+    assert cl and sv
+    assert cl[0]["args"]["trace_id"] == sv[0]["args"]["trace_id"]
+    assert sv[0]["args"]["parent_span_id"] == cl[0]["args"]["span_id"]
+
+
+def test_dropped_spans_gauge_tracks_ring_overflow():
+    tracing.trace_enable(buffer_size=16)
+    for i in range(40):
+        with tracing.span(f"d{i}"):
+            pass
+    assert tracing.dropped_spans() == 24
+    assert metrics.snapshot()["tracing.dropped_spans"] == 24
+    assert "tracing_dropped_spans 24" in metrics.prometheus_text()
+    tracing.trace_enable(buffer_size=65536)
+
+
+def test_reset_all_isolation_helper():
+    metrics.counter("iso.c").inc(5)
+    tracing.trace_enable()
+    with tracing.span("iso"):
+        pass
+    metrics.reset_all()
+    assert metrics.counter("iso.c").value() == 0
+    assert tracing.trace_events() == []  # ring cleared too
+    assert tracing.dropped_spans() == 0
+    # the gauge line survives (registered, zeroed) — /metrics always
+    # shows span loss explicitly, even as 0
+    assert "tracing_dropped_spans 0" in metrics.prometheus_text()
+
+
+# --- debug server (ISSUE 3) ---------------------------------------------
+
+
+def test_debug_server_endpoints_on_ephemeral_port():
+    import urllib.request
+
+    from paddle_tpu.observability.debug_server import DebugServer
+
+    metrics.counter("dbg.hits").inc(3)
+    srv = DebugServer()
+    srv.add_status("demo", lambda: {"n": np.int64(7), "xs": (1, 2)})
+    srv.add_status("broken", lambda: 1 / 0)
+    host, port = srv.start()
+    try:
+        def get(path):
+            return urllib.request.urlopen(
+                f"http://{host}:{port}{path}", timeout=10).read().decode()
+
+        assert get("/healthz").strip() == "ok"
+        body = get("/metrics")
+        assert "dbg_hits 3" in body
+        assert "tracing_dropped_spans" in body
+        st = json.loads(get("/statusz"))
+        assert st["pid"] == os.getpid()
+        assert st["demo"] == {"n": 7, "xs": [1, 2]}  # numpy/tuple coerced
+        assert "ZeroDivisionError" in st["broken"]["error"]
+        assert "flags" in st and "matmul_precision" in st["flags"]
+        assert "jax" in st
+        tz = json.loads(get("/tracez"))
+        assert tz["enabled"] is False and tz["recent"] == []
+        # 404 names the endpoints
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+# --- timeline merge CLI (ISSUE 3) ---------------------------------------
+
+
+def test_timeline_merge_cli_roundtrip(tmp_path, capsys):
+    from paddle_tpu.observability import timeline
+
+    tracing.trace_enable()
+    with tracing.span("work.a"):
+        pass
+    shard1 = tracing.trace_export(str(tmp_path / "trace-1.json"))
+    tracing.trace_reset()
+    with tracing.span("work.b"):
+        pass
+    shard2 = tracing.trace_export(str(tmp_path / "trace-2.json"))
+    out = str(tmp_path / "merged.json")
+    assert timeline.main(["merge", "-o", out, shard1, shard2]) == 0
+    txt = capsys.readouterr().out
+    assert "merged 2 shard(s)" in txt
+    doc = json.loads(open(out).read())
+    names = [e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert "work.a" in names and "work.b" in names
+    assert all(e["ts"] >= 0 for e in doc["traceEvents"] if "ts" in e)
+    assert len(doc["otherData"]["merged_shards"]) == 2
+    # same-pid shards get distinct display pids so Perfetto keeps tracks
+    pids = {e["pid"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert len(pids) == 2
+
+
+def test_timeline_merge_missing_shard_is_an_error(tmp_path, capsys):
+    from paddle_tpu.observability import timeline
+
+    tracing.trace_enable()
+    with tracing.span("only"):
+        pass
+    shard = tracing.trace_export(str(tmp_path / "trace-1.json"))
+    rc = timeline.main(["merge", "-o", str(tmp_path / "m.json"),
+                        shard, str(tmp_path / "gone.json")])
+    assert rc == 2
+    assert "merge failed" in capsys.readouterr().err
+
+
+# --- XLA cost accounting (ISSUE 3) --------------------------------------
+
+
+def test_compile_stats_report_and_gauges():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.executor import (compile_report,
+                                           reset_compile_report)
+    from paddle_tpu.fluid.flags import set_flags
+
+    main, startup, loss = _build_sgd_program()
+    scope = fluid.Scope()
+    reset_compile_report()
+    set_flags({"compile_stats": "auto"})  # conftest turns it off suite-wide
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[loss])
+    finally:
+        set_flags({"compile_stats": False})
+    rep = compile_report()
+    assert rep, "compile_stats 'auto' records every jit-cache miss"
+    last = rep[-1]
+    assert last["flops"] and last["flops"] > 0
+    assert last["bytes_accessed"] and last["bytes_accessed"] > 0
+    assert "memory" not in last  # 'auto' never pays the second compile
+    snap = metrics.snapshot()
+    assert snap["executor.compile.flops"] == last["flops"]
+    assert snap["executor.compile.bytes_accessed"] == last["bytes_accessed"]
+
+
+def test_compile_stats_full_mode_memory_analysis():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.executor import (compile_report,
+                                           reset_compile_report)
+    from paddle_tpu.fluid.flags import set_flags
+
+    main, startup, loss = _build_sgd_program()
+    scope = fluid.Scope()
+    reset_compile_report()
+    set_flags({"compile_stats": "full"})
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            exe.run(main, feed={"x": np.ones((3, 4), np.float32)},
+                    fetch_list=[loss])
+    finally:
+        set_flags({"compile_stats": False})
+    rep = compile_report()
+    assert rep
+    mem = rep[-1]["memory"]
+    assert mem["argument_size_in_bytes"] > 0
+    assert "temp_size_in_bytes" in mem
+    assert rep[-1]["compile_ms"] >= 0
+
+
+def test_compile_stats_off_records_nothing():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.executor import (compile_report,
+                                           reset_compile_report)
+
+    main, startup, loss = _build_sgd_program()
+    scope = fluid.Scope()
+    reset_compile_report()
+    assert fluid.flags.FLAGS["compile_stats"] is False  # conftest default
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+    assert compile_report() == []

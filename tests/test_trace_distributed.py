@@ -1,0 +1,203 @@
+"""ISSUE 3 acceptance: cluster-spanning observability.
+
+A 2-trainer + pserver sync run under tracing produces per-process trace
+shards that `timeline merge` combines into ONE Perfetto timeline where
+a client `rpc.push_grad` span and its server handler span share a
+trace_id and are linked by a flow event — including across a REAL
+process boundary (a second trainer process exports its own shard via
+PADDLE_TPU_TRACE_DIR). Scraping the env-flag-attached debug server
+during the run returns Prometheus metrics with the RPC latency
+histograms and `tracing.dropped_spans`, and /statusz shows the
+pserver's param table.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.distribute_transpiler import DistributeTranspiler
+from paddle_tpu.observability import metrics, timeline, tracing
+
+
+@pytest.fixture(autouse=True)
+def _trace_session():
+    tracing.trace_disable()
+    tracing.trace_reset()
+    yield
+    tracing.trace_disable()
+    tracing.trace_reset()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# a second OS process: one RPC client doing get_param + push_grad with
+# tracing on, exporting its shard at exit via PADDLE_TPU_TRACE_DIR
+_REMOTE_TRAINER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, os.environ["REPO_ROOT"])
+    import numpy as np
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.distributed.rpc import RpcClient
+
+    tracing.set_process_label("trainer:remote")
+    host, _, port = os.environ["PSERVER_EP"].rpartition(":")
+    c = RpcClient((host, int(port)))
+    (name, *_rest) = c.call("owned_params")
+    param = np.asarray(c.call("get_param", name))
+    c.call("push_grad", name, np.zeros_like(param), 0)
+    c.close()
+    print("REMOTE_DONE", flush=True)
+""")
+
+
+def test_cluster_trace_merge_and_debug_server(tmp_path, monkeypatch):
+    from test_param_server import _linear_model
+
+    monkeypatch.setenv("PADDLE_TPU_DEBUG_PORT", "0")
+    tracing.trace_enable(buffer_size=65536)
+    # the label is process-wide first-server-wins; an earlier test's
+    # master may have claimed it — pin this process's track name
+    tracing.set_process_label("pserver:local")
+
+    port = _free_port()
+    ep = f"127.0.0.1:{port}"
+    main, startup, cost = _linear_model(seed=13)
+    t0 = DistributeTranspiler()
+    t0.transpile(trainer_id=0, program=main, startup_program=startup,
+                 pservers=ep, trainers=2, sync_mode=True)
+    ps = t0.start_pserver(ep, port=port)
+    try:
+        progs = []
+        for tid in range(2):
+            t = DistributeTranspiler()
+            t.transpile(trainer_id=tid, program=main,
+                        startup_program=startup, pservers=ep, trainers=2,
+                        sync_mode=True)
+            progs.append(t.get_trainer_program(send_recv=True))
+
+        def feed(step):
+            rng = np.random.RandomState(300 + step)
+            x = rng.rand(8, 4).astype(np.float32)
+            y = (x @ np.array([[1.0], [2.0], [-1.0], [0.5]],
+                              dtype=np.float32) + 0.3).astype(np.float32)
+            return {"x": x, "y": y}
+
+        results = {}
+
+        def trainer(tid):
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor()
+                exe.run(startup)
+                for i in range(3):
+                    exe.run(progs[tid], feed=feed(i), fetch_list=[cost])
+                results[tid] = True
+
+        threads = [threading.Thread(target=trainer, args=(i,))
+                   for i in range(2)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+        assert set(results) == {0, 1}, "a trainer thread died or hung"
+
+        # --- debug server, attached by the env flag at serve() ----------
+        from paddle_tpu.observability import debug_server
+
+        dbg = debug_server.shared_server()
+        assert dbg is not None, "PADDLE_TPU_DEBUG_PORT did not attach"
+        host, dport = dbg.address
+
+        def get(path):
+            return urllib.request.urlopen(
+                f"http://{host}:{dport}{path}", timeout=10).read().decode()
+
+        prom = get("/metrics")
+        # RPC latency histograms + span-loss gauge, per the acceptance bar
+        assert "rpc_server_push_grad_ms" in prom
+        assert "rpc_client_push_grad_ms" in prom
+        assert "tracing_dropped_spans" in prom
+        st = json.loads(get("/statusz"))
+        pserver_status = st[f"pserver:{port}"]
+        assert pserver_status["round"] == 3
+        assert pserver_status["sync"] is True
+        assert set(pserver_status["params"]) == set(t0.param_assignment)
+        assert "dedup" in pserver_status["rpc"]
+        tz = json.loads(get("/tracez"))
+        assert tz["enabled"] is True and tz["buffered"] > 0
+
+        # --- a REAL second process contributes its own shard ------------
+        env = dict(os.environ)
+        env["PSERVER_EP"] = ep
+        env["REPO_ROOT"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env["PADDLE_TPU_TRACE"] = "1"
+        env["PADDLE_TPU_TRACE_DIR"] = str(tmp_path)
+        env.pop("PADDLE_TPU_DEBUG_PORT", None)
+        proc = subprocess.run([sys.executable, "-c", _REMOTE_TRAINER],
+                              env=env, capture_output=True, text=True,
+                              timeout=120)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        assert "REMOTE_DONE" in proc.stdout
+    finally:
+        ps.shutdown()
+
+    # this (trainer+pserver) process's shard
+    local_shard = tracing.trace_export(str(tmp_path / "trace-local.json"))
+    shards = sorted(str(p) for p in tmp_path.glob("trace-*.json"))
+    assert len(shards) == 2, shards
+
+    merged_path = str(tmp_path / "merged.json")
+    assert timeline.main(["merge", "-o", merged_path] + shards) == 0
+    doc = json.loads(open(merged_path).read())
+    evs = doc["traceEvents"]
+
+    # the remote process's client push_grad span and THIS process's
+    # server handler span share a trace_id, parent-linked, with a flow
+    # event pair spanning the two pids
+    local_pid = os.getpid()
+    remote_clients = [
+        e for e in evs if e.get("ph") == "X"
+        and e["name"] == "rpc.client.push_grad" and e["pid"] != local_pid]
+    assert remote_clients, "remote shard lost its client span"
+    rc = remote_clients[0]
+    servers = [
+        e for e in evs if e.get("ph") == "X"
+        and e["name"] == "rpc.server.push_grad" and e["pid"] == local_pid
+        and e["args"]["trace_id"] == rc["args"]["trace_id"]]
+    assert servers, "server handler span did not adopt the remote trace"
+    assert servers[0]["args"]["parent_span_id"] == rc["args"]["span_id"]
+
+    flow_ids_remote = {e["id"] for e in evs if e.get("ph") == "s"
+                       and e["pid"] != local_pid}
+    flow_ids_local = {e["id"] for e in evs if e.get("ph") == "f"
+                      and e["pid"] == local_pid}
+    assert flow_ids_remote & flow_ids_local, \
+        "no flow start/finish pair crosses the process boundary"
+
+    # the in-process trainers produced their own linked pairs too
+    local_pairs = [
+        e for e in evs if e.get("ph") == "X"
+        and e["name"] == "rpc.server.push_grad" and e["pid"] == local_pid]
+    assert len(local_pairs) >= 6  # 2 trainers x 3 steps
+
+    # process metadata names both tracks
+    labels = {e["args"]["name"] for e in evs
+              if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert "trainer:remote" in labels
+    assert any(lbl.startswith("pserver:") for lbl in labels), labels

@@ -30,8 +30,10 @@ documented failure mode, not a bug).
 from __future__ import annotations
 
 import argparse
+import glob
 import os
 import random
+import shutil
 import subprocess
 import sys
 import time
@@ -63,12 +65,24 @@ def make_spec(seed: int) -> str:
     return ";".join(parts)
 
 
-def run_trial(seed: int, verbose: bool = False) -> bool:
+def run_trial(seed: int, verbose: bool = False,
+              trace_dir: str | None = None) -> bool:
     spec = make_spec(seed)
     env = dict(os.environ)
     env["PADDLE_TPU_FAULTS"] = spec
     env["PADDLE_TPU_CHAOS"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
+    trial_dir = None
+    if trace_dir:
+        # every process of the trial (pytest + any workers it spawns)
+        # records spans and exports a per-process shard at exit
+        # (tracing's PADDLE_TPU_TRACE_DIR atexit hook) — kept only on
+        # failure, merged so the repro spec arrives WITH its timeline
+        trial_dir = os.path.join(os.path.abspath(trace_dir),
+                                 f"seed{seed}")
+        os.makedirs(trial_dir, exist_ok=True)
+        env["PADDLE_TPU_TRACE"] = "1"
+        env["PADDLE_TPU_TRACE_DIR"] = trial_dir
     t0 = time.time()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", SCENARIO, "-q", "-s",
@@ -85,7 +99,36 @@ def run_trial(seed: int, verbose: bool = False) -> bool:
         print(f"SOAK_FAIL seed={seed}")
         print(f"REPRO: PADDLE_TPU_FAULTS='{spec}' PADDLE_TPU_CHAOS=1 "
               f"python -m pytest {SCENARIO}")
+        if trial_dir:
+            _dump_traces(trial_dir)
+    elif trial_dir:
+        shutil.rmtree(trial_dir, ignore_errors=True)
     return ok
+
+
+def _dump_traces(trial_dir: str):
+    """Merge the failing trial's per-process shards next to the repro
+    spec (best effort: a missing merger must not mask the SOAK_FAIL)."""
+    shards = sorted(glob.glob(os.path.join(trial_dir, "trace-*.json")))
+    if not shards:
+        print(f"TRACES: none exported under {trial_dir} "
+              "(process died before atexit?)")
+        return
+    merged = os.path.join(trial_dir, "merged_trace.json")
+    print(f"TRACES: {len(shards)} shard(s) in {trial_dir}")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.observability.timeline",
+             "merge", "-o", merged] + shards,
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+    except Exception as e:  # the shards are already the evidence — a
+        # broken/slow merger must not abort the remaining trials
+        print(f"TIMELINE: merge failed: {type(e).__name__}: {e}")
+        return
+    if proc.returncode == 0:
+        print(f"TIMELINE: {merged} (open in https://ui.perfetto.dev)")
+    else:
+        print(f"TIMELINE: merge failed: {proc.stderr.strip()[-500:]}")
 
 
 def main(argv=None) -> int:
@@ -94,12 +137,17 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=None,
                     help="base seed (default: time-derived, printed)")
     ap.add_argument("--verbose", action="store_true")
+    ap.add_argument("--trace-dir", default=None,
+                    help="dump per-process trace shards + a merged "
+                         "Perfetto timeline here for FAILING trials "
+                         "(passing trials clean up after themselves)")
     args = ap.parse_args(argv)
     base = args.seed if args.seed is not None else int(time.time()) % 100000
     print(f"chaos soak: {args.trials} trials, base seed {base}")
     failures = 0
     for i in range(args.trials):
-        if not run_trial(base + i, verbose=args.verbose):
+        if not run_trial(base + i, verbose=args.verbose,
+                         trace_dir=args.trace_dir):
             failures += 1
     print(f"chaos soak done: {args.trials - failures}/{args.trials} OK")
     return 1 if failures else 0
