@@ -1,0 +1,27 @@
+"""paddle_tpu.analysis — static analysis for the define-then-run stack.
+
+Three passes and one driver (see docs/STATIC_ANALYSIS.md for the full
+catalog and CLI usage):
+
+  - `verify` — Program/IR verifier (V0xx): runs between graph
+    construction and lowering; `FLAGS["verify_programs"]` gates the
+    executor on it, and the memory-optimization transpiler proves its
+    rewrites against it.
+  - `locks` — concurrency lint (L1xx): lock-order graph + blocking-call-
+    under-lock over the distributed runtime and observability modules.
+  - `invariants` — registry drift lint (N2xx): fault sites, metric/span
+    names, FLAGS keys.
+
+CLI: ``python -m paddle_tpu.analysis [--json] [--selftest]``.
+"""
+from .diagnostics import (  # noqa: F401
+    ERROR, WARNING, AnalysisError, Diagnostic, ProgramVerifyError,
+    errors, warnings,
+)
+from .verify import assert_valid, verify_program  # noqa: F401
+
+__all__ = [
+    "ERROR", "WARNING", "AnalysisError", "Diagnostic",
+    "ProgramVerifyError", "errors", "warnings",
+    "assert_valid", "verify_program",
+]

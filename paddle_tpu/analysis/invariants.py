@@ -1,0 +1,480 @@
+"""Invariant lint — cross-checks for stringly-typed registries that
+drift silently.
+
+Three registries in this repo are keyed by bare strings, with the
+producers and consumers in different files (and different processes):
+
+  - fault-injection sites: `faults.fire("<site>")` calls in the runtime
+    vs. the `kind@site:sel` specs tests and tools install;
+  - metric / gauge / histogram / span names: `metrics.counter("x.y")` /
+    `tracing.span("x.y")` registrations in `paddle_tpu/` vs. the names
+    tests assert on and docs document;
+  - FLAGS keys: `FLAGS["k"]` reads vs. the keys defined in
+    `fluid/flags.py`.
+
+A renamed counter or a typo'd fault site today fails nothing — the test
+silently asserts on a never-incremented metric. This pass makes the
+drift a CI failure:
+
+    N201 (error)   fault spec names a site no injection point declares
+    N202 (error)   metric/span name asserted in tests or documented in
+                   docs that no source registration declares
+    N203 (error)   FLAGS key read/written that fluid/flags.py does not
+                   define
+    N204 (warning) FLAGS key defined but never read anywhere
+
+Suppress a deliberate bad name (grammar tests, docs of removed names)
+with `# lint: allow-site` / `# lint: allow-name` on the same line
+(docs: `<!-- lint: allow-name -->` anywhere on the line).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .diagnostics import ERROR, WARNING, Diagnostic
+
+PASS_NAME = "invariants"
+
+
+def _d(code, sev, msg, where="", hint=""):
+    return Diagnostic(code=code, severity=sev, message=msg, where=where,
+                      hint=hint, pass_name=PASS_NAME)
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _py_files(*dirs: str) -> List[str]:
+    out: List[str] = []
+    for d in dirs:
+        if os.path.isfile(d) and d.endswith(".py"):
+            out.append(d)
+            continue
+        for root, _subdirs, names in os.walk(d):
+            if "__pycache__" in root:
+                continue
+            out += [os.path.join(root, n) for n in sorted(names)
+                    if n.endswith(".py")]
+    return sorted(set(out))
+
+
+def _read(path: str) -> str:
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def _parse(path: str, src: Optional[str] = None):
+    try:
+        return ast.parse(src if src is not None else _read(path),
+                         filename=path)
+    except SyntaxError:
+        return None
+
+
+def _suppressed_lines(src: str, token: str) -> Set[int]:
+    out = set()
+    for i, line in enumerate(src.splitlines(), start=1):
+        if f"lint: {token}" in line:
+            out.add(i)
+    return out
+
+
+def _joinedstr_pattern(node: ast.JoinedStr) -> str:
+    """f"handler.{method}" -> 'handler.*' (wildcard per placeholder)."""
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value)
+        else:
+            parts.append("*")
+    return "".join(parts)
+
+
+def _match(name: str, exact: Set[str], patterns: Set[str]) -> bool:
+    if name in exact:
+        return True
+    for pat in patterns:
+        if re.fullmatch(re.escape(pat).replace(r"\*", r"[^\s]+"), name):
+            return True
+    return False
+
+
+# --- fault sites -------------------------------------------------------
+
+_SPEC_RULE_RE = re.compile(
+    r"(?:refuse|drop|delay|error|crash)@([\w.\-]+):")
+
+
+def collect_declared_sites(pkg_dir: str) -> Tuple[Set[str], Set[str]]:
+    """(exact sites, wildcard patterns) from `faults.fire(...)` /
+    `_faults.fire(...)` call sites in the runtime package."""
+    exact: Set[str] = set()
+    patterns: Set[str] = set()
+    for path in _py_files(pkg_dir):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr == "fire"):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                exact.add(arg.value)
+            elif isinstance(arg, ast.JoinedStr):
+                patterns.add(_joinedstr_pattern(arg))
+    return exact, patterns
+
+
+def collect_used_sites(paths: Iterable[str]
+                       ) -> List[Tuple[str, str, int, bool]]:
+    """(site, file, line, suppressed) for every `kind@site:` occurrence
+    inside string constants of the given files/dirs."""
+    out: List[Tuple[str, str, int, bool]] = []
+    for path in _py_files(*paths):
+        src = _read(path)
+        suppressed = _suppressed_lines(src, "allow-site")
+        tree = _parse(path, src)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                for m in _SPEC_RULE_RE.finditer(node.value):
+                    out.append((m.group(1), path, node.lineno,
+                                node.lineno in suppressed))
+            elif isinstance(node, ast.JoinedStr):
+                for v in node.values:
+                    if isinstance(v, ast.Constant) and \
+                            isinstance(v.value, str):
+                        for m in _SPEC_RULE_RE.finditer(v.value):
+                            out.append((m.group(1), path, node.lineno,
+                                        node.lineno in suppressed))
+    return out
+
+
+def check_fault_sites(declared: Tuple[Set[str], Set[str]],
+                      used: List[Tuple[str, str, int, bool]]
+                      ) -> List[Diagnostic]:
+    exact, patterns = declared
+    diags: List[Diagnostic] = []
+    for site, path, line, suppressed in used:
+        if suppressed:
+            continue
+        if _match(site, exact, patterns):
+            continue
+        diags.append(_d(
+            "N201", ERROR,
+            f"fault spec targets site '{site}', but no "
+            "faults.fire() call declares it",
+            where=f"{os.path.relpath(path, _repo_root())}:{line}",
+            hint="declared sites: " + ", ".join(
+                sorted(exact | patterns)) +
+            "; annotate '# lint: allow-site' for grammar-only specs"))
+    return diags
+
+
+# --- metric / span names ----------------------------------------------
+
+_METRIC_FNS = {"counter", "gauge", "histogram"}
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_*]+)+$")
+
+
+def collect_declared_names(pkg_dir: str) -> Tuple[Set[str], Set[str]]:
+    """(exact, patterns) of metric AND span registrations in the
+    package: literal or f-string first args of metrics.counter/gauge/
+    histogram and tracing.span calls."""
+    exact: Set[str] = set()
+    patterns: Set[str] = set()
+    for path in _py_files(pkg_dir):
+        tree = _parse(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if name not in _METRIC_FNS and name != "span":
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                exact.add(arg.value)
+            elif isinstance(arg, ast.JoinedStr):
+                patterns.add(_joinedstr_pattern(arg))
+    return exact, patterns
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+
+
+class NameUniverse:
+    """Everything a dotted name in a test or doc may legitimately refer
+    to: declared metrics/spans (dotted or prometheus-sanitized), fault
+    sites, or an actual attribute of a paddle_tpu module (docs name
+    functions like `tracing.note_clock_offset` in the same backtick
+    style)."""
+
+    # first path segment -> module to getattr against
+    _MODULES = {
+        "tracing": "paddle_tpu.observability.tracing",
+        "metrics": "paddle_tpu.observability.metrics",
+        "timeline": "paddle_tpu.observability.timeline",
+        "debug_server": "paddle_tpu.observability.debug_server",
+        "faults": "paddle_tpu.distributed.faults",
+        "elastic": "paddle_tpu.distributed.elastic",
+        "master": "paddle_tpu.distributed.master",
+        "fluid": "paddle_tpu.fluid",
+        "executor": "paddle_tpu.fluid.executor",
+        "io": "paddle_tpu.fluid.io",
+    }
+
+    def __init__(self, names: Tuple[Set[str], Set[str]],
+                 sites: Tuple[Set[str], Set[str]]):
+        self.exact, self.patterns = names
+        self.site_exact, self.site_patterns = sites
+        self.sanitized = {_sanitize(n) for n in self.exact}
+        # sanitize but keep the wildcard character live
+        self.sanitized_patterns = {
+            "".join(c if (c.isalnum() or c in "_:*") else "_" for c in p)
+            for p in self.patterns}
+        # prefixes that make a dotted string "one of ours"
+        self.prefixes = {n.split(".", 1)[0] for n in self.exact} | \
+            {p.split(".", 1)[0] for p in self.patterns if "*" not in
+             p.split(".", 1)[0]}
+
+    def claims(self, name: str) -> bool:
+        """Does this dotted name LOOK like one of our registry names
+        (and therefore must resolve)?"""
+        return _NAME_RE.match(name) is not None and \
+            name.split(".", 1)[0] in self.prefixes
+
+    def resolves(self, name: str) -> bool:
+        if _match(name, self.exact, self.patterns):
+            return True
+        if _match(name, self.site_exact, self.site_patterns):
+            return True
+        if "_" in name and _match(name, self.sanitized,
+                                  self.sanitized_patterns):
+            return True
+        # module attribute (docs reference code in the same style)
+        head, _, rest = name.partition(".")
+        mod_name = self._MODULES.get(head)
+        if mod_name and rest:
+            try:
+                import importlib
+
+                obj = importlib.import_module(mod_name)
+                for part in rest.split("."):
+                    obj = getattr(obj, part)
+                return True
+            except (ImportError, AttributeError):
+                return False
+        return False
+
+
+def collect_test_name_refs(paths: Iterable[str], universe: NameUniverse
+                           ) -> List[Tuple[str, str, int, bool]]:
+    out: List[Tuple[str, str, int, bool]] = []
+    for path in _py_files(*paths):
+        src = _read(path)
+        suppressed = _suppressed_lines(src, "allow-name")
+        tree = _parse(path, src)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    universe.claims(node.value):
+                out.append((node.value, path, node.lineno,
+                            node.lineno in suppressed))
+    return out
+
+
+_BACKTICK_RE = re.compile(r"`([^`\s]+)`")
+
+
+def collect_doc_name_refs(doc_paths: Iterable[str], universe: NameUniverse
+                          ) -> List[Tuple[str, str, int, bool]]:
+    out: List[Tuple[str, str, int, bool]] = []
+    for path in doc_paths:
+        if not os.path.exists(path):
+            continue
+        for lineno, line in enumerate(_read(path).splitlines(), start=1):
+            suppressed = "lint: allow-name" in line
+            for m in _BACKTICK_RE.finditer(line):
+                token = m.group(1).strip("*`,.;:()")
+                if universe.claims(token):
+                    out.append((token, path, lineno, suppressed))
+    return out
+
+
+def check_names(universe: NameUniverse,
+                refs: List[Tuple[str, str, int, bool]]) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    seen: Set[Tuple[str, str, int]] = set()
+    for name, path, line, suppressed in refs:
+        if suppressed or universe.resolves(name):
+            continue
+        key = (name, path, line)
+        if key in seen:
+            continue
+        seen.add(key)
+        diags.append(_d(
+            "N202", ERROR,
+            f"name '{name}' is asserted/documented but no "
+            "metrics.counter/gauge/histogram or tracing.span "
+            "registration declares it",
+            where=f"{os.path.relpath(path, _repo_root())}:{line}",
+            hint="rename the reference to the registered name, or "
+                 "annotate 'lint: allow-name' if deliberate"))
+    return diags
+
+
+# --- FLAGS keys --------------------------------------------------------
+
+def _readthrough_keys(flags_path: str) -> Set[str]:
+    """Keys _Flags.__getitem__ special-cases via `k == "..."` dispatch
+    — defined (and consumed) without ever appearing in a subscript."""
+    tree = _parse(flags_path)
+    out: Set[str] = set()
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare) and \
+                isinstance(node.left, ast.Name) and node.left.id == "k":
+            for cmp in node.comparators:
+                if isinstance(cmp, ast.Constant) and \
+                        isinstance(cmp.value, str):
+                    out.add(cmp.value)
+    return out
+
+
+def collect_defined_flags(flags_path: str) -> Set[str]:
+    """Literal keys of the FLAGS dict in fluid/flags.py (including the
+    read-through keys its _Flags.__getitem__ special-cases)."""
+    tree = _parse(flags_path)
+    defined: Set[str] = set(_readthrough_keys(flags_path))
+    if tree is None:
+        return defined
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if targets and any(isinstance(t, ast.Name) and t.id == "FLAGS"
+                           for t in targets):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Dict):
+                    for k in sub.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            defined.add(k.value)
+    return defined
+
+
+def collect_flag_refs(paths: Iterable[str], skip_files: Set[str] = frozenset()
+                      ) -> List[Tuple[str, str, int, str]]:
+    """(key, file, line, kind) of FLAGS["k"] subscripts, get_flag("k")
+    calls, and set_flags({"k": ...}) literal keys."""
+    out: List[Tuple[str, str, int, str]] = []
+    for path in _py_files(*paths):
+        if os.path.abspath(path) in skip_files:
+            continue
+        tree = _parse(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Subscript):
+                base = node.value
+                base_name = base.attr if isinstance(base, ast.Attribute) \
+                    else (base.id if isinstance(base, ast.Name) else None)
+                if base_name == "FLAGS" and \
+                        isinstance(node.slice, ast.Constant) and \
+                        isinstance(node.slice.value, str):
+                    kind = "write" if isinstance(
+                        getattr(node, "ctx", None), ast.Store) else "read"
+                    out.append((node.slice.value, path, node.lineno, kind))
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None)
+                if name == "get_flag" and node.args and \
+                        isinstance(node.args[0], ast.Constant):
+                    out.append((node.args[0].value, path, node.lineno,
+                                "read"))
+                elif name == "set_flags" and node.args and \
+                        isinstance(node.args[0], ast.Dict):
+                    for k in node.args[0].keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            out.append((k.value, path, node.lineno, "write"))
+    return out
+
+
+def check_flags(defined: Set[str],
+                refs: List[Tuple[str, str, int, str]],
+                warn_unread: bool = True) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    read_keys = {k for k, _p, _l, kind in refs if kind == "read"}
+    for key, path, line, _kind in refs:
+        if key not in defined:
+            diags.append(_d(
+                "N203", ERROR,
+                f"FLAGS key '{key}' is not defined in fluid/flags.py",
+                where=f"{os.path.relpath(path, _repo_root())}:{line}",
+                hint="defined keys: " + ", ".join(sorted(defined))))
+    if warn_unread:
+        for key in sorted(defined - read_keys):
+            diags.append(_d(
+                "N204", WARNING,
+                f"FLAGS key '{key}' is defined but never read",
+                where="paddle_tpu/fluid/flags.py",
+                hint="dead configuration surface — wire it up or "
+                     "remove it"))
+    return diags
+
+
+# --- driver ------------------------------------------------------------
+
+def check_repo(root: Optional[str] = None) -> List[Diagnostic]:
+    root = root or _repo_root()
+    pkg = os.path.join(root, "paddle_tpu")
+    tests = os.path.join(root, "tests")
+    tools = os.path.join(root, "tools")
+    docs = [os.path.join(root, "docs", n)
+            for n in ("OBSERVABILITY.md", "FAULT_TOLERANCE.md",
+                      "STATIC_ANALYSIS.md")]
+    diags: List[Diagnostic] = []
+
+    sites = collect_declared_sites(pkg)
+    diags += check_fault_sites(
+        sites, collect_used_sites([tests, tools, os.path.join(pkg)]))
+
+    universe = NameUniverse(collect_declared_names(pkg), sites)
+    refs = collect_test_name_refs([tests], universe)
+    refs += collect_doc_name_refs(docs, universe)
+    diags += check_names(universe, refs)
+
+    flags_path = os.path.join(pkg, "fluid", "flags.py")
+    defined = collect_defined_flags(flags_path)
+    refs2 = collect_flag_refs(
+        [pkg, tests, tools, os.path.join(root, "benchmarks")])
+    # read-through keys ('trace'/'trace_buffer'/'faults') are consumed
+    # inside _Flags.__getitem__ via `k == "..."` dispatch, not a
+    # subscript — count them as read so N204 doesn't cry wolf
+    refs2 += [(k, flags_path, 0, "read")
+              for k in _readthrough_keys(flags_path)]
+    diags += check_flags(defined, refs2)
+    return diags

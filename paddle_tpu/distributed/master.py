@@ -614,6 +614,9 @@ class MasterClient:
     def _call_once(self, method: str, *args):
         from .rpc import read_frame, write_frame
 
+        # lint: allow-blocking — _lock serializes this client's frames on
+        # its single master connection (same design as RpcClient.call);
+        # concurrent trainers each hold their own MasterClient.
         with self._lock, _tracing.span(f"master.client.{method}",
                                        method=method):
             req = {"method": method, "args": list(args)}

@@ -530,6 +530,11 @@ class RpcClient:
 
     def call(self, method: str, *args):
         t0 = time.perf_counter()
+        # lint: allow-blocking — _mu deliberately serializes calls (and
+        # their retry sleeps) on this client's single connection: two
+        # threads interleaving frames on one socket would corrupt the
+        # stream. Blocking callers park here by design; use a separate
+        # channel (get_client(ep, channel=...)) for isolation.
         with self._mu, _tracing.span(f"rpc.client.{method}",
                                      method=method):
             self._seq += 1

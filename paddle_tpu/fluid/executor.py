@@ -843,6 +843,18 @@ class Executor:
                 _m_feed_sig_misses.inc()
             _m_jit_compiles.inc()
             self._compiled_now = True
+            if FLAGS["verify_programs"]:
+                # pre-lowering IR verification (ISSUE 4): refuse a
+                # malformed program HERE, with op-indexed diagnostics,
+                # instead of deep inside a JAX trace. Structural checks
+                # only — one O(ops) walk per compile, not per step.
+                from ..analysis.verify import assert_valid
+
+                assert_valid(
+                    program, check_shapes=False,
+                    fetch_targets=[n for n in fetch_names],
+                    header="program failed verification before lowering "
+                           "(FLAGS['verify_programs'] is on)")
             with _tracing.span("executor.lower",
                                program_version=program._version):
                 state_in, state_out = _block_io(block, set(feed_arrays),

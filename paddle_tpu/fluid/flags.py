@@ -84,6 +84,15 @@ FLAGS: Dict[str, Any] = _Flags({
     #                 loops shouldn't
     #   False       = off (no extra lowering at all)
     "compile_stats": "auto",
+    # run the static program verifier (paddle_tpu.analysis.verify) before
+    # lowering each new (program, feed signature) the executor compiles:
+    # structural checks only (use-before-def, unknown vars/ops, block
+    # nesting — not the abstract-eval shape re-check), so the cost is one
+    # O(ops) walk per jit-cache MISS, never per step. Off by default for
+    # users (the build-time inference already guards the common path);
+    # tests/conftest.py turns it on suite-wide so every program any test
+    # runs is verified.
+    "verify_programs": False,
     # record host spans into paddle_tpu.observability.tracing from process
     # start (profiler()/trace_enable() also toggle at runtime). Purely a
     # host-side recorder: does NOT affect what gets traced/compiled, so

@@ -78,13 +78,58 @@ class LayerHelper:
         if not attr.trainable and attr.initializer is None:
             attr.set_default_initializer(ConstantInitializer(0.0))
 
-        startup_block = self.startup_program.global_block()
-        sv = startup_block.create_var(
-            name=attr.name, shape=shape, dtype=dtype, persistable=True,
-        )
-        attr.initializer(sv, startup_block)
-
         main_block = self.main_program.global_block()
+        if main_block.has_var(attr.name):
+            # shared parameter (e.g. one embedding table behind several
+            # lookups): return the existing Parameter instead of
+            # re-creating it — and, crucially, instead of appending a
+            # SECOND initializer op to the startup program, where every
+            # write but the last is dead (verifier V007) and each re-init
+            # wastes a random draw
+            existing = main_block.var(attr.name)
+            if not isinstance(existing, Parameter):
+                raise ValueError(
+                    f"var '{attr.name}' already exists and is not a "
+                    "parameter — pick a different ParamAttr name")
+            if existing.shape is not None and tuple(existing.shape) != \
+                    tuple(shape):
+                raise ValueError(
+                    f"shared parameter '{attr.name}' re-declared with "
+                    f"shape {tuple(shape)} != existing "
+                    f"{tuple(existing.shape)}")
+            from .core import convert_dtype
+
+            if existing.dtype != convert_dtype(dtype):
+                raise ValueError(
+                    f"shared parameter '{attr.name}' re-declared with "
+                    f"dtype {convert_dtype(dtype)} != existing "
+                    f"{existing.dtype}")
+            return existing
+
+        startup_block = self.startup_program.global_block()
+        if startup_block.has_var(attr.name):
+            # a reused startup program (fresh main built against it):
+            # the existing initializer must actually produce THIS
+            # parameter — a silently-kept stale init would materialize a
+            # wrong-shaped/typed value at scope setup
+            from .core import convert_dtype
+
+            sv = startup_block.var(attr.name)
+            if (sv.shape is not None and tuple(sv.shape) != tuple(shape)) \
+                    or sv.dtype != convert_dtype(dtype):
+                raise ValueError(
+                    f"parameter '{attr.name}' already has an initializer "
+                    f"in the startup program with shape {sv.shape} / "
+                    f"dtype {sv.dtype}, but is re-declared as "
+                    f"{tuple(shape)} / {convert_dtype(dtype)} — use a "
+                    "fresh startup program (or a different ParamAttr "
+                    "name)")
+        else:
+            sv = startup_block.create_var(
+                name=attr.name, shape=shape, dtype=dtype, persistable=True,
+            )
+            attr.initializer(sv, startup_block)
+
         return main_block.create_parameter(
             name=attr.name, shape=shape, dtype=dtype,
             **{k: v for k, v in attr.to_kwargs().items() if k != "name"},

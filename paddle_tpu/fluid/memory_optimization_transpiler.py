@@ -81,22 +81,67 @@ def _size_key(block, name):
 
 
 def memory_optimize(input_program: Program, skip_opt_set=None,
-                    print_log: bool = False, level: int = 0) -> int:
+                    print_log: bool = False, level: int = 0,
+                    verify: bool = True) -> int:
     """In-place var-reuse rewrite of the global block; returns the number of
     merged vars. Programs with sub-block control flow keep those vars
     untouched (the reference pairs sub-blocks explicitly,
-    _process_sub_block_pair:254 — here they're conservatively skipped)."""
+    _process_sub_block_pair:254 — here they're conservatively skipped).
+
+    Gated on the static verifier (ISSUE 4): the pass logs every merge it
+    performs and, unless `verify=False`, proves against the PRE-rewrite
+    liveness that no merge aliases a still-live variable (V010) and that
+    the rewrite introduced no new structural errors. A gate refusal
+    raises AnalysisError AND rolls the in-place rewrite back, so the
+    caller keeps an intact (unoptimized) program instead of a
+    half-rewritten one — and instead of the aliasing surfacing as a
+    wrong number ten steps later."""
+    import copy
+
     block = input_program.global_block()
+    if verify:
+        from ..analysis.verify import verify_program as _verify_program
+
+        before_diags = _verify_program(input_program, check_shapes=False)
+        # snapshot what the rewrite mutates (op IO descs + the var map)
+        # so a gate refusal can hand the caller back an INTACT program
+        # instead of the half-rewritten one the error is about
+        saved_io = [(copy.deepcopy(op.desc.inputs),
+                     copy.deepcopy(op.desc.outputs)) for op in block.ops]
+        saved_vars = dict(block.vars)
     skip: Set[str] = set(skip_opt_set or ())
     for op in block.ops:
         if op.desc.type in _SUB_BLOCK_OPS:
             # anything touched by control flow stays
             skip.update(n for n in op.desc.input_names() if n)
             skip.update(n for n in op.desc.output_names() if n)
+    # feed/state leaves — names read before (or without) any def — are
+    # not storage: they are the executor's feed/scope inputs, and a temp
+    # merged into one would overwrite a fed placeholder (verifier V001)
+    first_def: Dict[str, int] = {}
+    first_read: Dict[str, int] = {}
+    for i, op in enumerate(block.ops):
+        for n in op.desc.input_names():
+            if n:
+                first_read.setdefault(n, i)
+        for n in op.desc.output_names():
+            if n:
+                first_def.setdefault(n, i)
+    skip.update(n for n, r in first_read.items()
+                if first_def.get(n, len(block.ops)) > r)
     cfg = ControlFlowGraph(block)
 
     pool: List[str] = []  # dead var names available for reuse
     rename: Dict[str, str] = {}
+    events: List[tuple] = []  # (op index, merged var, reused storage)
+    # storage last-use tracking, the same interval math the verifier's
+    # check_reuse_events proves against: a candidate whose name is
+    # re-DEFINED later (disjoint live ranges — e.g. an in-place update
+    # chain reusing one name) must not serve as storage while that later
+    # range is still ahead, and every merge extends the storage's range
+    # by the merged var's
+    last_use = cfg.last_use_index()
+    storage_last: Dict[str, int] = {}
     merged = 0
     for i, od in enumerate(cfg.ops):
         if od.type in _SKIP_OPS:
@@ -111,10 +156,16 @@ def memory_optimize(input_program: Program, skip_opt_set=None,
             key = _size_key(block, out)
             for cand in pool:
                 if _size_key(block, cand) == key and cand != out:
+                    end = storage_last.get(cand, last_use.get(cand, -1))
+                    if end >= i:
+                        continue  # storage live again later: unsafe
                     rename[out] = cand
                     od.rename_outputs({out: cand})
                     block.vars.pop(out, None)
                     pool.remove(cand)
+                    events.append((i, out, cand))
+                    storage_last[cand] = max(
+                        end, storage_last.get(out, last_use.get(out, -1)))
                     merged += 1
                     if print_log:
                         print(f"[memory_optimize] {out} -> {cand}")
@@ -126,6 +177,21 @@ def memory_optimize(input_program: Program, skip_opt_set=None,
             if _reusable(block, n, skip) and n not in pool:
                 pool.append(n)
     input_program._bump_version()
+    if verify:
+        from ..analysis.verify import verify_rewrite
+
+        try:
+            verify_rewrite(input_program, before_diags, cfg, events,
+                           what="memory_optimize")
+        except Exception:
+            # roll the in-place rewrite back: the caller keeps a usable
+            # (unoptimized) program alongside the raised diagnostics
+            for op, (ins, outs) in zip(block.ops, saved_io):
+                op.desc.inputs = ins
+                op.desc.outputs = outs
+            block.vars = saved_vars
+            input_program._bump_version()
+            raise
     return merged
 
 

@@ -40,7 +40,12 @@ import pytest
 # The tests that assert cost accounting enable it explicitly.
 from paddle_tpu.fluid.flags import set_flags
 
-set_flags({"strict_shape_inference": True, "compile_stats": False})
+# verify_programs runs the static IR verifier (paddle_tpu.analysis) on
+# every program the executor compiles — structural checks per jit-cache
+# miss, so malformed graphs fail with op-indexed diagnostics instead of
+# deep JAX trace errors. On suite-wide here (off by default for users).
+set_flags({"strict_shape_inference": True, "compile_stats": False,
+           "verify_programs": True})
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,440 @@
+"""Static-analysis subsystem (ISSUE 4): program verifier, concurrency
+lint, invariant lint, CLI driver, and the executor/transpiler gates.
+
+Three layers of coverage:
+  - every diagnostic code fires on its synthetic bad input (the same
+    case registry `python -m paddle_tpu.analysis --selftest` runs);
+  - the real repo and the real book-example Programs are CLEAN at error
+    level — the moment a fault site, metric name, FLAGS key, lock
+    ordering, or book-program invariant regresses, this file fails;
+  - the gates gate: the executor refuses a malformed program with
+    op-indexed diagnostics, and memory_optimize proves its rewrites.
+"""
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.analysis import (
+    AnalysisError, Diagnostic, errors, verify_program,
+)
+from paddle_tpu.analysis import examples, invariants, locks, selftest
+from paddle_tpu.analysis.verify import check_reuse_events
+from paddle_tpu.fluid import layers
+from paddle_tpu.fluid.framework import Program, program_guard
+
+
+# --- every code fires on its synthetic bad input ------------------------
+
+@pytest.mark.parametrize("code", sorted(selftest.CASES))
+def test_diagnostic_code_fires(code):
+    diags = selftest.CASES[code]()
+    assert any(d.code == code for d in diags), \
+        f"{code} did not fire on its synthetic bad input: " \
+        f"{[d.format() for d in diags]}"
+    for d in diags:
+        assert isinstance(d, Diagnostic)
+        assert d.severity in ("error", "warning")
+        assert d.format()  # renders
+
+
+def test_selftest_runner_all_green():
+    results = selftest.run_selftest()
+    assert len(results) >= 10  # acceptance: >= 10 distinct codes
+    bad = [code for code, fired, _ in results if not fired]
+    assert not bad, f"selftest codes did not fire: {bad}"
+
+
+# --- verifier over real programs ---------------------------------------
+
+@pytest.mark.parametrize("name", sorted(examples.BOOK_EXAMPLES))
+def test_book_examples_verify_clean(name):
+    main, startup = examples.BOOK_EXAMPLES[name]()
+    for prog in (main, startup):
+        errs = errors(verify_program(prog, check_shapes=True))
+        assert not errs, [d.format() for d in errs]
+
+
+def test_verifier_clean_program_has_no_diagnostics():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        h = layers.fc(input=x, size=3, act="relu")
+        loss = layers.mean(h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    diags = verify_program(main, check_shapes=True,
+                           fetch_targets=[loss.name])
+    assert not errors(diags), [d.format() for d in diags]
+
+
+def test_shared_param_is_initialized_exactly_once():
+    """The fix the verifier's V007 surfaced: N embedding layers sharing
+    one table used to append N initializer ops to the startup program
+    (N-1 dead writes, N-1 wasted random draws)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        a = layers.data(name="a", shape=[1], dtype="int64")
+        b = layers.data(name="b", shape=[1], dtype="int64")
+        ea = layers.embedding(input=a, size=[50, 8],
+                              param_attr=fluid.ParamAttr(name="tbl"))
+        eb = layers.embedding(input=b, size=[50, 8],
+                              param_attr=fluid.ParamAttr(name="tbl"))
+        layers.mean(layers.concat(input=[ea, eb], axis=1))
+    inits = [op for op in startup.global_block().ops
+             if "tbl" in op.desc.output_names()]
+    assert len(inits) == 1, [op.desc.type for op in inits]
+    assert not any(d.code == "V007"
+                   for d in verify_program(startup, check_shapes=False))
+
+
+def test_shared_param_shape_mismatch_rejected():
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        a = layers.data(name="a", shape=[1], dtype="int64")
+        layers.embedding(input=a, size=[50, 8],
+                         param_attr=fluid.ParamAttr(name="tbl2"))
+        with pytest.raises(ValueError, match="shape"):
+            layers.embedding(input=a, size=[60, 8],
+                             param_attr=fluid.ParamAttr(name="tbl2"))
+
+
+# --- executor gate ------------------------------------------------------
+
+def test_executor_refuses_malformed_program():
+    from paddle_tpu.analysis.selftest import _mk_program
+
+    prog = _mk_program(
+        {"a": dict(shape=[2, 2], dtype="float32"),
+         "t": dict(shape=[2, 2], dtype="float32"),
+         "b": dict(shape=[2, 2], dtype="float32")},
+        [("relu", {"X": ["t"]}, {"Out": ["b"]}, {}),
+         ("relu", {"X": ["a"]}, {"Out": ["t"]}, {})],
+    )
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with pytest.raises(AnalysisError) as ei:
+            exe.run(prog, feed={"a": np.ones((2, 2), np.float32)},
+                    fetch_list=["b"])
+    assert any(d.code == "V001" for d in ei.value.diagnostics)
+
+
+def test_executor_verify_flag_off_skips_gate():
+    """With the flag off the same program reaches the executor's own
+    (later, vaguer) error paths — proving the gate is the flag."""
+    from paddle_tpu.analysis.selftest import _mk_program
+    from paddle_tpu.fluid.flags import set_flags
+
+    prog = _mk_program(
+        {"a": dict(shape=[2, 2], dtype="float32"),
+         "b": dict(shape=[2, 2], dtype="float32")},
+        [("relu", {"X": ["ghost"]}, {"Out": ["b"]}, {})],
+    )
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    set_flags({"verify_programs": False})
+    try:
+        with fluid.scope_guard(scope):
+            with pytest.raises(Exception) as ei:
+                exe.run(prog, feed={"a": np.ones((2, 2), np.float32)},
+                        fetch_list=["b"])
+        assert not isinstance(ei.value, AnalysisError)
+    finally:
+        set_flags({"verify_programs": True})
+
+
+# --- memory-optimization gate ------------------------------------------
+
+def _mlp_program(seed=11):
+    from paddle_tpu.fluid import unique_name
+
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = seed
+    with unique_name.guard(), program_guard(main, startup):
+        x = layers.data(name="x", shape=[16], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        h = x
+        for i in range(3):
+            h = layers.fc(input=h, size=16, act="relu")
+        p = layers.fc(input=h, size=1)
+        cost = layers.mean(layers.square_error_cost(input=p, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    return main, startup, cost
+
+
+def test_memory_optimize_verifies_and_passes_on_book_programs():
+    """Deflake guard (ISSUE 4 satellite): the transpiler's output passes
+    the verifier on ALL book-example programs — a future transpiler
+    change cannot silently introduce unsafe reuse."""
+    from paddle_tpu.fluid.memory_optimization_transpiler import (
+        memory_optimize,
+    )
+
+    for name, build in sorted(examples.BOOK_EXAMPLES.items()):
+        main, _startup = build()
+        # gate runs inside memory_optimize (verify=True default) and
+        # raises AnalysisError on an unsafe rewrite
+        memory_optimize(main)
+        errs = errors(verify_program(main, check_shapes=True))
+        assert not errs, (name, [d.format() for d in errs])
+
+
+def test_memory_optimize_still_trains_identically():
+    from paddle_tpu.fluid.memory_optimization_transpiler import (
+        memory_optimize,
+    )
+
+    def run(main, startup, cost):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            xs = rng.rand(8, 16).astype(np.float32)
+            ys = rng.rand(8, 1).astype(np.float32)
+            return [exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[cost])[0].item() for _ in range(3)]
+
+    m1, s1, c1 = _mlp_program()
+    ref = run(m1, s1, c1)
+    m2, s2, c2 = _mlp_program()
+    merged = memory_optimize(m2, skip_opt_set={c2.name})
+    assert merged > 0
+    np.testing.assert_allclose(ref, run(m2, s2, c2), rtol=1e-6)
+
+
+def test_memory_optimize_skips_storage_with_later_live_range():
+    """Review regression: a var with two disjoint live ranges (def@0
+    read@1, re-def@3) enters the pool after its FIRST range ends; the
+    old pass would hand it out as storage for a temp still live across
+    the re-definition, clobbering the temp's value at op 3. The pass
+    must skip that candidate (and the gate must not fire — the program
+    stays intact and optimizable)."""
+    from paddle_tpu.analysis.selftest import _mk_program
+    from paddle_tpu.fluid.memory_optimization_transpiler import (
+        memory_optimize,
+    )
+
+    v = dict(shape=[4], dtype="float32")
+    prog = _mk_program(
+        {"a": v, "out": v, "b": v, "t": v, "c": v},
+        [("relu", {"X": ["a"]}, {"Out": ["out"]}, {}),
+         ("relu", {"X": ["out"]}, {"Out": ["b"]}, {}),
+         ("relu", {"X": ["b"]}, {"Out": ["t"]}, {}),     # 'out' is in
+         ("relu", {"X": ["b"]}, {"Out": ["out"]}, {}),   # the pool here,
+         ("relu", {"X": ["t"]}, {"Out": ["c"]}, {})],    # but re-defined
+    )
+    memory_optimize(prog)  # must neither corrupt nor raise
+    block = prog.global_block()
+    # the unsafe merge t->out was skipped: op 2 still writes 't' and
+    # op 4 still reads it
+    assert block.ops[2].desc.outputs["Out"] == ["t"]
+    assert block.ops[4].desc.inputs["X"] == ["t"]
+    assert not errors(verify_program(prog, check_shapes=False))
+
+
+def test_shared_param_dtype_mismatch_rejected():
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        layers.fc(input=x, size=3,
+                  param_attr=fluid.ParamAttr(name="wshared"))
+        helper = LayerHelper("t")
+        with pytest.raises(ValueError, match="dtype"):
+            helper.create_parameter(fluid.ParamAttr(name="wshared"),
+                                    shape=[4, 3], dtype="float16")
+
+
+def test_stale_startup_initializer_rejected():
+    """Review regression: a fresh main Program built against a REUSED
+    startup program must not silently keep a wrong-shaped initializer."""
+    startup = Program()
+    main1, main2 = Program(), Program()
+    with program_guard(main1, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        layers.fc(input=x, size=3, param_attr=fluid.ParamAttr(name="wsp"))
+    with program_guard(main2, startup):
+        x = layers.data(name="x", shape=[6], dtype="float32")
+        # same param name, different shape, same (reused) startup
+        with pytest.raises(ValueError, match="startup"):
+            layers.fc(input=x, size=3,
+                      param_attr=fluid.ParamAttr(name="wsp"))
+        # matching re-declaration reuses the existing initializer
+        x4 = layers.data(name="x4", shape=[4], dtype="float32")
+        layers.fc(input=x4, size=3, param_attr=fluid.ParamAttr(name="wsp"))
+    inits = [op for op in startup.global_block().ops
+             if "wsp" in op.desc.output_names()]
+    assert len(inits) == 1
+
+
+def test_reuse_alias_is_caught():
+    """check_reuse_events refuses a merge whose storage is still live —
+    the exact corruption the transpiler gate exists to prevent."""
+    from paddle_tpu.analysis.selftest import _mk_program
+    from paddle_tpu.fluid.memory_optimization_transpiler import (
+        ControlFlowGraph,
+    )
+
+    prog = _mk_program(
+        {"a": dict(shape=[4], dtype="float32"),
+         "buf": dict(shape=[4], dtype="float32"),
+         "out": dict(shape=[4], dtype="float32"),
+         "z": dict(shape=[4], dtype="float32")},
+        [("relu", {"X": ["a"]}, {"Out": ["out"]}, {}),
+         ("relu", {"X": ["buf"]}, {"Out": ["z"]}, {})],
+    )
+    cfg = ControlFlowGraph(prog.global_block())
+    bad = check_reuse_events(cfg, [(0, "out", "buf")])
+    assert any(d.code == "V010" and d.severity == "error" for d in bad)
+    # and a legitimate merge (storage dead before the def) is clean
+    ok = check_reuse_events(cfg, [(1, "z", "out")])
+    assert not ok or all(d.code != "V010" for d in ok)
+
+
+# --- concurrency + invariant passes over the real repo ------------------
+
+def test_locks_lint_clean_on_runtime_modules():
+    diags = locks.lint_paths(locks.default_lint_paths())
+    errs = errors(diags)
+    assert not errs, [d.format() for d in errs]
+
+
+def test_locks_lint_suppression_works():
+    src = selftest._L102_SRC.replace(
+        "with self._mu:",
+        "with self._mu:  # lint: allow-blocking")
+    assert not locks.lint_source(src, "s.py")
+    # and the unsuppressed form still fires
+    assert any(d.code == "L102"
+               for d in locks.lint_source(selftest._L102_SRC, "s.py"))
+
+
+def test_locks_lint_condition_wait_exempt_only_for_own_lock():
+    src = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._other = threading.Lock()
+
+    def ok(self):
+        with self._cv:
+            self._cv.wait(1.0)
+
+    def bad(self):
+        with self._other:
+            with self._cv:
+                self._cv.wait(1.0)
+'''
+    diags = locks.lint_source(src, "s.py")
+    waits = [d for d in diags if d.code == "L102" and "wait" in d.message]
+    assert len(waits) == 1, [d.format() for d in diags]
+    assert ":17" in waits[0].where or "s.py" in waits[0].where
+
+
+def test_locks_lint_condition_aliases_wrapped_lock():
+    src = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+
+    def nested(self):
+        with self._cv:
+            with self._mu:
+                pass
+'''
+    diags = locks.lint_source(src, "s.py")
+    assert any(d.code == "L103" for d in diags), \
+        [d.format() for d in diags]
+
+
+def test_lock_order_declaration_violation():
+    src = '''
+import threading
+
+# lint: lock-order(_a<_b)
+
+class S:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def backwards(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+    diags = locks.lint_source(src, "s.py")
+    assert any(d.code == "L101" and "violation" in d.message
+               for d in diags), [d.format() for d in diags]
+
+
+def test_invariants_clean_on_repo():
+    diags = errors(invariants.check_repo())
+    assert not diags, [d.format() for d in diags]
+
+
+def test_invariants_catch_registry_drift():
+    pkg_names = invariants.collect_declared_names(
+        invariants._repo_root() + "/paddle_tpu")
+    sites = invariants.collect_declared_sites(
+        invariants._repo_root() + "/paddle_tpu")
+    universe = invariants.NameUniverse(pkg_names, sites)
+    # the real registries resolve
+    assert universe.resolves("executor.jit_compiles")
+    assert universe.resolves("rpc.server.dedup_hits")
+    assert universe.resolves("rpc.server.push_grad.ms")  # f-string family
+    assert universe.resolves("pserver.barrier_wait_ms")
+    # prometheus-sanitized spellings resolve too
+    assert universe.resolves("rpc_client_push_grad_ms")
+    # and drift does not (pserver names are all exact — no dynamic
+    # family to hide behind, unlike rpc.client.* which is a declared
+    # per-method span family)
+    assert not universe.resolves("executor.jit_compilez")  # lint: allow-name
+    assert not universe.resolves("pserver.bogus_metric")  # lint: allow-name
+
+
+def test_fault_sites_of_the_real_runtime_are_declared():
+    exact, patterns = invariants.collect_declared_sites(
+        invariants._repo_root() + "/paddle_tpu")
+    assert "connect" in exact
+    assert "master.snapshot" in exact
+    assert any(p.startswith("handler.") for p in patterns)
+    assert any(p.startswith("recv.") for p in patterns)
+
+
+def test_flags_keys_all_defined():
+    root = invariants._repo_root()
+    defined = invariants.collect_defined_flags(
+        root + "/paddle_tpu/fluid/flags.py")
+    assert "verify_programs" in defined
+    assert "matmul_precision" in defined
+    refs = invariants.collect_flag_refs([root + "/paddle_tpu"])
+    unknown = {k for k, *_ in refs} - defined
+    assert not unknown, unknown
+
+
+# --- CLI driver ---------------------------------------------------------
+
+@pytest.mark.slow
+def test_cli_selftest_and_repo_run():
+    """The acceptance commands: `--selftest` passes, and the repo run
+    exits 0 at error level (warnings allowed). Slow lane: it imports the
+    full stack and builds every book program in a subprocess."""
+    for args in (["--selftest"], ["--json"]):
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis"] + args,
+            capture_output=True, text=True, timeout=600,
+            cwd=invariants._repo_root(),
+        )
+        assert proc.returncode == 0, (args, proc.stdout[-2000:],
+                                      proc.stderr[-2000:])

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""One-shot repo health check: static analysis + pytest collection.
+
+    python tools/check.py            # analysis CLI + collect-only smoke
+    python tools/check.py --fast     # skip the (abstract-eval priced)
+                                     # V003/V004 shape re-check
+    python tools/check.py --selftest # also prove every diagnostic code
+                                     # still fires
+
+Runs the same things CI's cheap lane runs, in the same way, so "works
+locally" and "works in CI" are the same claim:
+
+  1. `python -m paddle_tpu.analysis --selftest`   (with --selftest)
+  2. `python -m paddle_tpu.analysis`              (repo + book programs;
+                                                   exit-nonzero on any
+                                                   error-level diagnostic)
+  3. `python -m pytest tests/ --collect-only -q`  (imports every test
+                                                   module under
+                                                   --strict-markers: a
+                                                   bad import or an
+                                                   unregistered marker
+                                                   fails here, in
+                                                   seconds, not in the
+                                                   870s tier-1 lane)
+
+Exit status: nonzero if any step fails."""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(title, cmd) -> int:
+    print(f"\n=== {title}: {' '.join(cmd)}")
+    t0 = time.time()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(cmd, cwd=ROOT, env=env)
+    print(f"=== {title}: rc={proc.returncode} "
+          f"({time.time() - t0:.1f}s)")
+    return proc.returncode
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tools/check.py")
+    ap.add_argument("--fast", action="store_true",
+                    help="skip the shape/dtype abstract-eval re-check")
+    ap.add_argument("--selftest", action="store_true",
+                    help="also run the analysis selftest")
+    args = ap.parse_args(argv)
+
+    py = sys.executable
+    rc = 0
+    if args.selftest:
+        rc |= _run("analysis selftest",
+                   [py, "-m", "paddle_tpu.analysis", "--selftest"])
+    analysis_cmd = [py, "-m", "paddle_tpu.analysis"]
+    if args.fast:
+        analysis_cmd.append("--no-shapes")
+    rc |= _run("static analysis", analysis_cmd)
+    rc |= _run("pytest collect smoke",
+               [py, "-m", "pytest", "tests/", "--collect-only", "-q",
+                "-p", "no:cacheprovider"])
+    print(f"\ntools/check.py: {'OK' if rc == 0 else 'FAILED'}")
+    return 1 if rc else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
