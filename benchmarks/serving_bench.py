@@ -1,0 +1,148 @@
+"""Open-loop serving load generator: throughput + latency percentiles
+for the paddle_tpu.serving stack (ISSUE 5).
+
+OPEN-loop: requests are fired on a fixed schedule (target QPS) no matter
+how the server is doing — the honest way to measure a serving system,
+because a closed loop (wait-for-response-then-send) self-throttles and
+hides queueing collapse. Latency is measured per request from its
+SCHEDULED time, so schedule slip counts against the server, not the
+generator.
+
+One JSON evidence line on stdout (the _timing.py convention: the
+framework_metrics snapshot rides along, so the artifact carries
+queue-wait vs compute splits, batch sizes, padding waste, and overload
+counts next to the wall-clock numbers).
+
+Env knobs / flags:
+    SERVE_QPS      target request rate            (default 300)
+    SERVE_SECONDS  open-loop duration             (default 5)
+    SERVE_THREADS  client worker threads          (default 8)
+    SERVE_BUCKETS  bucket ladder                  (default "1,2,4,8")
+    SERVE_MAXROWS  max request rows (mixed sizes) (default 4)
+    SERVE_MAXQ     admission queue bound (default: FLAGS serving_max_queue)
+    SERVE_WAIT_MS  batching timer ms              (default 2.0)
+    --smoke        tiny fixed run for CI's slow lane (CPU-friendly)
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _timing import framework_metrics  # noqa: E402
+
+SMOKE = "--smoke" in sys.argv
+QPS = float(os.environ.get("SERVE_QPS", "60" if SMOKE else "300"))
+SECONDS = float(os.environ.get("SERVE_SECONDS", "1.5" if SMOKE else "5"))
+THREADS = int(os.environ.get("SERVE_THREADS", "4" if SMOKE else "8"))
+BUCKETS = [int(b) for b in
+           os.environ.get("SERVE_BUCKETS", "1,2,4,8").split(",")]
+MAXROWS = int(os.environ.get("SERVE_MAXROWS", "4"))
+MAXQ = (int(os.environ["SERVE_MAXQ"])
+        if os.environ.get("SERVE_MAXQ") else None)
+WAIT_MS = float(os.environ.get("SERVE_WAIT_MS", "2.0"))
+
+
+def main() -> int:
+    import tempfile
+
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving import ServerOverloaded, ServingClient, \
+        ServingServer
+    from paddle_tpu.serving.__main__ import make_model_dir
+
+    with tempfile.TemporaryDirectory() as tmp:
+        d, _probe, _ref = make_model_dir(os.path.join(tmp, "m"))
+        # request pool sized to MAXROWS (make_model_dir's probe has only
+        # 4 rows — slicing it would silently cap the configured mix)
+        pool = np.random.RandomState(1).rand(
+            max(MAXROWS, 1), 8).astype(np.float32)
+        srv = ServingServer()
+        addr = srv.serve()
+        loader = ServingClient(addr)
+        t_load0 = time.perf_counter()
+        loader.load_model("bench", d, buckets=BUCKETS, max_queue=MAXQ,
+                          max_wait_ms=WAIT_MS)
+        load_warm_s = time.perf_counter() - t_load0
+
+        n_requests = int(QPS * SECONDS)
+        rng = np.random.RandomState(0)
+        sizes = [1 + int(rng.randint(MAXROWS)) for _ in range(n_requests)]
+        lat_ms = []
+        overloads = [0]
+        errors = [0]
+        mu = threading.Lock()
+        t_start = time.perf_counter() + 0.1  # common schedule epoch
+
+        def worker(tid):
+            cli = ServingClient(addr)
+            try:
+                # worker t owns requests t, t+THREADS, t+2*THREADS, ...
+                for i in range(tid, n_requests, THREADS):
+                    sched = t_start + i / QPS
+                    now = time.perf_counter()
+                    if sched > now:
+                        time.sleep(sched - now)
+                    try:
+                        cli.infer("bench",
+                                  {"x": pool[:sizes[i]]},
+                                  deadline_ms=30000.0)
+                        dt = (time.perf_counter() - sched) * 1e3
+                        with mu:
+                            lat_ms.append(dt)
+                    except ServerOverloaded:
+                        with mu:
+                            overloads[0] += 1
+                    except Exception:
+                        with mu:
+                            errors[0] += 1
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(THREADS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_s = time.perf_counter() - t0
+
+        snap = metrics.snapshot(prefix="serving.", skip_zero=True)
+        lat = np.asarray(sorted(lat_ms)) if lat_ms else np.zeros(1)
+        evidence = {
+            "what": "serving_bench open-loop",
+            "smoke": SMOKE,
+            "qps_target": QPS,
+            "seconds": SECONDS,
+            "threads": THREADS,
+            "buckets": BUCKETS,
+            "max_queue": MAXQ,
+            "max_wait_ms": WAIT_MS,
+            "offered": n_requests,
+            "completed": len(lat_ms),
+            "overloaded": overloads[0],
+            "errors": errors[0],
+            "throughput_rps": round(len(lat_ms) / wall_s, 2),
+            "load_warm_s": round(load_warm_s, 3),
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+            "max_ms": round(float(lat[-1]), 3),
+            "padding_waste": snap.get("serving.padding_waste", {}),
+            "batch_size": snap.get("serving.batch_size", {}),
+            "queue_wait_ms": snap.get("serving.queue_wait_ms", {}),
+            "compute_ms": snap.get("serving.compute_ms", {}),
+            "framework_metrics": framework_metrics(),
+        }
+        loader.close()
+        srv.shutdown()
+        print(json.dumps(evidence))
+        return 0 if not errors[0] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

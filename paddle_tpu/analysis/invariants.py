@@ -455,7 +455,7 @@ def check_repo(root: Optional[str] = None) -> List[Diagnostic]:
     tools = os.path.join(root, "tools")
     docs = [os.path.join(root, "docs", n)
             for n in ("OBSERVABILITY.md", "FAULT_TOLERANCE.md",
-                      "STATIC_ANALYSIS.md")]
+                      "STATIC_ANALYSIS.md", "SERVING.md")]
     diags: List[Diagnostic] = []
 
     sites = collect_declared_sites(pkg)

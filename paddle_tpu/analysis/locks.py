@@ -533,4 +533,5 @@ def default_lint_paths(repo_root: Optional[str] = None) -> List[str]:
         os.path.dirname(os.path.abspath(__file__))))
     pkg = os.path.join(root, "paddle_tpu")
     return [os.path.join(pkg, "distributed"),
-            os.path.join(pkg, "observability")]
+            os.path.join(pkg, "observability"),
+            os.path.join(pkg, "serving")]

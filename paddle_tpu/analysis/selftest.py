@@ -226,9 +226,20 @@ def case_l103():
 def case_n201():
     from .invariants import check_fault_sites
 
-    declared = ({"connect", "master.snapshot"}, {"recv.*", "send.*"})
-    used = [("nope.bogus_site", "snippet.py", 1, False)]
-    return check_fault_sites(declared, used)
+    # exact names plus f-string wildcard FAMILIES — `serving.*` is the
+    # real one serving/server.py declares via `fire(f"serving.{method}")`
+    declared = ({"connect", "master.snapshot"},
+                {"recv.*", "send.*", "serving.*"})
+    used = [("nope.bogus_site", "snippet.py", 1, False),
+            ("serving.infer", "snippet.py", 2, False)]
+    diags = check_fault_sites(declared, used)
+    # the family must CLAIM serving.infer: a second, spurious N201 here
+    # means wildcard matching rotted — crash the case so it fails
+    if any("serving.infer" in d.message for d in diags):
+        raise AssertionError(
+            "wildcard site family 'serving.*' did not match "
+            "'serving.infer'")
+    return diags
 
 
 def case_n202():

@@ -105,6 +105,17 @@ FLAGS: Dict[str, Any] = _Flags({
     # string, e.g. 'seed=7;drop@recv.push_grad:1,3'); None/'' = off.
     # Seeded from PADDLE_TPU_FAULTS; reads are live (see _Flags).
     "faults": None,
+    # serving defaults (paddle_tpu/serving, ISSUE 5). The bucket ladder
+    # is THE compile-bound knob: dynamic batches pad up to the next
+    # ladder entry, so the executor jit cache holds at most one entry
+    # per bucket per model version regardless of arrival pattern.
+    "serving_buckets": "1,2,4,8,16",
+    # admission bound: queue depth past which infer() is rejected with
+    # ServerOverloaded instead of queueing into unbounded latency
+    "serving_max_queue": 64,
+    # batching timer: the oldest queued request waits at most this long
+    # for batch-mates before its (possibly underfull) batch launches
+    "serving_max_wait_ms": 5.0,
 })
 
 

@@ -185,15 +185,56 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None):
-    """reference io.py:383 — returns (program, feed_names, fetch_vars)."""
-    with open(os.path.join(dirname, model_filename or MODEL_FILENAME), "rb") as f:
+                         params_filename=None,
+                         scope: Optional[Scope] = None):
+    """reference io.py:383 — returns (program, feed_names, fetch_vars).
+
+    Serving turns this into a user-facing API (paddle_tpu/serving loads
+    models by directory over RPC), so every missing artifact fails HERE
+    with the offending path named — not as a bare FileNotFoundError /
+    KeyError from deep inside `_build_load_program` or the load_combine
+    host op. `scope` targets the load (default: the calling thread's
+    global scope) so engines can populate private scopes without a
+    scope_guard."""
+    if not os.path.isdir(dirname):
+        raise IOError(
+            f"inference model directory '{dirname}' does not exist — "
+            "pass the directory given to save_inference_model / "
+            "export_compiled_model")
+    model_path = os.path.join(dirname, model_filename or MODEL_FILENAME)
+    if not os.path.exists(model_path):
+        raise IOError(
+            f"no serialized program at '{model_path}' — is '{dirname}' a "
+            "save_inference_model directory? (export_compiled_model "
+            "artifacts load via load_exported_model)")
+    meta_path = os.path.join(dirname, "__meta__.json")
+    if not os.path.exists(meta_path):
+        raise IOError(
+            f"missing feed/fetch metadata '{meta_path}' — the model "
+            "directory is incomplete (was save_inference_model "
+            "interrupted?)")
+    with open(model_path, "rb") as f:
         program = Program.parse_from_bytes(f.read())
-    with open(os.path.join(dirname, "__meta__.json")) as f:
+    with open(meta_path) as f:
         meta = json.load(f)
     persistables = [v for v in program.list_vars() if v.persistable]
-    load_vars(executor, dirname, program, vars=persistables,
-              filename=params_filename or PARAMS_FILENAME)
+    if persistables:
+        params_path = os.path.join(
+            dirname, _norm_npz(params_filename or PARAMS_FILENAME))
+        if not os.path.exists(params_path):
+            raise IOError(
+                f"missing parameter payload '{params_path}' for model "
+                f"directory '{dirname}'")
+        with np.load(params_path) as payload:
+            missing = sorted(v.name for v in persistables
+                             if v.name not in payload.files)
+        if missing:
+            raise IOError(
+                f"parameter payload '{params_path}' lacks persistable "
+                f"var(s) {missing} that the program requires — the "
+                "artifact was saved from a different program version")
+        load_vars(executor, dirname, program, vars=persistables,
+                  filename=params_filename or PARAMS_FILENAME, scope=scope)
     fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
     return program, meta["feed_names"], fetch_vars
 

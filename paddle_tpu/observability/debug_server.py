@@ -12,7 +12,9 @@ Stdlib-only (http.server on an ephemeral port), serving:
   /statusz   process status JSON: flags, jax backend/devices, uptime,
              plus every registered status provider (the pserver adds
              its param table + heartbeat ages, the master its queue
-             stats, the RPC server its dedup-cache occupancy)
+             stats, the RPC server its dedup-cache occupancy, and a
+             ServingServer its "serving:<port>" section — models,
+             versions, bucket ladders, queue depths)
 
 Two ways in:
 

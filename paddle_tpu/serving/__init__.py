@@ -1,0 +1,25 @@
+"""paddle_tpu.serving — online inference serving (ISSUE 5).
+
+The runtime that consumes what `fluid/io.py` produces: load a
+`save_inference_model` directory (or an `export_compiled_model`
+StableHLO artifact) behind an `InferenceEngine` that batches requests
+into a fixed bucket ladder, a `ModelRegistry` that hot-swaps versions
+atomically, and a `ServingServer`/`ServingClient` pair on the
+distributed RPC transport with admission control and chaos-ready
+`serving.*` fault sites. See docs/SERVING.md.
+
+    python -m paddle_tpu.serving --selftest   # in-process end-to-end
+"""
+from .client import ServingClient
+from .engine import InferenceEngine, default_buckets, parse_buckets
+from .errors import (DeadlineExceeded, EngineRetired, ModelNotFound,
+                     RequestTooLarge, ServerOverloaded, ServingError)
+from .registry import ModelRegistry
+from .server import ServingServer
+
+__all__ = [
+    "InferenceEngine", "ModelRegistry", "ServingServer", "ServingClient",
+    "ServingError", "ServerOverloaded", "DeadlineExceeded",
+    "ModelNotFound", "RequestTooLarge", "EngineRetired",
+    "default_buckets", "parse_buckets",
+]

@@ -1,0 +1,224 @@
+"""CLI driver for the serving subsystem.
+
+    python -m paddle_tpu.serving --selftest
+        In-process end-to-end proof (no external network, no datasets):
+        builds two versions of a tiny model, then exercises the bucketed
+        batcher (jit-compile bound + batch-invariance), the RPC
+        server/client path, an atomic hot-swap, and the overload
+        rejection path. Exit-nonzero on any failure — wired into
+        tools/check.py as the serving smoke.
+
+    python -m paddle_tpu.serving --serve --load m=/path/to/model_dir
+        Operator mode: start a ServingServer, load the named model
+        directories, print the address, and serve until interrupted.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+
+def _force_cpu():
+    """The selftest must not require (or try to dial) a TPU: pin the jax
+    platform before any backend initialization, the same way
+    tests/conftest.py and the analysis CLI do."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def make_model_dir(dirname: str, scale: float = 1.0, feature_dim: int = 8,
+                   classes: int = 3):
+    """Build + save a tiny softmax model with DETERMINISTIC,
+    scale-distinct parameters (so two builds with different `scale` are
+    observably different model versions). Returns (dirname, probe
+    input, reference output) — the reference computed by the framework
+    itself, for later equality checks against the serving path."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers, unique_name
+    from paddle_tpu.fluid.framework import Parameter, Program, program_guard
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup), unique_name.guard():
+            x = layers.data(name="x", shape=[feature_dim], dtype="float32")
+            pred = layers.fc(input=x, size=classes, act="softmax")
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(7)
+        for var in sorted(main.list_vars(), key=lambda v: v.name):
+            if isinstance(var, Parameter):
+                vals = rng.uniform(-1, 1, size=tuple(var.shape)) * scale
+                scope.set_var(var.name, jnp.asarray(vals.astype(np.float32)))
+        fluid.io.save_inference_model(dirname, ["x"], [pred], exe, main)
+        probe = np.random.RandomState(11).rand(4, feature_dim).astype(
+            np.float32)
+        (ref,) = exe.run(main, feed={"x": probe}, fetch_list=[pred])
+    return dirname, probe, ref
+
+
+def run_selftest(verbose: bool = True) -> int:
+    import numpy as np
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    from paddle_tpu.observability import metrics as _metrics
+    from . import (InferenceEngine, ServerOverloaded, ServingClient,
+                   ServingServer)
+
+    def say(msg):
+        if verbose:
+            print(f"  {msg}")
+
+    failures = []
+
+    def check(ok, what):
+        say(("ok  " if ok else "FAIL") + f" {what}")
+        if not ok:
+            failures.append(what)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        d1, probe, ref1 = make_model_dir(os.path.join(tmp, "v1"), scale=1.0)
+        d2, _, ref2 = make_model_dir(os.path.join(tmp, "v2"), scale=-1.0)
+
+        # -- 1. bucketed batching bounds the jit cache -------------------
+        jc = _metrics.counter("executor.jit_compiles")
+        base = jc.value()
+        eng = InferenceEngine.from_inference_dir(
+            d1, name="selftest", buckets=[1, 2, 4], max_wait_ms=1.0)
+        warm_compiles = jc.value() - base
+        check(warm_compiles <= 3,
+              f"warmup compiles {warm_compiles} <= ladder length 3")
+        sizes = [1, 2, 3, 4, 1, 3, 2, 4, 1, 1]
+        rng = np.random.RandomState(0)
+        reqs = [rng.rand(b, 8).astype(np.float32) for b in sizes]
+        with ThreadPoolExecutor(max_workers=6) as pool:
+            outs = list(pool.map(lambda a: eng.infer({"x": a}), reqs))
+        check(all(o[0][0].shape[0] == a.shape[0]
+                  for o, a in zip(outs, reqs)),
+              "every request got its own rows back")
+        check(jc.value() - base <= 3,
+              f"mixed arrival pattern stayed inside the ladder "
+              f"({jc.value() - base} compiles)")
+        # batch invariance: one 4-row request == 4 single-row requests
+        (whole, _) = eng.infer({"x": probe})
+        singles = [eng.infer({"x": probe[i:i + 1]})[0][0]
+                   for i in range(probe.shape[0])]
+        check(np.allclose(np.concatenate(singles), whole[0], atol=1e-5),
+              "batching is result-invariant (padding sliced off)")
+        check(np.allclose(whole[0], ref1, atol=1e-5),
+              "engine output matches the framework reference")
+        eng.stop()
+
+        # -- 2. server / client / hot-swap / overload --------------------
+        srv = ServingServer()
+        addr = srv.serve()
+        cli = ServingClient(addr)
+        try:
+            cli.load_model("m", d1, buckets=[1, 2, 4], max_wait_ms=1.0)
+            h = cli.health()
+            check(h.get("ok") and "m" in h.get("models", []),
+                  "health reports the loaded model")
+            out, v = cli.infer("m", {"x": probe})
+            check(v == 1 and np.allclose(out[0], ref1, atol=1e-5),
+                  "RPC infer serves v1")
+            cli.load_model("m", d2, buckets=[1, 2, 4], max_wait_ms=1.0)
+            out, v = cli.infer("m", {"x": probe})
+            check(v == 2 and np.allclose(out[0], ref2, atol=1e-5),
+                  "hot-swap flipped to v2 atomically")
+            listed = cli.list_models()
+            check(listed.get("m", {}).get("version") == 2,
+                  "list_models shows the new version")
+
+            # overload: tighten the admission bound, park the scheduler
+            # on its batching timer (long enough that a contended host
+            # still lands the flood inside the window), and flood —
+            # extras must be refused IMMEDIATELY with ServerOverloaded,
+            # not queued forever
+            cli.load_model("m", d2, version=3, buckets=[1, 2, 4],
+                           max_queue=1, max_wait_ms=1200.0)
+            ok_n = over_n = 0
+
+            def fire(i):
+                nonlocal ok_n, over_n
+                try:
+                    cli2 = ServingClient(addr)
+                    try:
+                        cli2.infer("m", {"x": probe[:1]},
+                                   deadline_ms=30000.0)
+                        ok_n += 1
+                    finally:
+                        cli2.close()
+                except ServerOverloaded:
+                    over_n += 1
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                list(pool.map(fire, range(8)))
+            check(over_n > 0 and ok_n > 0,
+                  f"overload sheds load ({ok_n} served, {over_n} refused)")
+            check(_metrics.counter("serving.overloads").value() >= over_n,
+                  "serving.overloads counted the rejections")
+        finally:
+            cli.close()
+            srv.shutdown()
+
+    if failures:
+        print(f"serving selftest: {len(failures)} FAILURE(S): {failures}")
+        return 1
+    print("serving selftest: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m paddle_tpu.serving")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the in-process end-to-end selftest")
+    ap.add_argument("--serve", action="store_true",
+                    help="start a ServingServer")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--load", action="append", default=[],
+                    metavar="NAME=DIR",
+                    help="model(s) to load at startup (repeatable)")
+    args = ap.parse_args(argv)
+
+    _force_cpu()
+    if args.serve:
+        from . import InferenceEngine, ServingServer
+
+        srv = ServingServer()
+        host, port = srv.serve(args.host, args.port)
+        for spec in args.load:
+            name, _, dirname = spec.partition("=")
+            if not dirname:
+                print(f"bad --load {spec!r} (want NAME=DIR)")
+                return 2
+            eng = srv.registry.deploy(
+                name,
+                lambda d=dirname, n=name:
+                    InferenceEngine.from_inference_dir(d, name=n))
+            print(f"loaded {name} v{eng.version} from {dirname}")
+        print(f"serving on {host}:{port} (ctrl-c to stop)")
+        try:
+            import time
+
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            srv.shutdown()
+        return 0
+    # default: selftest
+    return run_selftest()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+"""ServingClient — typed client over distributed/rpc.py's RpcClient.
+
+Transport retries are SAFE by construction: every frame carries the
+idempotency token, and the server routes `infer` through its dedup
+cache, so a retransmit after a dropped reply is answered from the
+original response without re-running the batch. Application errors come
+back as ``"<TypeName>: <message>"`` strings; `_raise_typed` maps the
+name back to the serving exception class (ServerOverloaded,
+DeadlineExceeded, ...) so callers catch types, not regexes."""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..distributed.rpc import RpcClient
+from .errors import (DeadlineExceeded, EngineRetired, ModelNotFound,
+                     RequestTooLarge, ServerOverloaded, ServingError)
+
+__all__ = ["ServingClient"]
+
+_TYPED = {cls.__name__: cls for cls in
+          (ServerOverloaded, DeadlineExceeded, ModelNotFound,
+           RequestTooLarge, EngineRetired, ServingError)}
+
+# rpc.py's client raises RuntimeError("RPC <m> failed: <Type>: <msg>")
+_ERR_RE = re.compile(r"^RPC \S+ failed: (\w+): (.*)$", re.DOTALL)
+
+
+def _raise_typed(e: RuntimeError):
+    m = _ERR_RE.match(str(e))
+    if m and m.group(1) in _TYPED:
+        raise _TYPED[m.group(1)](m.group(2)) from e
+    raise
+
+
+class ServingClient:
+    """Blocking client for one ServingServer endpoint."""
+
+    def __init__(self, addr, timeout: float = 180.0, retries: int = 3):
+        self._rpc = RpcClient(addr, timeout=timeout, retries=retries)
+
+    def infer(self, model: str, feeds: Dict[str, Any],
+              deadline_ms: Optional[float] = None
+              ) -> Tuple[List[np.ndarray], int]:
+        """Returns (outputs, served_version). Raises ServerOverloaded /
+        DeadlineExceeded / ModelNotFound / RequestTooLarge."""
+        wire_feeds = {str(k): np.asarray(v) for k, v in feeds.items()}
+        try:
+            resp = self._rpc.call("infer", model, wire_feeds, deadline_ms)
+        except RuntimeError as e:
+            _raise_typed(e)
+        return ([np.asarray(o) for o in resp["outputs"]],
+                int(resp["version"]))
+
+    def load_model(self, model: str, dirname: str,
+                   version: Optional[int] = None, kind: str = "auto",
+                   buckets: Optional[Sequence[int]] = None,
+                   max_queue: Optional[int] = None,
+                   max_wait_ms: Optional[float] = None) -> Dict[str, Any]:
+        try:
+            return self._rpc.call("load_model", model, dirname, version,
+                                  kind, list(buckets) if buckets else None,
+                                  max_queue, max_wait_ms)
+        except RuntimeError as e:
+            _raise_typed(e)
+
+    def unload_model(self, model: str) -> Dict[str, Any]:
+        try:
+            return self._rpc.call("unload_model", model)
+        except RuntimeError as e:
+            _raise_typed(e)
+
+    def list_models(self) -> Dict[str, Any]:
+        return self._rpc.call("list_models")
+
+    def health(self) -> Dict[str, Any]:
+        return self._rpc.call("health")
+
+    def close(self):
+        self._rpc.close()

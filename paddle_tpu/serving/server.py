@@ -1,0 +1,205 @@
+"""ServingServer — the RPC front door of the serving subsystem.
+
+Rides distributed/rpc.py (the same length-prefixed JSON + raw-segment
+framing the pserver uses), so serving inherits the whole PR 1-4
+infrastructure for free: idempotency-token dedup, retry-safe clients,
+per-method latency histograms, trace-context adoption, and named fault
+sites for chaos plans.
+
+Methods (all fire the `serving.<method>` fault site before running, so
+`PADDLE_TPU_FAULTS='error@serving.infer:0'` chaos plans reach them):
+
+    infer(model, feeds, deadline_ms)   -> {model, version, outputs}
+    load_model(model, dirname, ...)    -> engine stats (after warmup)
+    unload_model(model)                -> final engine stats
+    list_models()                      -> {name: stats}
+    health()                           -> {"ok": True, "models": [...]}
+
+Retry semantics: `infer` is SEMANTICALLY idempotent (pure function of
+its feeds), but it is deliberately NOT declared in RpcServer's
+`idempotent` set — it rides the dedup cache instead, so a client
+retransmit after a lost reply is answered from the cached response
+without re-running the batch (rpc.server.dedup_hits counts exactly one
+per retransmitted frame; the chaos test pins this). Re-execution would
+be CORRECT but wasteful — and under overload, wasteful is wrong.
+Memory sizing note: the dedup cache holds recent infer RESPONSES (up
+to `dedup_cap`, held >= 900s, 4x-cap safety valve — see
+rpc._DedupCache); budget `dedup_cap x typical response bytes` of
+serving-host RAM, and shrink `dedup_cap` for models with large
+outputs. `health`/`list_models` are declared idempotent: cheap reads
+whose responses must not occupy dedup-cache slots. Overload/deadline/
+not-found rejections are application errors — RpcClient never retries
+them, so a shedding server is not hammered by its own rejects.
+
+Admission control happens in the ENGINE (bounded queue depth →
+immediate structured ServerOverloaded): by the time a request would
+have to wait unboundedly, it has already been refused.
+
+A hot-swap retires the old engine only after the registry pointer
+flipped; a request that raced the flip gets EngineRetired from the old
+engine and is transparently resubmitted to the current one
+(`serving.swap_resubmits`) — zero requests fail because a deploy
+happened.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..distributed import faults as _faults
+from ..distributed.rpc import RpcServer
+from ..observability import debug_server as _debug, metrics as _metrics, \
+    tracing as _tracing
+from ..observability.log import get_logger
+from .engine import InferenceEngine
+from .errors import EngineRetired, ModelNotFound, ServingError
+from .registry import ModelRegistry
+
+__all__ = ["ServingServer"]
+
+_log = get_logger("serving")
+
+_m_resubmits = _metrics.counter("serving.swap_resubmits")
+
+
+class ServingServer:
+    """RPC serving front end over a ModelRegistry."""
+
+    # a request may race at most this many consecutive retirements (each
+    # get() after a retirement returns the freshly-flipped engine, so >1
+    # loop only happens under back-to-back deploys)
+    _SWAP_RETRIES = 8
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 dedup_cap: int = 1024):
+        self._registry = registry or ModelRegistry()
+        handlers = {
+            "infer": self._infer,
+            "load_model": self._load_model,
+            "unload_model": self._unload_model,
+            "list_models": self._list_models,
+            "health": self._health,
+        }
+        self._rpc = RpcServer(
+            {m: self._guarded(m, fn) for m, fn in handlers.items()},
+            dedup_cap=dedup_cap,
+            idempotent={"health", "list_models"},
+        )
+        # serializes load_model end-to-end: auto-versioning is a
+        # read-then-deploy sequence, and two concurrent deploys of one
+        # model racing it would mint duplicate version numbers (deploys
+        # are rare and already compile-bound — serializing them costs
+        # nothing that matters)
+        self._load_mu = threading.Lock()
+
+    @staticmethod
+    def _guarded(method: str, fn):
+        """Every handler fires its `serving.<method>` fault site first,
+        so chaos plans (`error@serving.infer:0`) reach the serving layer
+        by name — the same seam the RPC transport already has."""
+        def handler(*args, **kw):
+            _faults.fire(f"serving.{method}")
+            return fn(*args, **kw)
+        return handler
+
+    @property
+    def registry(self) -> ModelRegistry:
+        return self._registry
+
+    # -- lifecycle --------------------------------------------------------
+    def serve(self, host: str = "127.0.0.1", port: int = 0
+              ) -> Tuple[str, int]:
+        addr = self._rpc.serve(host, port)
+        _tracing.set_process_label(f"serving:{addr[1]}")
+        _log.info("serving server listening on %s:%d", *addr)
+        # live introspection: PADDLE_TPU_DEBUG_PORT attaches the shared
+        # debug server; /statusz grows a "serving:<port>" section
+        # (models, versions, bucket ladders, queue depths, transport).
+        # Per-INSTANCE name: two servers in one process must not clobber
+        # each other's section (or deregister the survivor's on shutdown)
+        _debug.maybe_serve_from_env()
+        self._status_name = f"serving:{addr[1]}"
+        _debug.add_status(self._status_name, self._status)
+        return addr
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._rpc.address
+
+    def shutdown(self, drain: bool = True):
+        _debug.remove_status(getattr(self, "_status_name", None))
+        self._rpc.shutdown()
+        self._registry.unload_all(drain=drain)
+
+    def _status(self) -> Dict[str, Any]:
+        return {"models": self._registry.stats(),
+                "rpc": self._rpc.stats()}
+
+    # -- handlers ---------------------------------------------------------
+    def _infer(self, model: str, feeds: Dict[str, Any],
+               deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+        with _tracing.span("serving.request", model=str(model)):
+            for _ in range(self._SWAP_RETRIES):
+                engine = self._registry.get(str(model))
+                try:
+                    outputs, version = engine.infer(
+                        feeds, deadline_ms=deadline_ms)
+                except EngineRetired:
+                    # raced a hot-swap: the registry already points at
+                    # the replacement — resubmit there, never fail
+                    _m_resubmits.inc()
+                    continue
+                return {"model": str(model), "version": version,
+                        "outputs": [np.asarray(o) for o in outputs]}
+            raise ServingError(
+                f"model '{model}' kept retiring across "
+                f"{self._SWAP_RETRIES} resubmits — deploy storm?")
+
+    def _load_model(self, model: str, dirname: str,
+                    version: Optional[int] = None,
+                    kind: str = "auto",
+                    buckets: Optional[Sequence[int]] = None,
+                    max_queue: Optional[int] = None,
+                    max_wait_ms: Optional[float] = None) -> Dict[str, Any]:
+        """Load + warm + atomically install `dirname` under `model`.
+        `kind`: 'program' (save_inference_model dir), 'exported'
+        (export_compiled_model dir), or 'auto' (sniff the artifact)."""
+        model = str(model)
+        # lint: allow-blocking — the whole deploy (load + per-bucket
+        # compile + drain of the old engine) is deliberately serialized;
+        # see _load_mu above. infer traffic never takes this lock.
+        with self._load_mu:
+            if version is None:
+                try:
+                    version = self._registry.get(model).version + 1
+                except ModelNotFound:
+                    version = 1
+            if kind == "auto":
+                kind = ("exported"
+                        if os.path.exists(os.path.join(
+                            dirname, "__stablehlo__.bin"))
+                        else "program")
+
+            def build():
+                if kind == "exported":
+                    return InferenceEngine.from_exported_dir(
+                        dirname, name=model, version=version,
+                        max_queue=max_queue, max_wait_ms=max_wait_ms)
+                return InferenceEngine.from_inference_dir(
+                    dirname, name=model, version=version, buckets=buckets,
+                    max_queue=max_queue, max_wait_ms=max_wait_ms)
+
+            engine = self._registry.deploy(model, build)
+            return engine.stats()
+
+    def _unload_model(self, model: str) -> Dict[str, Any]:
+        return self._registry.unload(str(model))
+
+    def _list_models(self) -> Dict[str, Any]:
+        return self._registry.stats()
+
+    def _health(self) -> Dict[str, Any]:
+        return {"ok": True, "models": self._registry.names()}

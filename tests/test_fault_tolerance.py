@@ -494,8 +494,14 @@ def test_oversized_payload_fails_fast_with_cause():
         t0 = time.perf_counter()
         with pytest.raises(FrameTooLargeError, match="shard it"):
             c.call("bump", huge)
-        # one attempt, no backoff sleeps
-        assert time.perf_counter() - t0 < 5.0
+        # ONE attempt: the retry counters are the deterministic evidence
+        # (a wall-clock bound flaked under host load — encoding the 16MiB
+        # payload once took >5s on a contended 2-vCPU box); the loose
+        # bound below only guards against burning the 3-retry budget on
+        # re-encodes
+        assert metrics.counter("rpc.client.retries").value() == 0
+        assert metrics.counter("rpc.client.connect_retries").value() == 0
+        assert time.perf_counter() - t0 < 30.0
         assert calls["n"] == 0
         # the connection (never written to) still works for the next call
         assert c.call("bump", 1)["x"] == 1

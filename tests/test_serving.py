@@ -1,0 +1,633 @@
+"""Serving subsystem (ISSUE 5): bucketed dynamic batching, model
+hot-swap, overload control, and the io.py artifact round-trips that feed
+it.
+
+Coverage map:
+  - save_inference_model -> load -> engine round-trip on two book models
+    (fit_a_line, lenet) + the export_compiled_model fast path;
+  - the bucket ladder bounds executor.jit_compiles regardless of arrival
+    pattern;
+  - registry hot-swap: atomic flip, rollback on failed warmup, and the
+    jit-cache LIFECYCLE guarantee (old Program weakref dies after swap —
+    compiled executables do not accumulate across version flips);
+  - admission control (ServerOverloaded), deadlines, validation errors;
+  - chaos: a serving.infer reply killed mid-frame is answered by the
+    idempotency-token dedup cache on retransmit — same answer, zero
+    re-execution, counters exact;
+  - the end-to-end acceptance run: two models, ~200 concurrent-ish mixed
+    -shape requests, mid-run hot-swap with zero failures, queue-shrink
+    overload, all visible in the metrics snapshot.
+"""
+import gc
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import faults
+from paddle_tpu.fluid import layers, unique_name
+from paddle_tpu.fluid.framework import Program, program_guard
+from paddle_tpu.observability import metrics
+from paddle_tpu.serving import (
+    DeadlineExceeded, InferenceEngine, ModelNotFound, ModelRegistry,
+    RequestTooLarge, ServerOverloaded, ServingClient, ServingServer,
+)
+from paddle_tpu.serving.__main__ import make_model_dir
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _jit_compiles():
+    return metrics.counter("executor.jit_compiles").value()
+
+
+# --- artifact round-trips (satellite) -----------------------------------
+
+def test_roundtrip_fit_a_line_engine(tmp_path):
+    """save_inference_model -> load_inference_model -> engine serves the
+    same prediction the training-process executor computed."""
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup), unique_name.guard():
+            x = layers.data(name="x", shape=[13], dtype="float32")
+            y_predict = layers.fc(input=x, size=1)
+        exe = fluid.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "fit_a_line")
+        fluid.save_inference_model(d, ["x"], [y_predict], exe, main)
+        probe = np.random.RandomState(0).rand(4, 13).astype(np.float32)
+        (want,) = exe.run(main, feed={"x": probe}, fetch_list=[y_predict])
+
+    eng = InferenceEngine.from_inference_dir(
+        d, name="fit_a_line", buckets=[4], max_wait_ms=1.0)
+    try:
+        got, version = eng.infer({"x": probe})
+        assert version == 1
+        np.testing.assert_allclose(got[0], want, rtol=1e-5)
+        # ragged sizes pad to the single bucket and slice back
+        got2, _ = eng.infer({"x": probe[:3]})
+        np.testing.assert_allclose(got2[0], want[:3], rtol=1e-5)
+    finally:
+        eng.stop()
+
+
+def test_roundtrip_lenet_engine(tmp_path):
+    """The conv book model through the same path (no training — the
+    artifact round-trip is what's under test)."""
+    from paddle_tpu.models import lenet
+
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    main.random_seed = startup.random_seed = 5
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup), unique_name.guard():
+            img = layers.data(name="img", shape=[1, 28, 28],
+                              dtype="float32")
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            _cost, _acc, prediction = lenet.build(img, label)
+        exe = fluid.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "lenet")
+        fluid.save_inference_model(d, ["img"], [prediction], exe, main)
+        probe = np.random.RandomState(3).rand(2, 1, 28, 28).astype(
+            np.float32)
+        (want,) = exe.run(
+            main, feed={"img": probe,
+                        "label": np.zeros((2, 1), np.int64)},
+            fetch_list=[prediction])
+
+    eng = InferenceEngine.from_inference_dir(
+        d, name="lenet", buckets=[2], max_wait_ms=1.0)
+    try:
+        got, _ = eng.infer({"img": probe})
+        np.testing.assert_allclose(got[0], want, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(got[0].sum(axis=1), 1.0, rtol=1e-4)
+    finally:
+        eng.stop()
+
+
+def test_export_compiled_fast_path(tmp_path):
+    """export_compiled_model -> from_exported_dir: the StableHLO
+    artifact serves (params baked in, no Program/Scope), padding up to
+    the exported batch."""
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup), unique_name.guard():
+            x = layers.data(name="x", shape=[8], dtype="float32")
+            pred = layers.fc(input=x, size=3, act="softmax")
+        exe = fluid.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "deploy")
+        fluid.io.export_compiled_model(
+            d, ["x"], [pred], exe, main_program=main, scope=scope,
+            batch_size=4)
+        probe = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+        (want,) = exe.run(main, feed={"x": probe}, fetch_list=[pred])
+
+    eng = InferenceEngine.from_exported_dir(
+        d, name="deploy", max_wait_ms=1.0)
+    try:
+        assert eng.buckets == [4] and eng.kind == "exported"
+        got, _ = eng.infer({"x": probe})
+        np.testing.assert_allclose(got[0], want, rtol=1e-5)
+        got2, _ = eng.infer({"x": probe[:1]})  # pads 1 -> 4, slices back
+        np.testing.assert_allclose(got2[0], want[:1], rtol=1e-5)
+    finally:
+        eng.stop()
+
+
+def test_load_inference_model_clear_errors(tmp_path):
+    """Satellite fix: missing dir / model file / params payload / var
+    all fail with the offending PATH named, not a deep KeyError."""
+    exe = fluid.Executor()
+    with pytest.raises(IOError, match="does not exist"):
+        fluid.load_inference_model(str(tmp_path / "nope"), exe)
+
+    d = tmp_path / "partial"
+    d.mkdir()
+    with pytest.raises(IOError, match="__model__"):
+        fluid.load_inference_model(str(d), exe)
+
+    d2, _probe, _ref = make_model_dir(str(tmp_path / "ok"))
+    os.remove(os.path.join(d2, "__params__.npz"))
+    with pytest.raises(IOError, match="__params__.npz"):
+        fluid.load_inference_model(d2, exe, scope=fluid.Scope())
+
+    d3, _probe, _ref = make_model_dir(str(tmp_path / "ok2"))
+    p = os.path.join(d3, "__params__.npz")
+    with np.load(p) as payload:
+        arrays = {n: payload[n] for n in payload.files}
+    dropped = sorted(arrays)[0]
+    del arrays[dropped]
+    np.savez(p, **arrays)
+    with pytest.raises(IOError, match=dropped.replace(".", r"\.")):
+        fluid.load_inference_model(d3, exe, scope=fluid.Scope())
+
+
+# --- bucketed batching --------------------------------------------------
+
+def test_bucket_ladder_bounds_jit_compiles(tmp_path):
+    """Mixed arrival sizes never mint more executables than the ladder
+    has entries — the whole point of shape-bucketed batching."""
+    d, probe, ref = make_model_dir(str(tmp_path / "m"))
+    base = _jit_compiles()
+    eng = InferenceEngine.from_inference_dir(
+        d, name="bucketed", buckets=[1, 2, 4], max_wait_ms=1.0)
+    assert _jit_compiles() - base <= 3  # warmup = one compile per bucket
+    try:
+        rng = np.random.RandomState(0)
+        reqs = [rng.rand(b, 8).astype(np.float32)
+                for b in (1, 3, 2, 4, 1, 2, 3, 4, 1, 1)]
+        with ThreadPoolExecutor(max_workers=6) as pool:
+            outs = list(pool.map(lambda a: eng.infer({"x": a}), reqs))
+        for a, (out, _v) in zip(reqs, outs):
+            assert out[0].shape == (a.shape[0], 3)
+        assert _jit_compiles() - base <= 3, \
+            "arrival pattern escaped the bucket ladder"
+        snap = metrics.snapshot(prefix="serving.")
+        assert snap["serving.batch_size"]["count"] >= 1
+        assert snap["serving.padding_waste"]["max"] <= 0.75  # ladder fits
+    finally:
+        eng.stop()
+
+
+def test_constant_dim_fetch_never_missliced(tmp_path):
+    """A fetch with a CONSTANT leading dim (here the fc weight, shape
+    (8, 3)) must come back WHOLE even when its size coincides with a
+    bucket — slicing decisions follow the declared fetch shapes, not
+    the runtime shape[0]==bucket coincidence."""
+    main, startup, scope = Program(), Program(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        with program_guard(main, startup), unique_name.guard():
+            x = layers.data(name="x", shape=[8], dtype="float32")
+            pred = layers.fc(input=x, size=3, act="softmax")
+        w = next(v for v in main.list_vars()
+                 if v.persistable and tuple(v.shape) == (8, 3))
+        exe = fluid.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "m")
+        fluid.save_inference_model(d, ["x"], [pred, w], exe, main)
+        want_w = np.asarray(scope.find_var(w.name))
+
+    # bucket 8 == the weight's leading dim: the trap this test pins
+    eng = InferenceEngine.from_inference_dir(
+        d, name="wfetch", buckets=[8], max_wait_ms=1.0)
+    try:
+        (got_pred, got_w), _v = eng.infer(
+            {"x": np.random.RandomState(0).rand(2, 8).astype(np.float32)})
+        assert got_pred.shape == (2, 3)      # per-row fetch: sliced
+        assert got_w.shape == (8, 3)         # constant-dim fetch: whole
+        np.testing.assert_allclose(got_w, want_w, rtol=1e-6)
+    finally:
+        eng.stop()
+
+
+def test_request_validation_and_too_large(tmp_path):
+    d, probe, _ref = make_model_dir(str(tmp_path / "m"))
+    eng = InferenceEngine.from_inference_dir(
+        d, name="valid", buckets=[1, 2], max_wait_ms=1.0)
+    try:
+        with pytest.raises(ValueError, match="requires feed 'x'"):
+            eng.infer({"y": probe})
+        with pytest.raises(ValueError, match="trailing dims"):
+            eng.infer({"x": np.zeros((2, 5), np.float32)})
+        with pytest.raises(RequestTooLarge, match="largest bucket 2"):
+            eng.infer({"x": np.zeros((3, 8), np.float32)})
+        # dtype sloppiness is conformed, not compiled: float64 in, no
+        # novel jit signature
+        base = _jit_compiles()
+        out, _ = eng.infer({"x": probe[:1].astype(np.float64)})
+        assert out[0].shape == (1, 3)
+        assert _jit_compiles() == base
+    finally:
+        eng.stop()
+
+
+def test_deadline_miss(tmp_path):
+    d, probe, _ref = make_model_dir(str(tmp_path / "m"))
+    eng = InferenceEngine.from_inference_dir(
+        d, name="deadline", buckets=[1, 2], max_wait_ms=250.0)
+    try:
+        # the batching timer (250ms) outlives a 20ms deadline: the
+        # request expires in-queue and is answered with the miss
+        with pytest.raises(DeadlineExceeded):
+            eng.infer({"x": probe[:1]}, deadline_ms=20.0)
+        assert metrics.counter("serving.deadline_misses").value() >= 1
+    finally:
+        eng.stop()
+
+
+def test_overload_rejection_direct(tmp_path):
+    d, probe, _ref = make_model_dir(str(tmp_path / "m"))
+    eng = InferenceEngine.from_inference_dir(
+        d, name="overload", buckets=[1, 2], max_queue=1,
+        max_wait_ms=300.0)
+    try:
+        # first request parks on the batching timer and occupies the
+        # whole (depth-1) queue; the second must be REFUSED immediately
+        req = eng.submit({"x": probe[:1]})
+        with pytest.raises(ServerOverloaded, match="queue is full"):
+            eng.submit({"x": probe[:1]})
+        assert metrics.counter("serving.overloads").value() == 1
+        assert req.ev.wait(10.0) and req.error is None
+    finally:
+        eng.stop()
+
+
+# --- registry / hot-swap lifecycle --------------------------------------
+
+def test_registry_hot_swap_and_rollback(tmp_path):
+    d1, probe, ref1 = make_model_dir(str(tmp_path / "v1"), scale=1.0)
+    d2, _p, ref2 = make_model_dir(str(tmp_path / "v2"), scale=-1.0)
+    reg = ModelRegistry()
+    reg.deploy("m", lambda: InferenceEngine.from_inference_dir(
+        d1, name="m", version=1, buckets=[4], max_wait_ms=1.0))
+    out, v = reg.get("m").infer({"x": probe})
+    assert v == 1
+    np.testing.assert_allclose(out[0], ref1, rtol=1e-5)
+
+    # failed build (bad directory) -> rollback: v1 keeps serving
+    with pytest.raises(IOError, match="does not exist"):
+        reg.deploy("m", lambda: InferenceEngine.from_inference_dir(
+            str(tmp_path / "missing"), name="m", version=9))
+    out, v = reg.get("m").infer({"x": probe})
+    assert v == 1
+
+    reg.deploy("m", lambda: InferenceEngine.from_inference_dir(
+        d2, name="m", version=2, buckets=[4], max_wait_ms=1.0))
+    out, v = reg.get("m").infer({"x": probe})
+    assert v == 2
+    np.testing.assert_allclose(out[0], ref2, rtol=1e-5)
+    assert metrics.counter("serving.hot_swaps").value() == 1
+    with pytest.raises(ModelNotFound):
+        reg.get("ghost")
+    reg.unload_all()
+    with pytest.raises(ModelNotFound):
+        reg.get("m")
+
+
+def test_hot_swap_releases_old_jit_cache(tmp_path):
+    """Satellite: the jit-cache LIFECYCLE guarantee. The executor cache
+    is a WeakKeyDictionary keyed by Program whose values (jitted fns)
+    strongly reference their Program — so the only way old versions are
+    ever freed is the engine dropping its whole Executor on retirement.
+    Assert via weakref that NOTHING pins a retired version's Program,
+    across several flips (many flips must not accumulate executables)."""
+    d, probe, _ref = make_model_dir(str(tmp_path / "m"))
+    reg = ModelRegistry()
+    refs = []
+    for version in range(1, 4):
+        reg.deploy("m", lambda v=version: InferenceEngine.from_inference_dir(
+            d, name="m", version=v, buckets=[2], max_wait_ms=1.0))
+        eng = reg.get("m")
+        out, v = eng.infer({"x": probe[:2]})
+        assert v == version
+        refs.append(weakref.ref(eng.program))
+    reg.unload_all()
+    gc.collect()
+    dangling = [i + 1 for i, r in enumerate(refs) if r() is not None]
+    assert not dangling, \
+        f"retired version(s) {dangling} still pin their Program " \
+        "(compiled executables leak across hot-swaps)"
+
+
+def test_swap_drains_in_flight_requests(tmp_path):
+    """A request admitted before the flip completes on the OLD engine —
+    stop(drain=True) means a deploy never drops in-flight work."""
+    d1, probe, ref1 = make_model_dir(str(tmp_path / "v1"), scale=1.0)
+    d2, _p, _r = make_model_dir(str(tmp_path / "v2"), scale=-1.0)
+    reg = ModelRegistry()
+    reg.deploy("m", lambda: InferenceEngine.from_inference_dir(
+        d1, name="m", version=1, buckets=[4], max_wait_ms=400.0))
+    # park a request on v1's batching timer, then swap: the drain must
+    # complete it (with v1's weights) before the old engine releases
+    req = reg.get("m").submit({"x": probe})
+    reg.deploy("m", lambda: InferenceEngine.from_inference_dir(
+        d2, name="m", version=2, buckets=[4], max_wait_ms=1.0))
+    assert req.ev.wait(10.0), "in-flight request dropped by hot-swap"
+    assert req.error is None
+    np.testing.assert_allclose(req.result[0], ref1, rtol=1e-5)
+    reg.unload_all()
+
+
+# --- RPC server / client ------------------------------------------------
+
+@pytest.fixture
+def serving_pair(tmp_path):
+    srv = ServingServer()
+    addr = srv.serve()
+    cli = ServingClient(addr)
+    yield srv, cli, addr
+    cli.close()
+    srv.shutdown()
+
+
+def test_server_basic_methods(serving_pair, tmp_path):
+    srv, cli, _addr = serving_pair
+    d, probe, ref = make_model_dir(str(tmp_path / "m"))
+    info = cli.load_model("m", d, buckets=[1, 2, 4], max_wait_ms=1.0)
+    assert info["version"] == 1 and info["buckets"] == [1, 2, 4]
+    assert cli.health() == {"ok": True, "models": ["m"]}
+    out, v = cli.infer("m", {"x": probe})
+    assert v == 1
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5)
+    with pytest.raises(ModelNotFound):
+        cli.infer("ghost", {"x": probe})
+    listed = cli.list_models()
+    assert listed["m"]["requests"] >= 1
+    final = cli.unload_model("m")
+    assert final["version"] == 1
+    assert cli.health() == {"ok": True, "models": []}
+
+
+def test_statusz_serving_section(monkeypatch, tmp_path):
+    """The debug server's /statusz carries the serving section: models,
+    versions, bucket ladder, queue depth."""
+    monkeypatch.setenv("PADDLE_TPU_DEBUG_PORT", "0")
+    from paddle_tpu.observability import debug_server
+
+    srv = ServingServer()
+    addr = srv.serve()
+    cli = ServingClient(addr)
+    try:
+        d, probe, _ref = make_model_dir(str(tmp_path / "m"))
+        cli.load_model("m", d, buckets=[1, 2], max_wait_ms=1.0)
+        cli.infer("m", {"x": probe[:1]})
+        dbg = debug_server.shared_server()
+        assert dbg is not None
+        host, port = dbg.address
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/statusz", timeout=10).read()
+        # per-instance section name: two servers must not clobber
+        status = json.loads(body)[f"serving:{addr[1]}"]
+        m = status["models"]["m"]
+        assert m["version"] == 1
+        assert m["buckets"] == [1, 2]
+        assert "queue_depth" in m and "max_queue" in m
+        assert "infer" in status["rpc"]["methods"]
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
+def test_trace_links_client_server_engine(serving_pair, tmp_path):
+    """Tentpole observability claim: with tracing on, one trace carries
+    rpc.client.infer -> rpc.server.infer -> serving.request, and the
+    engine's serving.batch span (scheduler THREAD) adopts the
+    batch-triggering request's context — client -> server -> engine on
+    one merged timeline."""
+    from paddle_tpu.observability import tracing
+
+    srv, cli, _addr = serving_pair
+    d, probe, _ref = make_model_dir(str(tmp_path / "m"))
+    cli.load_model("m", d, buckets=[4], max_wait_ms=1.0)
+    tracing.trace_enable(buffer_size=4096)
+    try:
+        cli.infer("m", {"x": probe})
+        events = tracing.trace_events()
+    finally:
+        tracing.trace_disable()
+    by_name = {}
+    for e in events:
+        if e.get("ph") == "X" and "trace_id" in e.get("args", {}):
+            by_name.setdefault(e["name"], []).append(e["args"])
+    for name in ("rpc.client.infer", "rpc.server.infer",
+                 "serving.request", "serving.batch"):
+        assert by_name.get(name), f"no traced {name} span"
+    tid = by_name["rpc.client.infer"][-1]["trace_id"]
+    assert by_name["rpc.server.infer"][-1]["trace_id"] == tid
+    assert by_name["serving.request"][-1]["trace_id"] == tid
+    assert by_name["serving.batch"][-1]["trace_id"] == tid
+    # the engine span's parent is the submitting request's span
+    assert by_name["serving.batch"][-1]["parent_span_id"] == \
+        by_name["serving.request"][-1]["span_id"]
+
+
+@pytest.mark.chaos
+def test_infer_reply_dropped_retry_is_dedup_exact(serving_pair, tmp_path):
+    """Satellite chaos test: kill the serving.infer REPLY mid-frame. The
+    client retransmits under its idempotency token; the server answers
+    from the dedup cache — same answer, the engine executed exactly
+    once, and every counter agrees."""
+    srv, cli, _addr = serving_pair
+    d, probe, ref = make_model_dir(str(tmp_path / "m"))
+    cli.load_model("m", d, buckets=[4], max_wait_ms=1.0)
+    metrics.reset_metrics()  # isolate the faulted call's counters
+    with faults.scoped("drop@recv.infer:0") as plan:
+        out, v = cli.infer("m", {"x": probe})
+    assert [(k, s) for k, s, _i in plan.injected()] == [("drop",
+                                                         "recv.infer")]
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5)
+    # exactly one retransmission, answered exactly once from the cache,
+    # with exactly one engine-side execution behind both deliveries
+    assert metrics.counter("rpc.client.retries").value() == 1
+    assert metrics.counter("rpc.server.dedup_hits").value() == 1
+    assert metrics.counter("serving.requests").value() == 1
+    assert metrics.counter("serving.batches").value() == 1
+
+
+@pytest.mark.chaos
+def test_serving_fault_site_reaches_handler(serving_pair, tmp_path):
+    """The serving.<method> fault family is live: an error plan at
+    serving.infer surfaces as an application error (not retried), and
+    the next call works."""
+    srv, cli, _addr = serving_pair
+    d, probe, ref = make_model_dir(str(tmp_path / "m"))
+    cli.load_model("m", d, buckets=[4], max_wait_ms=1.0)
+    with faults.scoped("error@serving.infer:0"):
+        with pytest.raises(RuntimeError, match="injected error"):
+            cli.infer("m", {"x": probe})
+        out, _v = cli.infer("m", {"x": probe})
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5)
+
+
+# --- end-to-end acceptance ----------------------------------------------
+
+def test_serving_acceptance(serving_pair, tmp_path):
+    """ISSUE 5 acceptance: two models, >= 200 concurrent-ish requests of
+    mixed batch shapes, (a) jit compiles bounded by the bucket ladder,
+    (b) a mid-run hot-swap with zero failed requests and the served
+    version flipping, (c) queue-shrink overload rejections while
+    accepted requests still meet their deadline — all visible in the
+    metrics snapshot."""
+    srv, cli, addr = serving_pair
+    d_a1, probe_a, ref_a1 = make_model_dir(str(tmp_path / "a1"), scale=1.0)
+    d_a2, _p, ref_a2 = make_model_dir(str(tmp_path / "a2"), scale=-1.0)
+    d_b, probe_b, ref_b = make_model_dir(
+        str(tmp_path / "b"), scale=0.5, feature_dim=5, classes=2)
+
+    base_compiles = _jit_compiles()
+    cli.load_model("a", d_a1, version=1, buckets=[1, 2, 4], max_wait_ms=2.0)
+    cli.load_model("b", d_b, version=1, buckets=[1, 2, 4], max_wait_ms=2.0)
+    expected = {"a": {1: ref_a1, 2: ref_a2}, "b": {1: ref_b}}
+    probes = {"a": probe_a, "b": probe_b}
+
+    n_threads, per_thread = 8, 25  # 200 requests + 8 post-swap probes
+    failures = []
+    versions_seen = {"a": set(), "b": set()}
+    mu = threading.Lock()
+    swap_done = threading.Event()
+
+    def worker(tid):
+        wcli = ServingClient(addr)
+        rng = np.random.RandomState(tid)
+
+        def one(model, rows):
+            out, ver = wcli.infer(model, {"x": probes[model][:rows]},
+                                  deadline_ms=60000.0)
+            want = expected[model][ver][:rows]
+            if not np.allclose(out[0], want, atol=1e-4):
+                raise AssertionError(
+                    f"{model} v{ver} rows={rows}: wrong answer")
+            with mu:
+                versions_seen[model].add(ver)
+
+        try:
+            for i in range(per_thread):
+                one("b" if (tid + i) % 4 == 0 else "a",
+                    1 + int(rng.randint(4)))  # mixed batch shapes
+            # one request guaranteed AFTER the deploy finished, so the
+            # version-flip observation cannot race a slow host (the
+            # swap may otherwise complete after the fixed workload)
+            assert swap_done.wait(180), "swap never completed"
+            one("a", 1)
+        except BaseException as e:
+            with mu:
+                failures.append(f"thread {tid}: {type(e).__name__}: {e}")
+        finally:
+            wcli.close()
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    # (b) hot-swap model "a" to v2 MID-RUN
+    time.sleep(0.25)
+    cli.load_model("a", d_a2, version=2, buckets=[1, 2, 4], max_wait_ms=2.0)
+    swap_done.set()
+    for t in threads:
+        t.join(300)
+    assert not failures, failures  # zero failed requests through the swap
+    assert versions_seen["a"] >= {2}, versions_seen
+    out, ver = cli.infer("a", {"x": probe_a})
+    assert ver == 2  # the observable version flipped
+    np.testing.assert_allclose(out[0], ref_a2, atol=1e-4)
+
+    # (a) bucketing bounds recompiles: 3 deployed engines x ladder of 3
+    compiles = _jit_compiles() - base_compiles
+    assert compiles <= 3 * 3, \
+        f"{compiles} compiles for 3 model versions x 3-bucket ladder"
+
+    # (c) shrink the queue bound under load -> structured rejections,
+    # while the accepted request still answers within its deadline
+    engine = srv.registry.get("a")
+    engine.set_max_queue(1)
+    # the long (1.5s) batching timer makes the rejection DETERMINISTIC
+    # even on a badly contended host: the first admitted request parks
+    # on the timer occupying the whole depth-1 queue, so any flood
+    # request landing within that window must be refused
+    cli.load_model("a", d_a2, version=3, buckets=[1, 2, 4],
+                   max_queue=1, max_wait_ms=1500.0)
+    served, refused = [], []
+
+    def flood(i):
+        fcli = ServingClient(addr)
+        try:
+            t0 = time.monotonic()
+            out, _v = fcli.infer("a", {"x": probes["a"][:1]},
+                                 deadline_ms=30000.0)
+            served.append(time.monotonic() - t0)
+            assert np.allclose(out[0], ref_a2[:1], atol=1e-4)
+        except ServerOverloaded:
+            refused.append(i)
+        finally:
+            fcli.close()
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        list(pool.map(flood, range(8)))
+    assert refused, "no ServerOverloaded under a depth-1 queue flood"
+    assert served and max(served) < 30.0  # accepted met their deadline
+
+    # all of it visible in the metrics snapshot
+    snap = metrics.snapshot(prefix="serving.")
+    assert snap["serving.queue_wait_ms"]["count"] > 0
+    assert snap["serving.compute_ms"]["count"] > 0
+    assert snap["serving.batch_size"]["count"] > 0
+    assert snap["serving.overloads"] >= len(refused)
+    assert snap["serving.hot_swaps"] >= 2
+    assert metrics.counter("serving.deadline_misses").value() == 0
+
+
+# --- slow lane: CLI selftest + bench smoke ------------------------------
+
+@pytest.mark.slow
+def test_serving_selftest_cli():
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.serving", "--selftest"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "serving selftest: OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_serving_bench_smoke():
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/serving_bench.py", "--smoke"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    evidence = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert evidence["completed"] > 0
+    assert evidence["p99_ms"] >= evidence["p50_ms"] > 0
+    assert "padding_waste" in evidence and "framework_metrics" in evidence

@@ -412,6 +412,34 @@ def test_fault_sites_of_the_real_runtime_are_declared():
     assert any(p.startswith("recv.") for p in patterns)
 
 
+def test_serving_registry_families_collected():
+    """ISSUE 5 satellite: the serving subsystem's fault sites, metric/
+    span names, and FLAGS keys are all first-class registry members —
+    drift in any of them is an N201/N202/N203 error, not silence."""
+    pkg = invariants._repo_root() + "/paddle_tpu"
+    _exact_sites, site_patterns = invariants.collect_declared_sites(pkg)
+    # the f-string family fire(f"serving.{method}") declares the
+    # wildcard, so chaos specs may target any serving method by name
+    assert "serving.*" in site_patterns
+    names = invariants.collect_declared_names(pkg)
+    universe = invariants.NameUniverse(names, (_exact_sites, site_patterns))
+    for n in ("serving.queue_wait_ms", "serving.batch_assemble_ms",
+              "serving.compute_ms", "serving.total_ms",
+              "serving.batch_size", "serving.padding_waste",
+              "serving.requests", "serving.overloads",
+              "serving.deadline_misses", "serving.hot_swaps",
+              "serving.swap_resubmits", "serving.batch",
+              "serving.warmup", "serving.request", "serving.infer"):
+        assert universe.resolves(n), n
+    # NOTE: no negative case under the serving prefix — the serving.*
+    # site family legitimately claims every serving.<method> spelling
+    assert any(p.startswith("serving.queue_depth.") for p in names[1])
+    defined = invariants.collect_defined_flags(
+        invariants._repo_root() + "/paddle_tpu/fluid/flags.py")
+    for k in ("serving_buckets", "serving_max_queue", "serving_max_wait_ms"):
+        assert k in defined
+
+
 def test_flags_keys_all_defined():
     root = invariants._repo_root()
     defined = invariants.collect_defined_flags(

@@ -14,7 +14,10 @@ locally" and "works in CI" are the same claim:
   2. `python -m paddle_tpu.analysis`              (repo + book programs;
                                                    exit-nonzero on any
                                                    error-level diagnostic)
-  3. `python -m pytest tests/ --collect-only -q`  (imports every test
+  3. `python -m paddle_tpu.serving --selftest`    (in-process serving
+                                                   smoke: bucketed batch,
+                                                   hot-swap, overload)
+  4. `python -m pytest tests/ --collect-only -q`  (imports every test
                                                    module under
                                                    --strict-markers: a
                                                    bad import or an
@@ -63,6 +66,8 @@ def main(argv=None) -> int:
     if args.fast:
         analysis_cmd.append("--no-shapes")
     rc |= _run("static analysis", analysis_cmd)
+    rc |= _run("serving selftest",
+               [py, "-m", "paddle_tpu.serving", "--selftest"])
     rc |= _run("pytest collect smoke",
                [py, "-m", "pytest", "tests/", "--collect-only", "-q",
                 "-p", "no:cacheprovider"])
