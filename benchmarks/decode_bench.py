@@ -1,0 +1,243 @@
+"""Decode-serving benchmark: tokens/s for three decode strategies over
+the SAME seeded toy decoder and the SAME mixed-length workload
+(ISSUE 6 acceptance evidence -> BENCH_SESSION_r07.json):
+
+  continuous — DecodeEngine(continuous=True): paged KV cache, new
+               sequences admitted into in-flight decode steps as slots
+               free (the tentpole).
+  drain      — DecodeEngine(continuous=False): same engine, same
+               compiled shapes, but a batch must fully complete before
+               the next is admitted — finished slots idle behind the
+               longest straggler.
+  reprefill  — the no-KV-cache strawman: every generated token
+               recomputes dense attention over the ENTIRE prefix
+               (prefix length padded to a power-of-two ladder so the
+               strawman is not ALSO compile-bound — it loses on
+               recompute alone, which is the honest comparison).
+
+The workload is submitted as one burst (every strategy sees the
+identical queue), wall time runs from first submit to last completion,
+and tokens/s counts GENERATED tokens only. The framework_metrics
+snapshot rides the evidence (decode step counts, occupancy histogram,
+KV pool gauges), per benchmarks/_timing.py convention.
+
+Env knobs:
+    DEC_REQUESTS    workload size              (default 48; smoke 16)
+    DEC_SLOTS       slot ladder                (default "1,2,4")
+    DEC_PAGE        KV page size               (default 4)
+    DEC_MAXSEQ      per-sequence token cap     (default 32; smoke 16)
+    DEC_PROMPT_MAX  max prompt length          (default 8; smoke 4)
+    DEC_NEW_MAX     max generated per request  (default 16; smoke 8)
+    --smoke         tiny fixed run for CI's slow lane
+"""
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _timing import framework_metrics  # noqa: E402
+
+SMOKE = "--smoke" in sys.argv
+REQUESTS = int(os.environ.get("DEC_REQUESTS", "16" if SMOKE else "48"))
+SLOTS = [int(s) for s in os.environ.get("DEC_SLOTS", "1,2,4").split(",")]
+PAGE = int(os.environ.get("DEC_PAGE", "4"))
+MAXSEQ = int(os.environ.get("DEC_MAXSEQ", "16" if SMOKE else "32"))
+PROMPT_MAX = int(os.environ.get("DEC_PROMPT_MAX", "4" if SMOKE else "8"))
+NEW_MAX = int(os.environ.get("DEC_NEW_MAX", "8" if SMOKE else "16"))
+if PROMPT_MAX >= MAXSEQ:
+    sys.exit(f"DEC_PROMPT_MAX ({PROMPT_MAX}) must be < DEC_MAXSEQ "
+             f"({MAXSEQ}): every sequence needs room for >= 1 new token")
+
+
+def _workload(seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(REQUESTS):
+        plen = 1 + int(rng.randint(PROMPT_MAX))
+        max_new = 1 + int(rng.randint(min(NEW_MAX, MAXSEQ - plen)))
+        out.append((rng.randint(0, 32, size=plen).astype(np.int32),
+                    max_new))
+    return out
+
+
+def _counters(*names):
+    from paddle_tpu.observability import metrics
+
+    return {n: metrics.counter(n).value() for n in names}
+
+
+def _occupancy():
+    """(sum, count) of the occupancy histogram — process-global, so
+    each engine row must delta it, same as the counters."""
+    from paddle_tpu.observability import metrics
+
+    o = metrics.snapshot().get("serving.decode.occupancy", {})
+    return float(o.get("sum", 0.0)), int(o.get("count", 0))
+
+
+def run_engine(spec, workload, continuous):
+    from paddle_tpu.serving import DecodeEngine
+
+    # pool sized for the whole burst: pages are reserved at admission
+    pages = 1 + sum(-(-(len(p) + n) // PAGE) for p, n in workload)
+    names = ("serving.decode.steps", "serving.decode.compiles",
+             "serving.decode.completions", "serving.decode.tokens")
+    eng = DecodeEngine(spec, name="bench", slots=SLOTS, page_size=PAGE,
+                       num_pages=pages, max_seq_len=MAXSEQ,
+                       max_queue=len(workload) + 1, continuous=continuous)
+    try:
+        before = _counters(*names)
+        occ_sum0, occ_n0 = _occupancy()
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=n) for p, n in workload]
+        for r in reqs:
+            assert r.ev.wait(600), "decode wedged"
+            assert r.error is None, r.error
+        wall = time.perf_counter() - t0
+        after = _counters(*names)
+        toks = after["serving.decode.tokens"] - \
+            before["serving.decode.tokens"]
+        occ_sum1, occ_n1 = _occupancy()
+        return {
+            "mode": "continuous" if continuous else "drain",
+            "wall_s": round(wall, 3),
+            "generated_tokens": int(toks),
+            "tokens_per_s": round(toks / wall, 2),
+            "decode_steps": after["serving.decode.steps"]
+            - before["serving.decode.steps"],
+            # `before` is captured after the constructor's warm(), so
+            # this delta is exactly the churn's new compiles (target: 0)
+            "post_warm_compiles": after["serving.decode.compiles"]
+            - before["serving.decode.compiles"],
+            "warmed_shapes": sorted(eng._compiled_shapes),
+            "occupancy_mean": round((occ_sum1 - occ_sum0)
+                                    / max(occ_n1 - occ_n0, 1), 3),
+            "kv": eng.cache.allocator.stats(),
+        }
+    finally:
+        eng.stop()
+
+
+def run_reprefill(spec, workload):
+    """The strawman: full dense causal forward over the whole prefix
+    per generated token. Prefix padded to a power-of-two ladder, one
+    compile per (ladder length)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving.decode import (_ln, _pos_encoding,
+                                           build_decoder_params)
+
+    params = build_decoder_params(spec)
+    dm, dh = spec.d_model, spec.head_dim
+
+    def fwd(params, toks, true_len):
+        t = toks.shape[0]
+        x = params["tok_emb"][toks] * math.sqrt(dm) + \
+            _pos_encoding(jnp.arange(t), dm)
+        pos = jnp.arange(t)
+        keep = (pos[None, :] <= pos[:, None]) & \
+            (pos[None, :] < true_len)                       # causal+pad
+        for l in range(spec.n_layers):
+            lp = params[f"layer{l}"]
+            h = _ln(x, lp["ln1"])
+            q = (h @ lp["wq"]).reshape(t, spec.n_heads, dh)
+            k = (h @ lp["wk"]).reshape(t, spec.n_kv_heads, dh)
+            v = (h @ lp["wv"]).reshape(t, spec.n_kv_heads, dh)
+            rep = spec.n_heads // spec.n_kv_heads
+            if rep > 1:
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
+            s = jnp.einsum("thd,shd->hts", q, k) * dh ** -0.5
+            s = jnp.where(keep[None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("hts,shd->thd", p, v)
+            x = x + attn.reshape(t, spec.n_heads * dh) @ lp["wo"]
+            h2 = _ln(x, lp["ln2"])
+            x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+        # only the last real position's logits are ever used — the
+        # T-long forward is the strawman's waste, on purpose
+        return _ln(x[true_len - 1], params["lnf"]) @ params["tok_emb"].T
+
+    jfwd = jax.jit(fwd)
+
+    # the strawman buckets lengths exactly like the engines bucket
+    # their padded dims — same helpers, so the rules can't diverge
+    from paddle_tpu.serving.decode import width_ladder
+    from paddle_tpu.serving.engine import bucket_for
+
+    ladder = width_ladder(MAXSEQ)
+
+    def bucket(n):
+        return bucket_for(ladder, n)
+
+    # pre-compile the length ladder so the timed loop is compile-free
+    for t in ladder:
+        jfwd(params, jnp.zeros((t,), jnp.int32), 1)
+
+    toks_total = 0
+    forwards = 0
+    t0 = time.perf_counter()
+    for prompt, max_new in workload:
+        prefix = list(prompt)
+        for _ in range(max_new):
+            t = bucket(len(prefix))
+            padded = np.zeros((t,), np.int32)
+            padded[:len(prefix)] = prefix
+            logits = jfwd(params, padded, len(prefix))
+            prefix.append(int(np.argmax(np.asarray(logits))))
+            toks_total += 1
+            forwards += 1
+    wall = time.perf_counter() - t0
+    return {
+        "mode": "reprefill-per-token",
+        "wall_s": round(wall, 3),
+        "generated_tokens": toks_total,
+        "tokens_per_s": round(toks_total / wall, 2),
+        "full_forwards": forwards,
+        "length_ladder": ladder,
+    }
+
+
+def main() -> int:
+    from paddle_tpu.serving import DecoderSpec
+
+    spec = DecoderSpec(vocab=32, d_model=16, n_layers=2, n_heads=2,
+                       n_kv_heads=1, seed=7)
+    workload = _workload()
+    rows = {}
+    for continuous in (False, True):
+        rows["continuous" if continuous else "drain"] = run_engine(
+            spec, workload, continuous)
+    rows["reprefill"] = run_reprefill(spec, workload)
+    cont, drain, straw = (rows["continuous"], rows["drain"],
+                          rows["reprefill"])
+    evidence = {
+        "what": "decode_bench: continuous batching vs drain-per-batch vs "
+                "re-prefill-per-token, identical workload + decoder",
+        "smoke": SMOKE,
+        "spec": spec.to_dict(),
+        "requests": REQUESTS,
+        "slot_ladder": SLOTS,
+        "page_size": PAGE,
+        "max_seq_len": MAXSEQ,
+        "prompt_max": PROMPT_MAX,
+        "new_max": NEW_MAX,
+        "results": rows,
+        "speedup_continuous_vs_drain": round(
+            cont["tokens_per_s"] / max(drain["tokens_per_s"], 1e-9), 3),
+        "speedup_continuous_vs_reprefill": round(
+            cont["tokens_per_s"] / max(straw["tokens_per_s"], 1e-9), 3),
+        "framework_metrics": framework_metrics(),
+    }
+    print(json.dumps(evidence))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

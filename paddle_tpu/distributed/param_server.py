@@ -568,13 +568,22 @@ class ParameterClient:
         return resp
 
     def get_param(self, name: str) -> np.ndarray:
-        return self._client(name).call("get_param", name)
+        """Zero-copy pull: the result is a READ-ONLY view over the RPC
+        frame's bytes (copy_result=False) — get_param is an idempotent
+        read whose result feeds device transfer/math; the old
+        per-segment receive copy was pure overhead on the largest
+        tensors the wire carries. Callers needing in-place mutation
+        must .copy() (numpy raises on write, so misuse is loud)."""
+        return self._client(name).call("get_param", name,
+                                       copy_result=False)
 
     def get_rows(self, name: str, rows) -> np.ndarray:
         """Pull only the given rows of a (large) table — the trainer-side
-        half of the reference's prefetch_op."""
+        half of the reference's prefetch_op. Read-only zero-copy view,
+        like get_param."""
         return self._client(name).call(
-            "get_rows", name, np.asarray(rows, dtype=np.int64))
+            "get_rows", name, np.asarray(rows, dtype=np.int64),
+            copy_result=False)
 
     def barrier(self, known_round=None):
         """Wait until the round this client's sends joined has fully

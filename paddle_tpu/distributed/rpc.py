@@ -106,7 +106,16 @@ def to_wire(obj, segs: Optional[list] = None):
     return obj
 
 
-def from_wire(obj, segs: Optional[list] = None):
+def from_wire(obj, segs: Optional[list] = None, copy: bool = True):
+    """Decode a wire object. ``copy=True`` (default) materializes each
+    tensor as a fresh writable array. ``copy=False`` returns NON-
+    WRITEABLE views straight over the frame's segment bytes — zero
+    receive-side copies, the right mode for read-path results
+    (get_param pulls) that are immediately consumed by `jnp.asarray` /
+    math; callers that need to mutate in place must copy themselves
+    (numpy raises on write, so misuse is loud, never silent
+    corruption). The view pins its frame's bytes alive exactly as long
+    as the array — same peak memory as the copy, minus the copy."""
     from ..fluid.selected_rows import SelectedRows
 
     if isinstance(obj, dict):
@@ -122,16 +131,19 @@ def from_wire(obj, segs: Optional[list] = None):
             arr = np.frombuffer(
                 raw, dtype=np.dtype(spec["dtype"])
             ).reshape(spec["shape"])
-            return arr.copy()  # writable, owns its memory
+            # frombuffer over immutable bytes is already read-only; the
+            # copy is what makes it writable (and owner of its memory)
+            return arr.copy() if copy else arr
         if "__sr__" in obj and len(obj) == 1:
             spec = obj["__sr__"]
             return SelectedRows(
-                from_wire(spec["rows"], segs), from_wire(spec["value"], segs),
+                from_wire(spec["rows"], segs, copy),
+                from_wire(spec["value"], segs, copy),
                 int(spec["height"]),
             )
-        return {k: from_wire(v, segs) for k, v in obj.items()}
+        return {k: from_wire(v, segs, copy) for k, v in obj.items()}
     if isinstance(obj, list):
-        return [from_wire(v, segs) for v in obj]
+        return [from_wire(v, segs, copy) for v in obj]
     return obj
 
 
@@ -528,7 +540,13 @@ class RpcClient:
         self._client_id = uuid.uuid4().hex[:16]
         self._seq = 0
 
-    def call(self, method: str, *args):
+    def call(self, method: str, *args, copy_result: bool = True):
+        """``copy_result=False``: tensors in the response come back as
+        read-only views over the received frame bytes — zero receive-
+        side copies, for read-path results (get_param/get_rows pulls)
+        that feed straight into `jnp.asarray`/math. The default stays a
+        writable copy so callers that mutate results in place keep
+        working."""
         t0 = time.perf_counter()
         # lint: allow-blocking — _mu deliberately serializes calls (and
         # their retry sleeps) on this client's single connection: two
@@ -587,7 +605,7 @@ class RpcClient:
         if not resp.get("ok"):
             _m_cli_errors.inc()
             raise RuntimeError(f"RPC {method} failed: {resp.get('error')}")
-        return from_wire(resp.get("result"), segs)
+        return from_wire(resp.get("result"), segs, copy=copy_result)
 
     def _attempt(self, method: str, req: dict):
         """One connect+send+recv try. Exceptions are tagged with

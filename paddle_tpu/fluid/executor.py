@@ -339,8 +339,11 @@ def _run_recv_ops(recv_ops, scope: Scope):
             ep = eps.get(name)
             if ep is None:
                 raise ValueError(f"recv op has no endpoint for '{name}'")
+            # copy_result=False: the pulled tensor is a read-only view
+            # over the RPC frame, consumed straight into jnp.asarray —
+            # the old receive-side host copy was pure overhead
             scope.set_var(name, jnp.asarray(get_client(ep).call(
-                "get_param", name)))
+                "get_param", name, copy_result=False)))
 
 
 def _run_prefetch_ops(prefetch_ops, feed_arrays: Dict[str, Any],
@@ -371,8 +374,10 @@ def _run_prefetch_ops(prefetch_ops, feed_arrays: Dict[str, Any],
         pad_fill = uniq[0] if uniq.size else 0
         uniq_padded = np.full((cap,), pad_fill, dtype=np.int64)
         uniq_padded[:uniq.size] = uniq
+        # copy_result=False: the sub-table is a read-only view over the
+        # RPC frame; copy-on-write below only when a row must be zeroed
         sub = np.asarray(get_client(attrs["endpoint"]).call(
-            "get_rows", attrs["param"], uniq_padded))
+            "get_rows", attrs["param"], uniq_padded, copy_result=False))
         padding_idx = int(attrs.get("padding_idx", -1))
         if padding_idx != -1:
             # the op-level padding zeroing was disabled at transpile time;
@@ -380,6 +385,8 @@ def _run_prefetch_ops(prefetch_ops, feed_arrays: Dict[str, Any],
             # exactly one row, so this is equivalent)
             pos = np.searchsorted(uniq, padding_idx)
             if pos < uniq.size and uniq[pos] == padding_idx:
+                if not sub.flags.writeable:
+                    sub = sub.copy()
                 sub[pos] = 0
         feed_arrays[sub_name] = sub
         feed_arrays[remap_name] = inverse.reshape(ids.shape).astype(np.int64)
@@ -470,8 +477,11 @@ def _run_send_ops(send_ops, values: Dict[str, Any],
                     get_client(ep, channel=f"barrier.{trainer_id}").call(
                         "barrier", push_round[ep], trainer_id)
             for name in out_names:
+                # copy_result=False: consumed straight into jnp.asarray,
+                # same zero-copy receive as _run_recv_ops above
                 scope.set_var(name, jnp.asarray(
-                    get_client(recv_eps[name]).call("get_param", name)))
+                    get_client(recv_eps[name]).call(
+                        "get_param", name, copy_result=False)))
 
 
 _IO_OP_TYPES = frozenset({"save", "save_combine", "load", "load_combine"})

@@ -116,6 +116,26 @@ FLAGS: Dict[str, Any] = _Flags({
     # batching timer: the oldest queued request waits at most this long
     # for batch-mates before its (possibly underfull) batch launches
     "serving_max_wait_ms": 5.0,
+    # decode serving (paddle_tpu/serving/decode.py, ISSUE 6). The slot
+    # ladder is the decode analogue of serving_buckets: the fixed-slot
+    # decode batch pads its slot count up to the next ladder entry, so
+    # (together with the derived page-table-width ladder) the decode
+    # step's jit cache is bounded at |slots| x |widths| shapes, all
+    # pre-compiled at warm
+    "decode_slots": "1,2,4",
+    # KV page granularity in tokens. Smaller pages = less internal
+    # fragmentation (reserve-at-admission rounds each sequence up to
+    # whole pages) but wider page tables; 16 matches one v5e sublane
+    # group of bf16 KV rows per head
+    "kv_page_size": 16,
+    # preallocated KV pool size in pages (page 0 is the reserved
+    # garbage page): pages x page_size bounds decode HBM INDEPENDENT of
+    # ragged sequence lengths — this is the decode admission bound
+    "kv_num_pages": 128,
+    # per-sequence cap on prompt + generated tokens; also sets the
+    # page-table width ladder (ceil(max_seq_len / kv_page_size) is the
+    # widest compiled table)
+    "decode_max_seq_len": 128,
 })
 
 

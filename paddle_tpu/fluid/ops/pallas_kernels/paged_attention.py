@@ -1,0 +1,202 @@
+"""Ragged paged attention for single-token decode (PAPERS.md: Ragged
+Paged Attention).
+
+The decode-serving shape problem: each live sequence has a different KV
+length that grows every step. Dense batched attention would need either
+one compiled program per ragged length combination (O(shapes) jit
+entries) or padding every sequence's K/V to max length (HBM ∝ max_len).
+Here K/V live in a paged pool (serving/kv_cache.py) and the kernel
+reads them THROUGH per-sequence page tables, so one compiled shape —
+``[slots, table_width]`` — serves every ragged length mix up to
+``table_width * page_size`` tokens.
+
+Layouts (one query token per sequence — the decode step):
+
+    q            [B, Hq, D]            this step's query per slot
+    k/v_pages    [P, page_size, Hkv, D]   the shared page pool
+    page_tables  [B, W] int32          page ids per slot, GARBAGE-padded
+    kv_lens      [B] int32             valid keys per slot (0 = dead)
+
+GQA: ``Hq % Hkv == 0``; query head h attends kv head ``h // (Hq/Hkv)``.
+Dead slots (kv_lens == 0) produce exact zeros.
+
+Two implementations with IDENTICAL semantics (A/B-tested against each
+other and against the flash kernel's dense path in
+tests/test_decode_serving.py):
+
+  - ``paged_attention_reference`` — pure-jax gather (k_pages[tables]):
+    the CPU path tier-1 exercises, and the numerics oracle.
+  - ``_paged_attention_pallas`` — a Pallas TPU kernel on grid
+    ``(B, W)`` with the page table as a SCALAR-PREFETCH operand: the
+    BlockSpec index_map reads ``tables[b, w]`` so the pipeline DMAs
+    exactly the pages each sequence owns, page by page, with an online
+    softmax across pages (flash-attention style running max/sum) —
+    the [B, W*page_size] score matrix never materializes.
+
+``paged_attention`` routes between them via flags (the same
+``use_pallas_kernels`` surface that routes flash attention; decode
+attention is bandwidth-bound so there is no ``flash_min_seq``-style
+crossover — on TPU the paged kernel always wins over gather-then-dense,
+which would materialize every page table's worth of K/V per step).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+__all__ = ["paged_attention", "paged_attention_reference"]
+
+
+def _check_shapes(q, k_pages, v_pages, page_tables, kv_lens):
+    b, hq, d = q.shape
+    p, ps, hkv, d2 = k_pages.shape
+    if v_pages.shape != k_pages.shape:
+        raise ValueError(f"k_pages {k_pages.shape} != v_pages "
+                         f"{v_pages.shape}")
+    if d2 != d:
+        raise ValueError(f"head_dim mismatch: q has {d}, pages have {d2}")
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads "
+                         f"{hkv}")
+    if page_tables.shape[0] != b or page_tables.ndim != 2:
+        raise ValueError(f"page_tables {page_tables.shape} does not match "
+                         f"batch {b}")
+    if kv_lens.shape != (b,):
+        raise ValueError(f"kv_lens {kv_lens.shape} != ({b},)")
+    return b, hq, d, ps, hkv, page_tables.shape[1]
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_tables, kv_lens,
+                              *, scale: Optional[float] = None):
+    """Pure-jax oracle: gather the pages, mask past each sequence's
+    length, dense softmax. Same signature/semantics as the kernel."""
+    b, hq, d, ps, hkv, w = _check_shapes(q, k_pages, v_pages, page_tables,
+                                         kv_lens)
+    scale = float(scale) if scale else d ** -0.5
+    rep = hq // hkv
+    # [B, W, ps, Hkv, D] -> [B, T, Hkv, D], T = W * ps
+    k = k_pages[page_tables].reshape(b, w * ps, hkv, d)
+    v = v_pages[page_tables].reshape(b, w * ps, hkv, d)
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qf = q.astype(jnp.float32) * scale
+    s = jnp.einsum("bhd,bthd->bht", qf, k.astype(jnp.float32))
+    keep = (jnp.arange(w * ps)[None, :] < kv_lens[:, None])[:, None, :]
+    s = jnp.where(keep, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m) * keep
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bht,bthd->bhd", p, v.astype(jnp.float32))
+    return (o / jnp.maximum(l, jnp.finfo(jnp.float32).tiny)).astype(q.dtype)
+
+
+def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_sc, l_sc, acc_sc, *, scale, page_size, rep):
+    """One (sequence b, page w) grid step: fold this page's keys into
+    the running online softmax. W iterates innermost (TPU grids run
+    sequentially), so the scratch accumulators carry across a
+    sequence's pages and reset at its first."""
+    w = pl.program_id(1)
+    nw = pl.num_programs(1)
+
+    @pl.when(w == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    b = pl.program_id(0)
+    kv_len = lens_ref[b]
+    q = q_ref[0].astype(jnp.float32) * scale          # [Hq, D]
+    k = k_ref[0].astype(jnp.float32)                  # [ps, Hkv, D]
+    v = v_ref[0].astype(jnp.float32)
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=1)                # [ps, Hq, D]
+        v = jnp.repeat(v, rep, axis=1)
+    # this page covers absolute key positions [w*ps, w*ps + ps)
+    offs = w * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_size), 1)                 # [1, ps]
+    keep = offs < kv_len                              # [1, ps]
+    # s[h, p] = q[h, :] . k[p, h, :]  (head-batched matvec: the decode
+    # step is bandwidth-bound — VPU elementwise+reduce is fine)
+    s = jnp.sum(q[:, None, :] * k.transpose(1, 0, 2), axis=-1)  # [Hq, ps]
+    s = jnp.where(keep, s, NEG_INF)
+    m_old = m_sc[...]                                 # [Hq, 1]
+    m_new = jnp.maximum(m_old, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_old - m_new)
+    p = jnp.exp(s - m_new) * keep                     # [Hq, ps]
+    l_new = l_sc[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    pv = jnp.sum(p.transpose(1, 0)[:, :, None] * v, axis=0)  # [Hq, D]
+    m_sc[...] = m_new
+    l_sc[...] = l_new
+    acc_sc[...] = acc_sc[...] * alpha + pv
+
+    @pl.when(w == nw - 1)
+    def _emit():
+        l = jnp.maximum(l_sc[...], jnp.finfo(jnp.float32).tiny)
+        o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pages, v_pages, page_tables, kv_lens,
+                            *, scale: Optional[float] = None,
+                            interpret: bool = False):
+    b, hq, d, ps, hkv, w = _check_shapes(q, k_pages, v_pages, page_tables,
+                                         kv_lens)
+    scale = float(scale) if scale else d ** -0.5
+    rep = hq // hkv
+    tables = page_tables.astype(jnp.int32)
+    lens = kv_lens.astype(jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,   # page_tables, kv_lens ride in SMEM
+        grid=(b, w),
+        in_specs=[
+            pl.BlockSpec((1, hq, d), lambda bb, ww, t, n: (bb, 0, 0)),
+            # THE paged read: the index map picks each sequence's w-th
+            # page out of the pool, so the pipeline DMAs only owned
+            # pages (garbage-padded entries fetch page 0, fully masked)
+            pl.BlockSpec((1, ps, hkv, d),
+                         lambda bb, ww, t, n: (t[bb, ww], 0, 0, 0)),
+            pl.BlockSpec((1, ps, hkv, d),
+                         lambda bb, ww, t, n: (t[bb, ww], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hq, d), lambda bb, ww, t, n: (bb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hq, 1), jnp.float32),   # running max
+            pltpu.VMEM((hq, 1), jnp.float32),   # running sum
+            pltpu.VMEM((hq, d), jnp.float32),   # output accumulator
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, scale=scale, page_size=ps,
+                               rep=rep)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
+        interpret=interpret,
+    )(tables, lens, q, k_pages, v_pages)
+
+
+def paged_attention(q, k_pages, v_pages, page_tables, kv_lens,
+                    *, scale: Optional[float] = None,
+                    interpret: Optional[bool] = None):
+    """Route between the Pallas kernel (TPU, or forced via
+    ``use_pallas_kernels=True`` in interpret mode for tests) and the
+    pure-jax reference — the same flags surface flash attention uses
+    (fluid/ops/attention_ops.py)."""
+    from ...flags import pallas_enabled, pallas_interpret
+
+    if pallas_enabled():
+        return _paged_attention_pallas(
+            q, k_pages, v_pages, page_tables, kv_lens, scale=scale,
+            interpret=pallas_interpret() if interpret is None
+            else interpret)
+    return paged_attention_reference(q, k_pages, v_pages, page_tables,
+                                     kv_lens, scale=scale)

@@ -1,4 +1,4 @@
-"""paddle_tpu.serving — online inference serving (ISSUE 5).
+"""paddle_tpu.serving — online inference serving (ISSUE 5 + 6).
 
 The runtime that consumes what `fluid/io.py` produces: load a
 `save_inference_model` directory (or an `export_compiled_model`
@@ -6,19 +6,26 @@ StableHLO artifact) behind an `InferenceEngine` that batches requests
 into a fixed bucket ladder, a `ModelRegistry` that hot-swaps versions
 atomically, and a `ServingServer`/`ServingClient` pair on the
 distributed RPC transport with admission control and chaos-ready
-`serving.*` fault sites. See docs/SERVING.md.
+`serving.*` fault sites. Autoregressive decode (ISSUE 6) rides the
+same registry/server: a `DecodeEngine` does continuous batching over a
+paged KV cache (`kv_cache.py`) with a ragged paged-attention kernel,
+served via the `generate`/`load_decoder` RPC methods. See
+docs/SERVING.md.
 
     python -m paddle_tpu.serving --selftest   # in-process end-to-end
 """
 from .client import ServingClient
+from .decode import DecodeEngine, DecoderSpec
 from .engine import InferenceEngine, default_buckets, parse_buckets
 from .errors import (DeadlineExceeded, EngineRetired, ModelNotFound,
                      RequestTooLarge, ServerOverloaded, ServingError)
+from .kv_cache import PageAllocator, PagedKvCache
 from .registry import ModelRegistry
 from .server import ServingServer
 
 __all__ = [
-    "InferenceEngine", "ModelRegistry", "ServingServer", "ServingClient",
+    "InferenceEngine", "DecodeEngine", "DecoderSpec", "ModelRegistry",
+    "ServingServer", "ServingClient", "PageAllocator", "PagedKvCache",
     "ServingError", "ServerOverloaded", "DeadlineExceeded",
     "ModelNotFound", "RequestTooLarge", "EngineRetired",
     "default_buckets", "parse_buckets",

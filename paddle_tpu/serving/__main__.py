@@ -4,9 +4,12 @@
         In-process end-to-end proof (no external network, no datasets):
         builds two versions of a tiny model, then exercises the bucketed
         batcher (jit-compile bound + batch-invariance), the RPC
-        server/client path, an atomic hot-swap, and the overload
-        rejection path. Exit-nonzero on any failure — wired into
-        tools/check.py as the serving smoke.
+        server/client path, an atomic hot-swap, the overload rejection
+        path, and the DECODE path (ISSUE 6: paged-KV continuous
+        batching — warmed slot/width ladder, zero churn compiles, page
+        exhaustion refusal, RPC generate + decoder hot-swap).
+        Exit-nonzero on any failure — wired into tools/check.py as the
+        serving smoke.
 
     python -m paddle_tpu.serving --serve --load m=/path/to/model_dir
         Operator mode: start a ServingServer, load the named model
@@ -170,6 +173,64 @@ def run_selftest(verbose: bool = True) -> int:
         finally:
             cli.close()
             srv.shutdown()
+
+        # -- 3. decode: paged KV + continuous batching (ISSUE 6) ---------
+        from . import DecodeEngine, DecoderSpec
+
+        spec = DecoderSpec(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                           n_kv_heads=1, seed=3)
+        deng = DecodeEngine(spec, name="dec", slots=[1, 2], page_size=4,
+                            num_pages=24, max_seq_len=8)
+        try:
+            n_shapes = (len(deng.slot_ladder)
+                        * len(deng.table_width_ladder))
+            check(len(deng._compiled_shapes) == n_shapes,
+                  f"decode warm compiled the full ladder ({n_shapes} "
+                  "shapes)")
+            dc = _metrics.counter("serving.decode.compiles")
+            base = dc.value()
+            rng = np.random.RandomState(0)
+            reqs = [deng.submit(
+                rng.randint(0, 32, size=1 + int(rng.randint(4))),
+                max_new_tokens=1 + int(rng.randint(4)))
+                for _ in range(8)]
+            ok = all(r.ev.wait(120) and r.error is None for r in reqs)
+            check(ok, "ragged sequence churn all completed")
+            check(dc.value() == base,
+                  "churn performed 0 new decode compiles")
+            check(deng.cache.allocator.stats()["pages_used"] == 0,
+                  "every KV page returned to the pool")
+            a = deng.generate([1, 2, 3], max_new_tokens=4)
+            b = deng.generate([1, 2, 3], max_new_tokens=4)
+            check(a["tokens"] == b["tokens"], "greedy decode deterministic")
+            try:
+                held = deng.cache.allocator.alloc(9999, 92)  # drain pool
+                deng.submit([1, 2, 3, 4], max_new_tokens=4)
+                check(False, "page exhaustion refused")
+            except ServerOverloaded:
+                check(True, "page exhaustion refused (ServerOverloaded)")
+                deng.cache.allocator.free(9999)
+        finally:
+            deng.stop()
+
+        # decode over RPC with a hot-swap
+        srv2 = ServingServer()
+        addr2 = srv2.serve()
+        cli2 = ServingClient(addr2)
+        try:
+            cli2.load_decoder("dec", spec.to_dict(), slots=[1, 2],
+                              page_size=4, num_pages=16, max_seq_len=8)
+            out = cli2.generate("dec", [3, 1], max_new_tokens=4)
+            check(out["version"] == 1 and len(out["tokens"]) == 4,
+                  "RPC generate serves the decoder")
+            cli2.load_decoder("dec", spec.to_dict(), slots=[1, 2],
+                              page_size=4, num_pages=16, max_seq_len=8)
+            out2 = cli2.generate("dec", [3, 1], max_new_tokens=4)
+            check(out2["version"] == 2 and out2["tokens"] == out["tokens"],
+                  "decoder hot-swap flipped with identical tokens")
+        finally:
+            cli2.close()
+            srv2.shutdown()
 
     if failures:
         print(f"serving selftest: {len(failures)} FAILURE(S): {failures}")

@@ -22,7 +22,8 @@ __all__ = ["ServingClient"]
 
 _TYPED = {cls.__name__: cls for cls in
           (ServerOverloaded, DeadlineExceeded, ModelNotFound,
-           RequestTooLarge, EngineRetired, ServingError)}
+           RequestTooLarge, EngineRetired, ServingError,
+           ValueError)}  # ValueError: spec/feed validation refusals
 
 # rpc.py's client raises RuntimeError("RPC <m> failed: <Type>: <msg>")
 _ERR_RE = re.compile(r"^RPC \S+ failed: (\w+): (.*)$", re.DOTALL)
@@ -54,6 +55,37 @@ class ServingClient:
         return ([np.asarray(o) for o in resp["outputs"]],
                 int(resp["version"]))
 
+    def generate(self, model: str, prompt: Sequence[int],
+                 max_new_tokens: int = 16,
+                 deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+        """Autoregressive decode on a loaded decoder. Returns
+        ``{"model", "version", "tokens", "prompt_len"}``. Transport
+        retries are dedup-safe: a retransmitted generate is answered
+        from the server's cache without re-decoding the sequence."""
+        try:
+            return self._rpc.call(
+                "generate", model, [int(t) for t in prompt],
+                int(max_new_tokens), deadline_ms)
+        except RuntimeError as e:
+            _raise_typed(e)
+
+    def load_decoder(self, model: str, spec: Dict[str, Any],
+                     version: Optional[int] = None,
+                     slots: Optional[Sequence[int]] = None,
+                     page_size: Optional[int] = None,
+                     num_pages: Optional[int] = None,
+                     max_seq_len: Optional[int] = None,
+                     max_queue: Optional[int] = None) -> Dict[str, Any]:
+        """Deploy a DecodeEngine from an architecture/seed spec dict
+        (see serving.decode.DecoderSpec); hot-swaps like load_model."""
+        try:
+            return self._rpc.call(
+                "load_decoder", model, dict(spec), version,
+                None if slots is None else [int(s) for s in slots],
+                page_size, num_pages, max_seq_len, max_queue)
+        except RuntimeError as e:
+            _raise_typed(e)
+
     def load_model(self, model: str, dirname: str,
                    version: Optional[int] = None, kind: str = "auto",
                    buckets: Optional[Sequence[int]] = None,
@@ -61,7 +93,8 @@ class ServingClient:
                    max_wait_ms: Optional[float] = None) -> Dict[str, Any]:
         try:
             return self._rpc.call("load_model", model, dirname, version,
-                                  kind, list(buckets) if buckets else None,
+                                  kind, None if buckets is None
+                                  else [int(b) for b in buckets],
                                   max_queue, max_wait_ms)
         except RuntimeError as e:
             _raise_typed(e)

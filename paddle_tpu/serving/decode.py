@@ -1,0 +1,771 @@
+"""Autoregressive decode serving: continuous batching over a paged KV
+cache (ISSUE 6; PAPERS.md: Ragged Paged Attention).
+
+The one-shot engine (engine.py) answers each request with one model
+run. Autoregressive decode is different in kind: a request is a
+SEQUENCE of dependent steps (one per generated token), each step needs
+the sequence's whole KV history on-device, and sequences finish at
+ragged, data-dependent times. Two naive designs fail on TPU:
+
+  - drain-per-batch (admit a batch, run every member to completion,
+    then admit the next): short sequences finish early and their slots
+    idle until the longest member drains — realized tokens/s decays
+    with length variance (decode_bench measures exactly this);
+  - per-sequence shapes: recompiling per ragged length mints O(shapes)
+    jit entries under the traffic that can least afford compiles.
+
+This engine does CONTINUOUS batching over FIXED compiled shapes:
+
+  - the decode batch has a fixed slot layout — slot count padded to a
+    small ladder (``FLAGS['decode_slots']``), per-slot page-table width
+    padded to a derived ladder — and ``warm()`` pre-compiles every
+    (slots, width) pair at load time, exactly like the one-shot
+    engine's bucket warm. After warmup a churn of admits/completions
+    at ragged lengths performs ZERO new compiles (tier-1 pins the
+    ``serving.decode.compiles`` counter);
+  - every step consumes ONE token per live slot: a sequence still in
+    its prompt consumes the next prompt token (prefill rides the same
+    compiled step — no separate prefill graph), a sequence past it
+    consumes its previously sampled token. New sequences are admitted
+    into free slots BETWEEN steps, mid-flight of everyone else —
+    admission never waits for a batch boundary;
+  - K/V live in the preallocated paged pool (kv_cache.py): HBM is
+    bounded at construction, pages are reserved at admission (refusal
+    is an immediate structured ``ServerOverloaded``) and recycled at
+    completion, and the paged-attention kernel reads through the page
+    tables so ragged histories share one compiled shape.
+
+The model behind the step is pluggable via the ``DecoderSpec`` /
+``build_decoder_params`` / ``decoder_step`` contract below; the
+built-in spec'd decoder (embedding + N pre-norm transformer layers
+with paged attention + tied-embedding logits, deterministic params
+from a seed) is the test/bench/selftest vehicle — real checkpoints
+implement the same step signature.
+
+Lifecycle mirrors the one-shot engine so the SAME ModelRegistry
+hot-swaps decoders: ``stop(drain=True)`` finishes every admitted
+sequence then drops params/pools/compiled steps (executables release
+on retirement); a failed ``warm()`` stops the scheduler before
+re-raising so the registry's rollback leaks nothing.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..observability import metrics as _metrics, tracing as _tracing
+from ..observability.log import get_logger
+from .engine import bucket_for as _bucket_for, parse_buckets
+from .errors import (DeadlineExceeded, EngineRetired, RequestTooLarge,
+                     ServerOverloaded, ServingError)
+from .kv_cache import GARBAGE_PAGE, PagedKvCache
+
+__all__ = ["DecoderSpec", "DecodeEngine", "build_decoder_params",
+           "decoder_step", "width_ladder"]
+
+_log = get_logger("serving")
+
+_m_requests = _metrics.counter("serving.decode.requests")
+_m_admitted = _metrics.counter("serving.decode.admitted")
+_m_completions = _metrics.counter("serving.decode.completions")
+_m_steps = _metrics.counter("serving.decode.steps")
+_m_tokens = _metrics.counter("serving.decode.tokens")
+_m_overloads = _metrics.counter("serving.decode.overloads")
+_m_deadline_miss = _metrics.counter("serving.decode.deadline_misses")
+_m_cancels = _metrics.counter("serving.decode.cancels")
+# one inc per DISTINCT (slots, width) shape the step compiles — after
+# warm() this must never move again (the tier-1 churn guard pins it)
+_m_compiles = _metrics.counter("serving.decode.compiles")
+_m_step_ms = _metrics.histogram("serving.decode.step_ms")
+_m_queue_wait = _metrics.histogram("serving.decode.queue_wait_ms")
+_m_total = _metrics.histogram("serving.decode.total_ms")
+# live slots / slot bucket per step: the continuous-batching win is
+# this histogram staying fat while drain-per-batch's decays
+_m_occupancy = _metrics.histogram("serving.decode.occupancy")
+
+
+# --- the pluggable decoder model ----------------------------------------
+
+class DecoderSpec:
+    """Architecture + identity of a decoder the engine can serve.
+    ``d_model == n_heads * head_dim`` (enforced); ``n_heads`` must be a
+    multiple of ``n_kv_heads`` (GQA). Params are DETERMINISTIC in
+    ``seed`` so two replicas loading the same spec serve bitwise the
+    same model — and tests can reference-check outputs."""
+
+    __slots__ = ("vocab", "d_model", "n_layers", "n_heads", "n_kv_heads",
+                 "head_dim", "seed", "eos_id")
+
+    def __init__(self, vocab: int = 64, d_model: int = 32,
+                 n_layers: int = 2, n_heads: int = 4,
+                 n_kv_heads: Optional[int] = None, seed: int = 0,
+                 eos_id: Optional[int] = None):
+        self.vocab = int(vocab)
+        self.d_model = int(d_model)
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.n_kv_heads = int(n_kv_heads if n_kv_heads is not None
+                              else n_heads)
+        if self.d_model % 2:
+            raise ValueError(f"d_model {d_model} must be even "
+                             f"(sinusoidal encoding pairs sin/cos halves)")
+        if self.d_model % self.n_heads:
+            raise ValueError(f"d_model {d_model} not divisible by "
+                             f"n_heads {n_heads}")
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError(f"n_heads {n_heads} not a multiple of "
+                             f"n_kv_heads {self.n_kv_heads}")
+        self.head_dim = self.d_model // self.n_heads
+        self.seed = int(seed)
+        self.eos_id = None if eos_id is None else int(eos_id)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in
+                ("vocab", "d_model", "n_layers", "n_heads", "n_kv_heads",
+                 "seed", "eos_id")}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DecoderSpec":
+        allowed = ("vocab", "d_model", "n_layers", "n_heads",
+                   "n_kv_heads", "seed", "eos_id")
+        # reject, don't drop: a misspelled field silently deploying a
+        # default-architecture decoder is a wrong-model hot-swap
+        # (head_dim is derived — accepted only if consistent)
+        unknown = sorted(set(d) - set(allowed) - {"head_dim"})
+        if unknown:
+            raise ValueError(
+                f"unknown DecoderSpec field(s) {unknown}; "
+                f"valid: {sorted(allowed)}")
+        spec = cls(**{k: v for k, v in d.items() if k in allowed})
+        if "head_dim" in d and int(d["head_dim"]) != spec.head_dim:
+            raise ValueError(
+                f"head_dim {d['head_dim']} contradicts d_model "
+                f"{spec.d_model} / n_heads {spec.n_heads} = "
+                f"{spec.head_dim} — head_dim is derived, not free")
+        return spec
+
+
+def build_decoder_params(spec: DecoderSpec) -> Dict[str, Any]:
+    """Deterministic parameter tree (seeded numpy draws, scaled-normal
+    init) — the test/bench stand-in for loading a checkpoint."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(spec.seed)
+    dm, dh = spec.d_model, spec.head_dim
+
+    def mat(fan_in, *shape):
+        return jnp.asarray(
+            (rng.randn(*shape) / math.sqrt(fan_in)).astype(np.float32))
+
+    params: Dict[str, Any] = {
+        "tok_emb": mat(dm, spec.vocab, dm),
+        "lnf": (jnp.ones((dm,), jnp.float32), jnp.zeros((dm,), jnp.float32)),
+    }
+    for l in range(spec.n_layers):
+        params[f"layer{l}"] = {
+            "ln1": (jnp.ones((dm,), jnp.float32),
+                    jnp.zeros((dm,), jnp.float32)),
+            "wq": mat(dm, dm, spec.n_heads * dh),
+            "wk": mat(dm, dm, spec.n_kv_heads * dh),
+            "wv": mat(dm, dm, spec.n_kv_heads * dh),
+            "wo": mat(dm, spec.n_heads * dh, dm),
+            "ln2": (jnp.ones((dm,), jnp.float32),
+                    jnp.zeros((dm,), jnp.float32)),
+            "w1": mat(dm, dm, 4 * dm),
+            "w2": mat(4 * dm, 4 * dm, dm),
+        }
+    return params
+
+
+def _ln(x, gb):
+    import jax.numpy as jnp
+
+    g, b = gb
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-6) * g + b
+
+
+def _pos_encoding(positions, d_model):
+    """Sinusoidal [B, d_model] — unbounded positions, no learned table
+    to cap sequence length."""
+    import jax.numpy as jnp
+
+    half = d_model // 2
+    freq = jnp.exp(-math.log(10000.0) * jnp.arange(half) / half)
+    ang = positions[:, None].astype(jnp.float32) * freq[None, :]
+    return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+
+
+def decoder_step(params, spec: DecoderSpec, tokens, positions,
+                 k_pool, v_pool, page_tables, kv_lens):
+    """ONE decode step for a fixed-slot batch. Functional: writes this
+    step's K/V into the paged pools (dead slots write the garbage
+    page), attends through the page tables, returns
+    ``(k_pool, v_pool, logits [B, vocab])``.
+
+    tokens/positions: [B] int32 (dead slots: 0/0 with an all-garbage
+    table row). kv_lens: [B] int32 — valid keys INCLUDING this step's
+    token (0 = dead slot -> exact-zero attention output).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..fluid.ops.pallas_kernels.paged_attention import paged_attention
+
+    b = tokens.shape[0]
+    ps = k_pool.shape[2]
+    dm, dh = spec.d_model, spec.head_dim
+    x = params["tok_emb"][tokens] * math.sqrt(dm) + \
+        _pos_encoding(positions, dm)
+    page_idx = positions // ps
+    # each slot's physical page for this token: its table row at the
+    # token's page index (garbage rows resolve to the garbage page)
+    page = jnp.take_along_axis(page_tables, page_idx[:, None], axis=1)[:, 0]
+    off = positions % ps
+    for l in range(spec.n_layers):
+        lp = params[f"layer{l}"]
+        h = _ln(x, lp["ln1"])
+        q = (h @ lp["wq"]).reshape(b, spec.n_heads, dh)
+        k = (h @ lp["wk"]).reshape(b, spec.n_kv_heads, dh)
+        v = (h @ lp["wv"]).reshape(b, spec.n_kv_heads, dh)
+        k_pool = k_pool.at[l, page, off].set(k.astype(k_pool.dtype))
+        v_pool = v_pool.at[l, page, off].set(v.astype(v_pool.dtype))
+        attn = paged_attention(q, k_pool[l], v_pool[l], page_tables,
+                               kv_lens)
+        x = x + attn.reshape(b, spec.n_heads * dh) @ lp["wo"]
+        h2 = _ln(x, lp["ln2"])
+        x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+    logits = _ln(x, params["lnf"]) @ params["tok_emb"].T
+    return k_pool, v_pool, logits
+
+
+# --- ladders ------------------------------------------------------------
+
+def width_ladder(max_pages: int) -> List[int]:
+    """Page-table width buckets: powers of two up to (and always
+    including) the worst case — the second padded dimension of the
+    compiled decode shape."""
+    if max_pages < 1:
+        raise ValueError(f"max_pages must be >= 1, got {max_pages}")
+    out, w = [], 1
+    while w < max_pages:
+        out.append(w)
+        w *= 2
+    out.append(max_pages)
+    return sorted(set(out))
+
+
+# --- requests / slots ---------------------------------------------------
+
+class _DecodeRequest:
+    __slots__ = ("prompt", "max_new", "deadline", "ev", "result", "error",
+                 "t_enq", "seq_id", "trace_ctx")
+
+    def __init__(self, prompt: np.ndarray, max_new: int,
+                 deadline: Optional[float], seq_id: int):
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.deadline = deadline
+        self.ev = threading.Event()
+        self.result: Optional[Dict[str, Any]] = None
+        self.error: Optional[BaseException] = None
+        self.t_enq = time.monotonic()
+        self.seq_id = seq_id
+        self.trace_ctx = _tracing.wire_context()
+
+    def fail(self, err: BaseException):
+        self.error = err
+        self.ev.set()
+
+
+class _Slot:
+    __slots__ = ("req", "pos", "produced", "pages_held")
+
+    def __init__(self, req: _DecodeRequest, pages_held: int):
+        self.req = req
+        self.pos = 0                # tokens already written to the cache
+        self.produced: List[int] = []
+        self.pages_held = pages_held
+
+    def next_token(self) -> int:
+        p = self.req.prompt
+        return int(p[self.pos]) if self.pos < len(p) \
+            else self.produced[self.pos - len(p)]
+
+
+# --- the engine ---------------------------------------------------------
+
+class DecodeEngine:
+    """Continuous-batching autoregressive decode over one loaded
+    decoder. Registry/server-compatible: ``name``/``version``/``kind``/
+    ``stats()``/``stop(drain=)`` mirror InferenceEngine, so the same
+    ModelRegistry hot-swaps decoders with the same drain guarantee."""
+
+    kind = "decoder"
+
+    def __init__(self, spec: DecoderSpec, *, name: str = "decoder",
+                 version: int = 1,
+                 slots: Optional[Sequence[int]] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 continuous: bool = True,
+                 params: Optional[Dict[str, Any]] = None,
+                 warm: bool = True):
+        from ..fluid.flags import FLAGS
+
+        self.name = str(name)
+        self.version = int(version)
+        self.spec = spec
+        self._params = (build_decoder_params(spec)
+                        if params is None else params)
+        self._slot_ladder = parse_buckets(
+            FLAGS["decode_slots"] if slots is None else slots)
+        self._max_slots = self._slot_ladder[-1]
+        ps = int(FLAGS["kv_page_size"] if page_size is None else page_size)
+        npages = int(FLAGS["kv_num_pages"] if num_pages is None
+                     else num_pages)
+        self.max_seq_len = int(FLAGS["decode_max_seq_len"]
+                               if max_seq_len is None else max_seq_len)
+        self._max_queue = int(FLAGS["serving_max_queue"]
+                              if max_queue is None else max_queue)
+        # drain-per-batch mode (continuous=False) exists ONLY as the
+        # honest A/B baseline for decode_bench — same engine, same
+        # compiled shapes, admission gated on an empty batch
+        self._continuous = bool(continuous)
+        self.cache = PagedKvCache(
+            spec.n_layers, spec.n_kv_heads, spec.head_dim,
+            page_size=ps, num_pages=npages,
+            label=f"{self.name}.v{self.version}")
+        w_max = self.cache.allocator.pages_for_tokens(self.max_seq_len)
+        self._width_ladder = width_ladder(w_max)
+        self._cond = threading.Condition()
+        self._queue: List[_DecodeRequest] = []
+        self._slots: List[_Slot] = []
+        self._stopping = False
+        self._released = False
+        self._seq_counter = 0
+        self._n_requests = 0
+        self._n_steps = 0
+        self._compiled_shapes: set = set()
+        self._g_depth = _metrics.gauge(
+            f"serving.decode.queue_depth.{self.name}.v{self.version}")
+        # per-instance for the same reason as queue_depth: a draining
+        # old version must not clobber the live engine's value
+        self._g_live = _metrics.gauge(
+            f"serving.decode.live_slots.{self.name}.v{self.version}")
+
+        import jax
+
+        spec_ref = spec  # closed over; jit retraces only on shape change
+
+        def _step(params, tokens, positions, k_pool, v_pool, tables, lens):
+            return decoder_step(params, spec_ref, tokens, positions,
+                                k_pool, v_pool, tables, lens)
+
+        # donate the pools on TPU so XLA updates the KV pages in place
+        # (HBM footprint stays the preallocated pool); CPU ignores
+        # donation, so skip it there to avoid per-call warnings
+        donate = (bool(FLAGS["donate_state"])
+                  and jax.default_backend() == "tpu")
+        self._donate = donate
+        self._step_fn = jax.jit(
+            _step, donate_argnums=(3, 4) if donate else ())
+        # serializes warm() (caller thread) against live steps (the
+        # scheduler thread): read-pools -> step -> rebind must be
+        # atomic or concurrent rebinds silently drop KV writes
+        self._step_mu = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"decode-{self.name}-v{self.version}")
+        self._thread.start()
+        if warm:
+            try:
+                self.warm()
+            except BaseException:
+                # failed warm is the registry's rollback path: the
+                # scheduler thread (and the params/pools it pins) must
+                # not outlive the failed deploy
+                self.stop(drain=False)
+                raise
+
+    # -- public surface ---------------------------------------------------
+    @property
+    def slot_ladder(self) -> List[int]:
+        return list(self._slot_ladder)
+
+    @property
+    def table_width_ladder(self) -> List[int]:
+        return list(self._width_ladder)
+
+    def warm(self):
+        """Pre-compile EVERY (slot-count, table-width) pair on an
+        all-dead synthetic batch (writes land on the garbage page).
+        After this, sequence churn at ragged lengths compiles nothing:
+        both padded dimensions only ever take ladder values."""
+        with _tracing.span("serving.decode.warmup", model=self.name,
+                           version=self.version):
+            for s in self._slot_ladder:
+                for w in self._width_ladder:
+                    self._run_step_arrays(
+                        np.zeros(s, np.int32), np.zeros(s, np.int32),
+                        np.full((s, w), GARBAGE_PAGE, np.int32),
+                        np.zeros(s, np.int32))
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
+               deadline_ms: Optional[float] = None) -> _DecodeRequest:
+        """Validate + reserve KV pages + enqueue. All refusals are
+        synchronous and typed: ``ServerOverloaded`` (queue full OR page
+        pool exhausted), ``RequestTooLarge`` (can't ever fit),
+        ``EngineRetired``, ``ValueError`` (bad tokens)."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if int(prompt.min()) < 0 or int(prompt.max()) >= self.spec.vocab:
+            raise ValueError(
+                f"prompt token ids must be in [0, {self.spec.vocab})")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = int(prompt.size) + max_new
+        if total > self.max_seq_len:
+            raise RequestTooLarge(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) = "
+                f"{total} exceeds max_seq_len {self.max_seq_len}")
+        deadline = (None if deadline_ms is None
+                    else time.monotonic() + float(deadline_ms) / 1e3)
+        with self._cond:
+            if self._stopping:
+                raise EngineRetired(
+                    f"decoder '{self.name}' v{self.version} is retiring")
+            if len(self._queue) >= self._max_queue:
+                _m_overloads.inc()
+                raise ServerOverloaded(
+                    f"decoder '{self.name}' queue is full "
+                    f"({self._max_queue} deep)")
+            self._seq_counter += 1
+            seq_id = self._seq_counter
+            try:
+                # reserve the worst case NOW: an admitted sequence can
+                # never die of page exhaustion mid-decode; the pool is
+                # the admission bound (kv_cache.py)
+                self.cache.allocator.alloc(seq_id, total)
+            except ServerOverloaded:
+                _m_overloads.inc()
+                raise
+            req = _DecodeRequest(prompt, max_new, deadline, seq_id)
+            self._queue.append(req)
+            self._n_requests += 1
+            self._g_depth.set(len(self._queue))
+            self._cond.notify()
+        _m_requests.inc()
+        return req
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
+                 deadline_ms: Optional[float] = None,
+                 timeout: float = 300.0) -> Dict[str, Any]:
+        """Blocking convenience: submit + wait. Returns
+        ``{"tokens": [...], "prompt_len": n, "version": v}``."""
+        req = self.submit(prompt, max_new_tokens, deadline_ms=deadline_ms)
+        if not req.ev.wait(timeout):
+            # withdraw before raising: an abandoned sequence must not
+            # keep its page reservation or burn further decode steps.
+            # cancel() returning False means the request finished in
+            # the wait-vs-cancel window — deliver that result, don't
+            # discard paid-for tokens as a timeout
+            if self.cancel(req):
+                raise ServingError(
+                    f"generate on '{self.name}' timed out after "
+                    f"{timeout}s (decode scheduler wedged?)")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def cancel(self, req: _DecodeRequest,
+               msg: str = "abandoned by caller") -> bool:
+        """Withdraw a submitted request whose waiter gave up: frees its
+        KV pages now and fails it, so the scheduler drops the slot at
+        the next answer phase instead of decoding dead work to
+        completion. A step already in flight still writes through the
+        page table it captured BEFORE the free — safe today because a
+        re-allocated page's every position is rewritten by its new
+        owner in the same step that first attends to it
+        (write-before-attend); the NEXT table build degrades the
+        canceled row to the garbage page. Returns False if the
+        request already finished."""
+        with self._cond:
+            if req.ev.is_set():
+                return False
+            if req in self._queue:
+                self._queue.remove(req)
+                self._g_depth.set(len(self._queue))
+            _m_cancels.inc()
+            self._fail_locked(req, ServingError(
+                f"generate on '{self.name}' canceled: {msg}"))
+            self._cond.notify_all()
+            return True
+
+    def set_max_queue(self, n: int):
+        with self._cond:
+            self._max_queue = max(1, int(n))
+
+    def stop(self, drain: bool = True, timeout: float = 300.0):
+        """Refuse new work; ``drain`` completes every admitted AND
+        queued sequence first (the hot-swap drain guarantee), else all
+        are failed with EngineRetired. Then params/pools/compiled steps
+        are dropped so retirement releases the executables and HBM."""
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                for r in self._queue:
+                    self.cache.allocator.free(r.seq_id)
+                    r.fail(EngineRetired(
+                        f"decoder '{self.name}' v{self.version} unloaded"))
+                self._queue.clear()
+                for s in self._slots:
+                    self.cache.allocator.free(s.req.seq_id)
+                    # a slot _complete()d mid-step may still be in
+                    # _slots (removal happens under _cond after the
+                    # step) — never overwrite a delivered result
+                    if not s.req.ev.is_set():
+                        s.req.fail(EngineRetired(
+                            f"decoder '{self.name}' v{self.version} "
+                            "unloaded"))
+                self._slots = []
+                self._g_depth.set(0)
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        if self._thread.is_alive():  # pragma: no cover - wedged scheduler
+            _log.error("decode scheduler for %s v%d did not exit in %.0fs",
+                       self.name, self.version, timeout)
+        with self._cond:
+            self._params = None
+            self._step_fn = None
+            self.cache.release()
+            self._released = True
+            self._g_depth.set(0)
+            # the scheduler may exit between steps without a final
+            # answer phase — a retired engine must not report phantom
+            # live slots
+            self._g_live.set(0)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._cond:
+            return {
+                "name": self.name,
+                "version": self.version,
+                "kind": self.kind,
+                "spec": self.spec.to_dict(),
+                "slots": list(self._slot_ladder),
+                "table_widths": list(self._width_ladder),
+                "page_size": self.cache.page_size,
+                "max_seq_len": self.max_seq_len,
+                "continuous": self._continuous,
+                "kv": self.cache.allocator.stats(),
+                "queue_depth": len(self._queue),
+                "live": len(self._slots),
+                "max_queue": self._max_queue,
+                "requests": self._n_requests,
+                "steps": self._n_steps,
+                "compiled_shapes": sorted(self._compiled_shapes),
+                "stopping": self._stopping,
+            }
+
+    # -- scheduler --------------------------------------------------------
+    def _fail_locked(self, req: _DecodeRequest, err: BaseException):
+        self.cache.allocator.free(req.seq_id)
+        req.fail(err)
+
+    def _drop_expired_locked(self, now: float):
+        keep = []
+        for r in self._queue:
+            if r.deadline is not None and now > r.deadline:
+                _m_deadline_miss.inc()
+                self._fail_locked(r, DeadlineExceeded(
+                    f"request to decoder '{self.name}' missed its "
+                    "deadline while queued"))
+            else:
+                keep.append(r)
+        if len(keep) != len(self._queue):
+            self._queue[:] = keep
+            self._g_depth.set(len(keep))
+
+    def _admit_locked(self):
+        """Move queued requests into free slots. Continuous mode admits
+        whenever a slot is free — INTO the in-flight batch; drain mode
+        (the bench baseline) only refills an empty batch."""
+        if not self._continuous and self._slots:
+            return
+        while self._queue and len(self._slots) < self._max_slots:
+            req = self._queue.pop(0)
+            pages = self.cache.allocator.pages_for_tokens(
+                len(req.prompt) + req.max_new)
+            self._slots.append(_Slot(req, pages))
+            _m_admitted.inc()
+            _m_queue_wait.observe((time.monotonic() - req.t_enq) * 1e3)
+        self._g_depth.set(len(self._queue))
+        self._g_live.set(len(self._slots))
+
+    def _next_live(self) -> Optional[List[_Slot]]:
+        # lint: allow-blocking — Condition.wait on the engine's own
+        # condition is the scheduler's idle state by design
+        with self._cond:
+            while True:
+                self._drop_expired_locked(time.monotonic())
+                self._admit_locked()
+                if self._slots:
+                    return list(self._slots)
+                if self._stopping and not self._queue:
+                    return None
+                # no live slots here implies the queue is (almost
+                # always) empty too — admission can't fail with every
+                # slot free — so idle blocks untimed on submit()/stop()
+                # notifies instead of polling 20x/s per loaded decoder;
+                # the timed wait survives only for the defensive case
+                # of a non-empty queue, whose deadlines need the poll
+                self._cond.wait(0.05 if self._queue else None)
+
+    def _loop(self):
+        while True:
+            live = self._next_live()
+            if live is None:
+                return
+            try:
+                self._step(live)
+            except BaseException as e:  # a broken step fails ITS slots
+                _log.error("decode step on %s v%d failed: %s: %s",
+                           self.name, self.version, type(e).__name__, e)
+                err = (e if isinstance(e, ServingError) else
+                       ServingError(f"{type(e).__name__}: {e}"))
+                with self._cond:
+                    for s in live:
+                        if not s.req.ev.is_set():
+                            self._fail_locked(s.req, err)
+                    self._slots = [s for s in self._slots
+                                   if s not in live]
+                    self._g_live.set(len(self._slots))
+                    if self._donate:
+                        # the raising step already consumed the donated
+                        # pools — k/v are deleted buffers and every
+                        # later step would fail too. Retire: fail
+                        # everything, refuse new submits (EngineRetired
+                        # -> the server resubmits after a redeploy)
+                        # instead of admitting doomed requests.
+                        _log.error(
+                            "decode pools for %s v%d were donated into "
+                            "the failed step — retiring the engine",
+                            self.name, self.version)
+                        self._stopping = True
+                        for s in self._slots:
+                            if not s.req.ev.is_set():
+                                self._fail_locked(s.req, err)
+                        self._slots = []
+                        for r in self._queue:
+                            self._fail_locked(r, err)
+                        self._queue.clear()
+                        self._g_depth.set(0)
+                        self._g_live.set(0)
+                        self._cond.notify_all()
+                        return
+
+    def _run_step_arrays(self, tokens, positions, tables, lens):
+        """Shared by warm() and live steps: count a DISTINCT-shape
+        compile, run the jitted step, rebind the pools."""
+        with self._step_mu:
+            key = (len(tokens), tables.shape[1])
+            if key not in self._compiled_shapes:
+                self._compiled_shapes.add(key)
+                _m_compiles.inc()
+            k, v, logits = self._step_fn(
+                self._params, tokens, positions, self.cache.k,
+                self.cache.v, tables, lens)
+            self.cache.rebind(k, v)
+            return logits
+
+    def _step(self, live: List[_Slot]):
+        s_bucket = _bucket_for(self._slot_ladder, len(live))
+        w_need = max(s.pages_held for s in live)
+        w_bucket = _bucket_for(self._width_ladder, w_need)
+        tokens = np.zeros(s_bucket, np.int32)
+        positions = np.zeros(s_bucket, np.int32)
+        lens = np.zeros(s_bucket, np.int32)
+        for i, s in enumerate(live):
+            tokens[i] = s.next_token()
+            positions[i] = s.pos
+            lens[i] = s.pos + 1  # the token written this step attends self
+        tables = self.cache.table_array(
+            [s.req.seq_id for s in live], w_bucket, rows=s_bucket)
+        t0 = time.perf_counter()
+        # one decode step joins the OLDEST live request's trace (a span
+        # has one parent); per-slot request spans live in the server
+        with _tracing.adopt(live[0].req.trace_ctx), \
+                _tracing.span("serving.decode.step", model=self.name,
+                              version=self.version, slots=s_bucket,
+                              width=w_bucket, live=len(live)):
+            logits = self._run_step_arrays(tokens, positions, tables, lens)
+        sampled = np.asarray(np.argmax(np.asarray(logits), axis=-1))
+        _m_step_ms.observe((time.perf_counter() - t0) * 1e3)
+        _m_steps.inc()
+        _m_occupancy.observe(len(live) / float(s_bucket))
+        with self._cond:
+            self._n_steps += 1
+        now = time.monotonic()
+        done: List[_Slot] = []
+        # the whole answer phase holds _cond: stop(drain=False) fails
+        # requests under _cond, so check-ev-then-answer must be atomic
+        # with it or the two sides can each answer the same request
+        notes: Dict[int, int] = {}
+        with self._cond:
+            for i, s in enumerate(live):
+                if s.req.ev.is_set():
+                    # already answered — stop(drain=False) raced this
+                    # step and failed the request; don't double-answer
+                    # or count a completion/token for it
+                    done.append(s)
+                    continue
+                s.pos += 1
+                notes[s.req.seq_id] = s.pos
+                tok = None
+                if s.pos >= len(s.req.prompt):
+                    tok = int(sampled[i])
+                    s.produced.append(tok)
+                    _m_tokens.inc()
+                finished = (len(s.produced) >= s.req.max_new
+                            or (tok is not None
+                                and self.spec.eos_id is not None
+                                and tok == self.spec.eos_id))
+                if finished:
+                    # finished beats a lapsed deadline: the result is
+                    # fully paid for — deliver it rather than discard
+                    done.append(s)
+                    self._complete(s)
+                elif s.req.deadline is not None and now > s.req.deadline:
+                    _m_deadline_miss.inc()
+                    done.append(s)
+                    self._fail_locked(s.req, DeadlineExceeded(
+                        f"request to decoder '{self.name}' lapsed "
+                        f"mid-decode after {len(s.produced)} tokens"))
+            # one allocator-lock round-trip for the whole step; seqs
+            # freed by _complete/_fail above are skipped inside
+            self.cache.allocator.note_tokens_many(notes)
+            if done:
+                self._slots = [s for s in self._slots if s not in done]
+                self._g_live.set(len(self._slots))
+                self._cond.notify_all()
+
+    def _complete(self, s: _Slot):
+        self.cache.allocator.free(s.req.seq_id)
+        _m_completions.inc()
+        _m_total.observe((time.monotonic() - s.req.t_enq) * 1e3)
+        s.req.result = {
+            "tokens": list(s.produced),
+            "prompt_len": int(len(s.req.prompt)),
+            "version": self.version,
+        }
+        s.req.ev.set()

@@ -99,6 +99,16 @@ def parse_buckets(spec) -> List[int]:
     return vals
 
 
+def bucket_for(ladder: Sequence[int], n: int) -> int:
+    """Smallest ladder entry >= n, clamped to the top bucket. The one
+    bucket-selection rule for every engine in serving/ — admission
+    bounds elsewhere keep n <= ladder[-1], so the clamp is defensive."""
+    for b in ladder:
+        if n <= b:
+            return b
+    return ladder[-1]
+
+
 class _FeedSpec:
     """What the engine knows about one feed: trailing dims (-1 = free)
     and dtype. Requests are validated against it at ADMISSION (a shape
@@ -444,10 +454,7 @@ class InferenceEngine:
 
     # -- scheduler --------------------------------------------------------
     def _bucket_for(self, rows: int) -> int:
-        for b in self._buckets:
-            if rows <= b:
-                return b
-        return self._max_batch
+        return bucket_for(self._buckets, rows)
 
     def _loop(self):
         while True:

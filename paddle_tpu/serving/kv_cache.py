@@ -1,0 +1,299 @@
+"""Paged KV cache — the HBM-bounded substrate of autoregressive decode.
+
+The decode-serving problem (PAPERS.md, Ragged Paged Attention): every
+live sequence needs its keys/values kept on-device, sequences have
+ragged lengths that change every step, and a compiled TPU program
+exists per SHAPE. Contiguous per-sequence KV buffers force a choice
+between recompiling per ragged length (O(shapes) jit entries) or
+padding every sequence to max length (HBM scales with max_len x
+max_sequences even when traffic is short). Paging dissolves both:
+
+  - K/V live in ONE preallocated pool of fixed-size pages
+    (``[layers, pages, page_size, kv_heads, head_dim]``) — the HBM
+    footprint is set at construction and never moves, no matter how
+    ragged the traffic;
+  - each sequence owns an ordered list of page ids (its PAGE TABLE);
+    the attention kernel reads K/V *through* the table, so sequences
+    of any length batch into one compiled shape per (slot-count,
+    table-width) bucket;
+  - pages return to a free list at completion and are reused — the
+    allocator is the admission-control surface: when pages run out the
+    refusal is an immediate structured ``ServerOverloaded``, never an
+    OOM mid-decode.
+
+Page 0 is RESERVED as the garbage page: dead decode slots and padded
+page-table entries all point at it, so masked lanes in the batched
+step have somewhere harmless to write/read without branching. The
+allocator never hands it out.
+
+Allocation policy: a sequence's worst-case page count
+(``ceil((prompt + max_new_tokens) / page_size)``) is allocated up
+front at admission. Pages are just indices into HBM that is already
+paid for, so reserving them early costs nothing physical — and it
+means a sequence that was admitted can NEVER die of page exhaustion
+mid-decode; the only refusal point is admission, where the client
+gets a typed reject it can retry against another replica. The cost is
+internal fragmentation (allocated-but-unwritten token slots), which
+the ``serving.kv.fragmentation`` gauge makes visible.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..observability import metrics as _metrics
+from .errors import ServerOverloaded
+
+__all__ = ["PageAllocator", "PagedKvCache", "GARBAGE_PAGE"]
+
+# page id 0 is never allocated: dead slots / table padding target it
+GARBAGE_PAGE = 0
+
+_m_allocs = _metrics.counter("serving.kv.page_allocs")
+_m_frees = _metrics.counter("serving.kv.page_frees")
+_m_exhausted = _metrics.counter("serving.kv.exhaustions")
+
+
+class PageAllocator:
+    """Free-list page allocator over a fixed pool of ``num_pages``.
+
+    Deterministic by construction (tested): fresh pages are handed out
+    in ascending id order, freed pages are reused LIFO — the same
+    admit/complete sequence always yields the same page tables, which
+    is what makes decode runs replayable and the chaos tests exact.
+
+    Thread-safe via one internal lock; every operation under it is a
+    list/dict edit (no blocking calls — L102-clean by construction).
+    """
+
+    def __init__(self, num_pages: int, page_size: int,
+                 label: Optional[str] = None):
+        if num_pages < 2:
+            raise ValueError(
+                f"need >= 2 pages (one is the reserved garbage page), "
+                f"got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._mu = threading.Lock()
+        # stack: pop() yields 1, 2, 3, ... when fresh; freed pages are
+        # pushed on top and reused first (LIFO)
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._owner: Dict[int, List[int]] = {}  # seq_id -> pages
+        self._tokens: Dict[int, int] = {}       # seq_id -> written tokens
+        self._total_tokens = 0                  # running sum(self._tokens)
+        # gauges are keyed per allocator when a label (engine name.vN)
+        # is given — coexisting pools (hot-swap drain, multi-model)
+        # must not last-writer-wins-clobber each other's occupancy;
+        # the plain names serve the bare/single-allocator case
+        sfx = f".{label}" if label else ""
+        self._g_pages_total = _metrics.gauge(f"serving.kv.pages_total{sfx}")
+        self._g_pages_used = _metrics.gauge(f"serving.kv.pages_used{sfx}")
+        # fraction of ALLOCATED token capacity not (yet) holding a real
+        # token — the price of reserve-at-admission, and the signal
+        # that page_size is too coarse for the traffic's length mix
+        self._g_fragmentation = _metrics.gauge(
+            f"serving.kv.fragmentation{sfx}")
+        self._g_pages_total.set(self.num_pages)
+        self._publish_locked()
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def pages_free(self) -> int:
+        with self._mu:
+            return len(self._free)
+
+    @property
+    def pages_used(self) -> int:
+        """Allocated pages (excluding the reserved garbage page)."""
+        with self._mu:
+            return (self.num_pages - 1) - len(self._free)
+
+    def stats(self) -> Dict[str, float]:
+        with self._mu:
+            used = (self.num_pages - 1) - len(self._free)
+            toks = self._total_tokens
+            cap = used * self.page_size
+            return {
+                "pages_total": self.num_pages,
+                "pages_used": used,
+                "pages_free": len(self._free),
+                "page_size": self.page_size,
+                "sequences": len(self._owner),
+                "tokens": toks,
+                "fragmentation": (1.0 - toks / cap) if cap else 0.0,
+            }
+
+    def _publish_locked(self):
+        used = (self.num_pages - 1) - len(self._free)
+        self._g_pages_used.set(used)
+        toks = self._total_tokens
+        cap = used * self.page_size
+        self._g_fragmentation.set(
+            round(1.0 - toks / cap, 6) if cap else 0.0)
+
+    def retire(self):
+        """Zero this allocator's gauges (engine retirement) so a
+        drained pool's final values don't linger as live occupancy."""
+        with self._mu:
+            self._g_pages_total.set(0)
+            self._g_pages_used.set(0)
+            self._g_fragmentation.set(0.0)
+
+    # -- lifecycle --------------------------------------------------------
+    def pages_for_tokens(self, n_tokens: int) -> int:
+        return max(1, -(-int(n_tokens) // self.page_size))
+
+    def alloc(self, seq_id: int, n_tokens: int) -> List[int]:
+        """Reserve the worst-case page count for a sequence of up to
+        ``n_tokens``. Raises ``ServerOverloaded`` (the pool IS the
+        admission bound) without side effects when short."""
+        need = self.pages_for_tokens(n_tokens)
+        with self._mu:
+            if seq_id in self._owner:
+                raise ValueError(f"sequence {seq_id} already has pages")
+            if need > len(self._free):
+                _m_exhausted.inc()
+                raise ServerOverloaded(
+                    f"KV page pool exhausted: need {need} pages for "
+                    f"{n_tokens} tokens, {len(self._free)} of "
+                    f"{self.num_pages - 1} free — retry later, raise "
+                    f"kv_num_pages, or shed to another replica")
+            pages = [self._free.pop() for _ in range(need)]
+            self._owner[seq_id] = pages
+            self._tokens[seq_id] = 0
+            _m_allocs.inc(need)
+            self._publish_locked()
+            return list(pages)
+
+    def note_tokens(self, seq_id: int, n_tokens: int):
+        """Record how many tokens the sequence has actually written —
+        feeds the fragmentation gauge; never moves pages."""
+        self.note_tokens_many({seq_id: n_tokens})
+
+    def note_tokens_many(self, updates: Dict[int, int]):
+        """Batched ``note_tokens`` for a whole decode step: one lock
+        acquisition and one gauge publish for all live slots (the
+        per-step hot path must not take the lock once per slot).
+        Unknown (already freed) sequences are skipped."""
+        with self._mu:
+            changed = False
+            for seq_id, n_tokens in updates.items():
+                if seq_id in self._tokens:
+                    n = int(n_tokens)
+                    self._total_tokens += n - self._tokens[seq_id]
+                    self._tokens[seq_id] = n
+                    changed = True
+            if changed:
+                self._publish_locked()
+
+    def free(self, seq_id: int) -> int:
+        """Return a sequence's pages to the free list (LIFO reuse).
+        Idempotent: freeing an unknown sequence is a no-op (the
+        completion path and an abort path may race)."""
+        with self._mu:
+            pages = self._owner.pop(seq_id, None)
+            self._total_tokens -= self._tokens.pop(seq_id, 0)
+            if not pages:
+                return 0
+            # reversed: re-allocating immediately yields the same ids in
+            # the same order the sequence held them (determinism test)
+            self._free.extend(reversed(pages))
+            _m_frees.inc(len(pages))
+            self._publish_locked()
+            return len(pages)
+
+    def _fill_row_locked(self, seq_id: int, out: np.ndarray):
+        pages = self._owner.get(seq_id, [])
+        if len(pages) > out.shape[0]:
+            raise ValueError(
+                f"sequence {seq_id} holds {len(pages)} pages, table "
+                f"width bucket {out.shape[0]} too narrow")
+        out[:len(pages)] = pages
+
+    def table_row(self, seq_id: int, width: int) -> np.ndarray:
+        """The sequence's page table padded to ``width`` with the
+        garbage page — the row shape is a COMPILED shape, so padding
+        happens here, once, deterministically."""
+        with self._mu:
+            row = np.full((width,), GARBAGE_PAGE, dtype=np.int32)
+            self._fill_row_locked(seq_id, row)
+            return row
+
+    def table_rows(self, seq_ids: Sequence[int], width: int,
+                   rows: int) -> np.ndarray:
+        """Stacked padded page tables ``[rows, width]`` for a whole
+        decode batch under ONE lock acquisition — the per-step hot
+        path must not take the allocator lock once per live slot."""
+        out = np.full((int(rows), width), GARBAGE_PAGE, dtype=np.int32)
+        with self._mu:
+            for i, sid in enumerate(seq_ids):
+                self._fill_row_locked(sid, out[i])
+        return out
+
+
+class PagedKvCache:
+    """The device-side pool the allocator's page ids index into.
+
+    K and V are each ``[layers, pages, page_size, kv_heads, head_dim]``
+    jax arrays allocated ONCE — ``hbm_bytes`` is the whole KV budget of
+    the engine, independent of how ragged the traffic is. The decode
+    step threads the pools through functionally (donated on TPU so XLA
+    updates them in place); the cache object rebinds after each step.
+    """
+
+    def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
+                 *, page_size: int, num_pages: int, dtype=None,
+                 label: Optional[str] = None):
+        import jax.numpy as jnp
+
+        self.num_layers = int(num_layers)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        self.allocator = PageAllocator(num_pages, page_size, label=label)
+        self.dtype = jnp.float32 if dtype is None else dtype
+        shape = (self.num_layers, int(num_pages), int(page_size),
+                 self.num_kv_heads, self.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+
+    @property
+    def page_size(self) -> int:
+        return self.allocator.page_size
+
+    @property
+    def num_pages(self) -> int:
+        return self.allocator.num_pages
+
+    @property
+    def hbm_bytes(self) -> int:
+        """The preallocated KV budget: fixed at construction."""
+        return 2 * int(np.prod(self.k.shape)) * self.k.dtype.itemsize
+
+    def rebind(self, k, v):
+        """Adopt the pools a decode step returned. Shape-checked: the
+        whole point is that the footprint NEVER changes."""
+        if tuple(k.shape) != tuple(self.k.shape) or \
+                tuple(v.shape) != tuple(self.v.shape):
+            raise ValueError(
+                f"decode step changed the pool shape: "
+                f"{tuple(self.k.shape)} -> {tuple(k.shape)}")
+        self.k = k
+        self.v = v
+
+    def table_array(self, seq_ids: Sequence[int], width: int,
+                    rows: Optional[int] = None) -> np.ndarray:
+        """Stacked page tables for a decode batch: ``[rows, width]``
+        int32, dead rows (beyond ``seq_ids``) all-garbage."""
+        n = len(seq_ids) if rows is None else int(rows)
+        return self.allocator.table_rows(seq_ids, width, n)
+
+    def release(self):
+        """Drop the device pools (engine retirement) so HBM frees, and
+        zero the allocator's gauges so the dead pool stops reporting."""
+        self.k = None
+        self.v = None
+        self.allocator.retire()

@@ -39,7 +39,12 @@ _m_swaps = _metrics.counter("serving.hot_swaps")
 
 
 class ModelRegistry:
-    """name -> live InferenceEngine, with swap/unload lifecycle."""
+    """name -> live engine, with swap/unload lifecycle.
+
+    Engine-kind-agnostic: anything with ``name``/``version``/``kind``/
+    ``stats()``/``stop(drain=)`` deploys here — the one-shot
+    InferenceEngine and the decode DecodeEngine share the registry (and
+    therefore the hot-swap drain + executable-release guarantees)."""
 
     def __init__(self):
         self._mu = threading.Lock()

@@ -10,7 +10,12 @@ Methods (all fire the `serving.<method>` fault site before running, so
 `PADDLE_TPU_FAULTS='error@serving.infer:0'` chaos plans reach them):
 
     infer(model, feeds, deadline_ms)   -> {model, version, outputs}
+    generate(model, prompt, max_new_tokens, deadline_ms)
+                                       -> {model, version, tokens,
+                                           prompt_len}  (decoders)
     load_model(model, dirname, ...)    -> engine stats (after warmup)
+    load_decoder(model, spec, ...)     -> decode-engine stats (after the
+                                          full slot/width warm)
     unload_model(model)                -> final engine stats
     list_models()                      -> {name: stats}
     health()                           -> {"ok": True, "models": [...]}
@@ -20,7 +25,11 @@ its feeds), but it is deliberately NOT declared in RpcServer's
 `idempotent` set — it rides the dedup cache instead, so a client
 retransmit after a lost reply is answered from the cached response
 without re-running the batch (rpc.server.dedup_hits counts exactly one
-per retransmitted frame; the chaos test pins this). Re-execution would
+per retransmitted frame; the chaos test pins this). `generate` rides
+the dedup cache for the stronger reason: re-decoding a whole sequence
+on a retransmit would burn len(prompt)+max_new decode steps AND
+re-reserve KV pages — the chaos test pins that a killed generate reply
+is answered from the cache with zero extra decode steps. Re-execution would
 be CORRECT but wasteful — and under overload, wasteful is wrong.
 Memory sizing note: the dedup cache holds recent infer RESPONSES (up
 to `dedup_cap`, held >= 900s, 4x-cap safety valve — see
@@ -78,7 +87,9 @@ class ServingServer:
         self._registry = registry or ModelRegistry()
         handlers = {
             "infer": self._infer,
+            "generate": self._generate,
             "load_model": self._load_model,
+            "load_decoder": self._load_decoder,
             "unload_model": self._unload_model,
             "list_models": self._list_models,
             "health": self._health,
@@ -144,6 +155,10 @@ class ServingServer:
         with _tracing.span("serving.request", model=str(model)):
             for _ in range(self._SWAP_RETRIES):
                 engine = self._registry.get(str(model))
+                if engine.kind == "decoder":
+                    raise ServingError(
+                        f"model '{model}' is a decoder — call generate, "
+                        "not infer")
                 try:
                     outputs, version = engine.infer(
                         feeds, deadline_ms=deadline_ms)
@@ -157,6 +172,82 @@ class ServingServer:
             raise ServingError(
                 f"model '{model}' kept retiring across "
                 f"{self._SWAP_RETRIES} resubmits — deploy storm?")
+
+    def _generate(self, model: str, prompt: Sequence[int],
+                  max_new_tokens: int = 16,
+                  deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+        """Autoregressive decode on a loaded DecodeEngine. Same swap-
+        resubmit contract as _infer: racing a hot-swap re-enqueues on
+        the replacement decoder instead of failing the request."""
+        with _tracing.span("serving.decode.request", model=str(model)):
+            for _ in range(self._SWAP_RETRIES):
+                engine = self._registry.get(str(model))
+                if engine.kind != "decoder":
+                    raise ServingError(
+                        f"model '{model}' is not a decoder — call infer, "
+                        "not generate")
+                try:
+                    out = engine.generate(
+                        prompt, max_new_tokens=max_new_tokens,
+                        deadline_ms=deadline_ms)
+                except EngineRetired:
+                    _m_resubmits.inc()
+                    continue
+                return {"model": str(model), **out}
+            raise ServingError(
+                f"decoder '{model}' kept retiring across "
+                f"{self._SWAP_RETRIES} resubmits — deploy storm?")
+
+    def _resolve_version(self, model: str, version: Optional[int]) -> int:
+        """Auto-assign (live+1) or validate a pinned version. A pinned
+        version EQUAL to the live one is refused: the new engine would
+        mint the same per-version gauge series (queue_depth/live_slots/
+        kv pool) and the old engine's retirement would then zero the
+        live engine's gauges — the clobber the per-version keying
+        exists to prevent. Redeploying an older (or any other) pinned
+        version is fine; only the collision is an error."""
+        try:
+            live = self._registry.get(model).version
+        except ModelNotFound:
+            live = None
+        if version is None:
+            return 1 if live is None else live + 1
+        version = int(version)
+        if live is not None and version == live:
+            raise ValueError(
+                f"model '{model}' v{version} is already the live "
+                f"version — pin a different version or omit it to "
+                f"auto-assign v{live + 1}")
+        return version
+
+    def _load_decoder(self, model: str, spec: Dict[str, Any],
+                      version: Optional[int] = None,
+                      slots: Optional[Sequence[int]] = None,
+                      page_size: Optional[int] = None,
+                      num_pages: Optional[int] = None,
+                      max_seq_len: Optional[int] = None,
+                      max_queue: Optional[int] = None) -> Dict[str, Any]:
+        """Build + warm (every slot/width shape) + atomically install a
+        DecodeEngine from an architecture/seed spec dict. Hot-swapping
+        a decoder drains the old engine — every in-flight SEQUENCE
+        finishes on its own KV cache before the old pool releases."""
+        from .decode import DecodeEngine, DecoderSpec
+
+        model = str(model)
+        # lint: allow-blocking — deploys serialize end-to-end; see
+        # _load_mu above. generate/infer traffic never takes this lock.
+        with self._load_mu:
+            version = self._resolve_version(model, version)
+
+            def build():
+                return DecodeEngine(
+                    DecoderSpec.from_dict(spec), name=model,
+                    version=version, slots=slots, page_size=page_size,
+                    num_pages=num_pages, max_seq_len=max_seq_len,
+                    max_queue=max_queue)
+
+            engine = self._registry.deploy(model, build)
+            return engine.stats()
 
     def _load_model(self, model: str, dirname: str,
                     version: Optional[int] = None,
@@ -172,11 +263,7 @@ class ServingServer:
         # compile + drain of the old engine) is deliberately serialized;
         # see _load_mu above. infer traffic never takes this lock.
         with self._load_mu:
-            if version is None:
-                try:
-                    version = self._registry.get(model).version + 1
-                except ModelNotFound:
-                    version = 1
+            version = self._resolve_version(model, version)
             if kind == "auto":
                 kind = ("exported"
                         if os.path.exists(os.path.join(

@@ -1,0 +1,564 @@
+"""Decode serving (ISSUE 6): paged KV cache, ragged paged attention,
+continuous batching.
+
+Coverage map:
+  - PageAllocator: alloc/free/reuse determinism, occupancy bound,
+    exhaustion refusal (structured, side-effect-free), page-table
+    padding stability, fragmentation accounting;
+  - paged_attention: reference path vs a dense numpy oracle, vs the
+    flash kernel's dense path, vs the Pallas paged kernel in interpret
+    mode — identical numerics across all four;
+  - DecodeEngine: warm pre-compiles exactly the (slots x widths)
+    ladder and sequence CHURN AT RAGGED LENGTHS performs ZERO new
+    compiles (the tier-1 acceptance guard — counter-asserted, and the
+    fluid executor's jit counter stays untouched), KV footprint fixed,
+    greedy decode deterministic;
+  - continuous batching beats drain-per-batch by EXACT step counts
+    (the scheduler-shape claim, proven with counters, not clocks);
+  - admission: queue overload, page-pool exhaustion, RequestTooLarge,
+    deadline misses — all typed and counted;
+  - registry hot-swap of decoders: drain + release;
+  - chaos: a generate reply killed mid-frame is answered from the
+    idempotency dedup cache on retransmit — zero re-decoding, exact
+    counters;
+  - rpc zero-copy satellite: from_wire(copy=False) returns READ-ONLY
+    buffer-backed views (mutation raises), get_param rides it, wire
+    byte counters identical to the copying path.
+
+All timing-sensitive claims are COUNTER asserts (tier-1 wall time
+swings 604-836s on this host — see memory/tier1-timing-margin).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import metrics
+from paddle_tpu.serving import (
+    DecodeEngine, DecoderSpec, ModelRegistry, PageAllocator,
+    RequestTooLarge, ServerOverloaded, ServingClient, ServingServer,
+)
+from paddle_tpu.serving.errors import (DeadlineExceeded, EngineRetired,
+                                       ServingError)
+from paddle_tpu.serving.kv_cache import GARBAGE_PAGE
+
+
+def _spec():
+    """Smallest decoder that still exercises GQA (2 q heads per kv
+    head) and multi-layer pool indexing."""
+    return DecoderSpec(vocab=32, d_model=16, n_layers=2, n_heads=2,
+                      n_kv_heads=1, seed=7)
+
+
+def _engine(**kw):
+    """Tiny ladders so warm compiles 4 shapes: slots [1,2] x widths
+    [1,2] (max_seq_len 8 / page_size 4)."""
+    kw.setdefault("slots", [1, 2])
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 10)
+    kw.setdefault("max_seq_len", 8)
+    kw.setdefault("max_queue", 16)
+    return DecodeEngine(_spec(), name=kw.pop("name", "toy"), **kw)
+
+
+# --- page allocator ------------------------------------------------------
+
+def test_page_allocator_determinism_and_reuse():
+    """Fresh pages come out in ascending order; freed pages are reused
+    LIFO — the same admit/complete history always yields the same page
+    tables (replayable decode)."""
+    a = PageAllocator(num_pages=8, page_size=4)
+    assert a.alloc(1, 8) == [1, 2]     # ceil(8/4) = 2 pages
+    assert a.alloc(2, 1) == [3]
+    assert a.alloc(3, 5) == [4, 5]
+    a.free(2)
+    a.free(1)
+    # LIFO: seq 1's pages (freed last) come back first, in held order
+    assert a.alloc(4, 9) == [1, 2, 3]
+    assert metrics.counter("serving.kv.page_allocs").value() == 8
+    assert metrics.counter("serving.kv.page_frees").value() == 3
+    # double free is a no-op, not corruption
+    assert a.free(1) == 0
+
+
+def test_page_allocator_exhaustion_is_clean():
+    """Refusal is typed, counted, and side-effect-free: the failed
+    alloc leaves the free list exactly as it was."""
+    a = PageAllocator(num_pages=4, page_size=2)   # 3 usable pages
+    a.alloc(1, 4)                                  # takes 2
+    free_before = a.pages_free
+    with pytest.raises(ServerOverloaded, match="page pool exhausted"):
+        a.alloc(2, 4)                              # needs 2, only 1 left
+    assert a.pages_free == free_before
+    assert metrics.counter("serving.kv.exhaustions").value() == 1
+    a.free(1)
+    assert a.pages_used == 0
+    assert a.alloc(3, 4) == [1, 2]                 # pool fully recovered
+
+
+def test_page_table_padding_and_fragmentation():
+    a = PageAllocator(num_pages=8, page_size=4)
+    a.alloc(1, 6)  # 2 pages for 6 tokens
+    row = a.table_row(1, 4)
+    assert row.dtype == np.int32 and row.shape == (4,)
+    assert list(row) == [1, 2, GARBAGE_PAGE, GARBAGE_PAGE]
+    with pytest.raises(ValueError, match="too narrow"):
+        a.table_row(1, 1)
+    a.note_tokens(1, 3)  # 3 of 8 reserved token slots written
+    st = a.stats()
+    assert st["pages_used"] == 2 and st["tokens"] == 3
+    assert st["fragmentation"] == pytest.approx(1.0 - 3 / 8)
+    assert metrics.gauge("serving.kv.pages_total").value() == 8
+
+
+# --- paged attention numerics -------------------------------------------
+
+def test_paged_attention_matches_dense_and_flash():
+    """The A/B the tentpole demands: the paged reference path, the
+    Pallas paged kernel (interpret), the flash kernel's dense path, and
+    a plain numpy softmax oracle all agree on the same ragged batch."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.ops.pallas_kernels.flash_attention import \
+        flash_attention
+    from paddle_tpu.fluid.ops.pallas_kernels.paged_attention import (
+        _paged_attention_pallas, paged_attention_reference)
+
+    rng = np.random.RandomState(0)
+    B, Hq, Hkv, D, ps = 3, 4, 2, 8, 8
+    P, W = 10, 3
+    lens = np.array([20, 5, 0], np.int32)          # ragged + a dead slot
+    tables = np.array([[1, 2, 3], [4, 0, 0], [0, 0, 0]], np.int32)
+    q = rng.randn(B, Hq, D).astype(np.float32)
+    kp = rng.randn(P, ps, Hkv, D).astype(np.float32)
+    vp = rng.randn(P, ps, Hkv, D).astype(np.float32)
+
+    ref = np.asarray(paged_attention_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lens)))
+
+    # oracle: dense softmax per sequence over the gathered pages
+    for b in range(B):
+        L = int(lens[b])
+        if L == 0:
+            np.testing.assert_array_equal(ref[b], 0.0)
+            continue
+        k = kp[tables[b]].reshape(-1, Hkv, D)[:L].repeat(Hq // Hkv, 1)
+        v = vp[tables[b]].reshape(-1, Hkv, D)[:L].repeat(Hq // Hkv, 1)
+        s = np.einsum("hd,thd->ht", q[b] * D ** -0.5, k)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(ref[b], np.einsum("ht,thd->hd", p, v),
+                                   rtol=2e-5, atol=2e-6)
+        # flash kernel's dense path on the same contiguous K/V
+        fl = np.asarray(flash_attention(
+            jnp.asarray(q[b][None, None]),          # [1, Sq=1, H, D]
+            jnp.asarray(k.transpose(1, 0, 2)[None].transpose(0, 2, 1, 3)),
+            jnp.asarray(v.transpose(1, 0, 2)[None].transpose(0, 2, 1, 3)),
+            causal=False, block_q=8, block_k=8, interpret=True))
+        np.testing.assert_allclose(ref[b], fl[0, 0], rtol=2e-4, atol=2e-5)
+
+    # the Pallas paged kernel (scalar-prefetch page walk), interpret mode
+    pal = np.asarray(_paged_attention_pallas(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lens), interpret=True))
+    np.testing.assert_allclose(pal, ref, rtol=2e-5, atol=2e-6)
+
+
+# --- the engine: compile guard, determinism, footprint -------------------
+
+def test_decode_churn_zero_new_compiles():
+    """THE acceptance guard: after warm, a churn of admits and
+    completions at ragged prompt/generation lengths performs ZERO new
+    decode-step compiles (and never touches the fluid executor's jit
+    cache), and the KV pool never grows."""
+    # pool sized for the whole submitted queue: pages are reserved at
+    # ADMISSION (kv_cache.py), so 10 queued 1-2 page sequences need
+    # up to 20 usable pages
+    eng = _engine(num_pages=24)
+    try:
+        # warm compiled exactly the ladder product
+        assert eng.slot_ladder == [1, 2]
+        assert eng.table_width_ladder == [1, 2]
+        assert sorted(eng._compiled_shapes) == [(1, 1), (1, 2),
+                                                (2, 1), (2, 2)]
+        pool_shape = tuple(eng.cache.k.shape)
+        base_decode = metrics.counter("serving.decode.compiles").value()
+        base_exec = metrics.counter("executor.jit_compiles").value()
+
+        rng = np.random.RandomState(1)
+        reqs = []
+        for _ in range(10):
+            prompt = rng.randint(0, 32, size=1 + int(rng.randint(4)))
+            max_new = 1 + int(rng.randint(8 - len(prompt)))
+            reqs.append(eng.submit(prompt, max_new_tokens=max_new))
+        for r in reqs:
+            assert r.ev.wait(120), "decode timed out"
+            assert r.error is None, r.error
+            assert 1 <= len(r.result["tokens"]) <= 8
+
+        assert metrics.counter("serving.decode.compiles").value() \
+            == base_decode, "sequence churn escaped the warmed ladder"
+        assert metrics.counter("executor.jit_compiles").value() \
+            == base_exec, "decode path leaked into the executor jit cache"
+        assert (len(eng._compiled_shapes) ==
+                len(eng.slot_ladder) * len(eng.table_width_ladder))
+        # footprint: the pool is the SAME preallocated arrays' shape,
+        # and every page went back to the free list
+        assert tuple(eng.cache.k.shape) == pool_shape
+        st = eng.cache.allocator.stats()
+        assert st["pages_total"] == 24 and st["pages_used"] == 0
+        assert metrics.counter("serving.decode.completions").value() == 10
+    finally:
+        eng.stop()
+
+
+def test_decode_greedy_is_deterministic():
+    eng = _engine()
+    try:
+        a = eng.generate([3, 1, 4], max_new_tokens=5)
+        b = eng.generate([3, 1, 4], max_new_tokens=5)
+        assert a["tokens"] == b["tokens"]
+        assert a["prompt_len"] == 3 and len(a["tokens"]) == 5
+        # a fresh engine with the same seeded spec replays bitwise
+        eng2 = _engine(name="toy2")
+        try:
+            c = eng2.generate([3, 1, 4], max_new_tokens=5)
+            assert c["tokens"] == a["tokens"]
+        finally:
+            eng2.stop()
+    finally:
+        eng.stop()
+
+
+def test_continuous_beats_drain_by_exact_step_count():
+    """The continuous-batching claim, proven with counters: 2 slots,
+    one long sequence (prompt 1 + 9 new = 9 steps) + two short ones
+    (1 step each). Drain-per-batch runs 9 + 1 = 10 steps (the second
+    wave waits for the long straggler; a finished slot idles).
+    Continuous admits the third sequence into the long one's in-flight
+    steps: short steps co-ride long steps, total = the long sequence's
+    own 9 (modulo submission racing, bounded below)."""
+    results = {}
+    for mode, continuous in (("drain", False), ("cont", True)):
+        eng = _engine(name=f"m_{mode}", slots=[2], max_seq_len=12,
+                      num_pages=12, continuous=continuous)
+        try:
+            base = metrics.counter("serving.decode.steps").value()
+            long = eng.submit([1], max_new_tokens=9)      # 9 steps
+            s1 = eng.submit([2], max_new_tokens=1)        # 1 step
+            s2 = eng.submit([3], max_new_tokens=1)        # 1 step
+            for r in (long, s1, s2):
+                assert r.ev.wait(120) and r.error is None, r.error
+            results[mode] = \
+                metrics.counter("serving.decode.steps").value() - base
+        finally:
+            eng.stop()
+    # drain is exactly 10 no matter how admission raced: waves are
+    # {long}, {s1, s2} (9+1) or {long, s1}, {s2} (9+1)
+    assert results["drain"] == 10, results
+    # continuous: s1/s2 ride the long sequence's steps; even if the
+    # submitting thread lost a couple of races the total stays below
+    # drain (9 in the common schedule)
+    assert results["cont"] < results["drain"], results
+    occ = metrics.snapshot()["serving.decode.occupancy"]
+    assert occ["count"] > 0
+
+
+# --- admission / deadlines ----------------------------------------------
+
+def test_decode_admission_refusals_are_typed():
+    eng = _engine(max_queue=2)
+    try:
+        with pytest.raises(ValueError, match="empty prompt"):
+            eng.submit([])
+        with pytest.raises(ValueError, match="token ids"):
+            eng.submit([99])
+        with pytest.raises(RequestTooLarge, match="max_seq_len"):
+            eng.submit([1, 2, 3], max_new_tokens=20)
+
+        # page exhaustion: pool is 9 usable pages of 4 tokens; three
+        # 8-token sequences take 2 pages each, the queue bound (2) is
+        # irrelevant because slots drain — so grab pages directly too
+        held = [eng.cache.allocator.alloc(1000 + i, 12) for i in range(3)]
+        base_over = metrics.counter("serving.decode.overloads").value()
+        with pytest.raises(ServerOverloaded, match="page pool exhausted"):
+            eng.submit([1, 2, 3, 4], max_new_tokens=4)   # needs 2 pages
+        assert metrics.counter("serving.decode.overloads").value() \
+            == base_over + 1
+        for i in range(3):
+            eng.cache.allocator.free(1000 + i)
+        # pool recovered: the same request is admitted now
+        out = eng.generate([1, 2, 3, 4], max_new_tokens=4)
+        assert len(out["tokens"]) == 4
+    finally:
+        eng.stop()
+
+
+def test_finished_result_delivered_even_if_deadline_lapsed():
+    """A request whose FINAL token lands in the same step its deadline
+    lapses gets the fully-computed result, not DeadlineExceeded — the
+    deadline sheds remaining work; it never discards paid-for output."""
+    eng = _engine()
+    try:
+        req = eng.submit([1], max_new_tokens=2)  # no deadline yet
+        # wait for the first generated token, then lapse the deadline
+        # so the step producing token 2 sees finished AND lapsed
+        deadline = time.monotonic()
+        for _ in range(2000):
+            with eng._cond:
+                slot = next((s for s in eng._slots if s.req is req), None)
+                if slot is not None and len(slot.produced) >= 1:
+                    req.deadline = deadline  # already in the past
+                    break
+            if req.ev.is_set():
+                break  # scheduler outran the poll: delivery still asserted
+            time.sleep(0.002)
+        assert req.ev.wait(60)
+        assert req.error is None, f"completed result discarded: {req.error}"
+        assert len(req.result["tokens"]) == 2
+    finally:
+        eng.stop()
+
+
+def test_decode_deadline_miss_frees_pages():
+    eng = _engine()
+    try:
+        with pytest.raises(DeadlineExceeded):
+            eng.generate([1, 2], max_new_tokens=6, deadline_ms=0.0)
+        assert metrics.counter(
+            "serving.decode.deadline_misses").value() >= 1
+        # the lapsed sequence's pages went back to the pool
+        assert eng.cache.allocator.stats()["pages_used"] == 0
+    finally:
+        eng.stop()
+
+
+# --- registry / hot-swap -------------------------------------------------
+
+def test_cancel_withdraws_abandoned_request_and_frees_pages():
+    """An abandoned generate (wait timeout) cancels its sequence: the
+    page reservation frees immediately and no decode steps are spent
+    completing a result nobody reads."""
+    eng = _engine(slots=[1])   # one slot: the second submit queues
+    try:
+        first = eng.submit([1, 2], max_new_tokens=6)
+        waiting = eng.submit([3, 4], max_new_tokens=6)
+        assert eng.cancel(waiting, msg="test walked away")
+        assert waiting.ev.is_set()
+        assert isinstance(waiting.error, ServingError)
+        assert "canceled" in str(waiting.error)
+        assert metrics.counter("serving.decode.cancels").value() == 1
+        assert first.ev.wait(60) and first.error is None
+        assert len(first.result["tokens"]) == 6
+        # canceling a finished request is a no-op
+        assert not eng.cancel(first)
+        assert metrics.counter("serving.decode.cancels").value() == 1
+        assert eng.cache.allocator.stats()["pages_used"] == 0
+    finally:
+        eng.stop()
+
+
+def test_step_failure_with_donated_pools_retires_engine():
+    """With donation active a raising step has already consumed the KV
+    pools — the engine must retire (fail everything, refuse submits)
+    instead of admitting requests doomed to fail on deleted buffers."""
+    eng = _engine()
+    try:
+        def _boom(*a, **k):
+            raise RuntimeError("injected step failure")
+        eng._donate = True      # CPU tests never donate; force the path
+        eng._step_fn = _boom
+        req = eng.submit([1, 2], max_new_tokens=4)
+        assert req.ev.wait(60)
+        assert isinstance(req.error, ServingError)
+        assert "injected step failure" in str(req.error)
+        # the scheduler retired the engine: new submits are refused so
+        # the server's resubmit loop lands on a redeployed engine
+        with pytest.raises(EngineRetired):
+            eng.submit([3], max_new_tokens=2)
+        # nothing leaked: pages back, gauges zeroed
+        assert eng.cache.allocator.stats()["pages_used"] == 0
+        assert metrics.gauge(
+            "serving.decode.live_slots.toy.v1").value() == 0
+        assert metrics.gauge(
+            "serving.decode.queue_depth.toy.v1").value() == 0
+    finally:
+        eng.stop()
+
+
+def test_registry_hot_swaps_decoders_with_release():
+    reg = ModelRegistry()
+    reg.deploy("g", lambda: _engine(name="g", version=1))
+    out1 = reg.get("g").generate([5, 6], max_new_tokens=3)
+    assert out1["version"] == 1
+    old = reg.get("g")
+    reg.deploy("g", lambda: _engine(name="g", version=2))
+    out2 = reg.get("g").generate([5, 6], max_new_tokens=3)
+    assert out2["version"] == 2
+    # same seeded spec -> the swap is invisible in the tokens
+    assert out2["tokens"] == out1["tokens"]
+    # the retired engine released its params and KV pool
+    assert old._released and old._params is None and old.cache.k is None
+    # ... and zeroed its per-version gauges — no phantom load on a
+    # dead engine (live_slots included: the scheduler can exit between
+    # steps without a final answer phase)
+    assert metrics.gauge("serving.decode.queue_depth.g.v1").value() == 0
+    assert metrics.gauge("serving.decode.live_slots.g.v1").value() == 0
+    assert metrics.gauge("serving.kv.pages_used.g.v1").value() == 0
+    assert metrics.counter("serving.hot_swaps").value() == 1
+    reg.unload_all()
+
+
+def test_swap_drains_in_flight_sequences():
+    """A sequence admitted before the flip finishes on the OLD decoder
+    (its KV history lives in the old pool) — zero dropped sequences."""
+    reg = ModelRegistry()
+    reg.deploy("g", lambda: _engine(name="g", version=1))
+    req = reg.get("g").submit([1], max_new_tokens=7)
+    reg.deploy("g", lambda: _engine(name="g", version=2))
+    assert req.ev.wait(120), "in-flight sequence dropped by hot-swap"
+    assert req.error is None
+    assert req.result["version"] == 1 and len(req.result["tokens"]) == 7
+    reg.unload_all()
+
+
+# --- RPC / chaos ---------------------------------------------------------
+
+@pytest.fixture
+def decode_server():
+    srv = ServingServer()
+    addr = srv.serve()
+    cli = ServingClient(addr)
+    cli.load_decoder("gen", _spec().to_dict(), slots=[1, 2], page_size=4,
+                     num_pages=10, max_seq_len=8)
+    yield srv, cli, addr
+    cli.close()
+    srv.shutdown()
+
+
+def test_generate_rpc_roundtrip(decode_server):
+    srv, cli, _addr = decode_server
+    out = cli.generate("gen", [3, 1, 4], max_new_tokens=5)
+    assert out["version"] == 1 and len(out["tokens"]) == 5
+    # wrong-kind calls are typed errors, not crashes
+    with pytest.raises(ServingError, match="is a decoder"):
+        cli.infer("gen", {"x": np.zeros((1, 2), np.float32)})
+    listed = cli.list_models()
+    assert listed["gen"]["kind"] == "decoder"
+    assert listed["gen"]["kv"]["pages_used"] == 0
+    # redeploying the LIVE version is refused before anything is built:
+    # a same-version engine would mint the same per-version gauge
+    # series and its retirement would zero the live engine's gauges
+    with pytest.raises(ValueError, match="already the live version"):
+        cli.load_decoder("gen", _spec().to_dict(), version=1,
+                         slots=[1, 2], page_size=4, num_pages=10,
+                         max_seq_len=8)
+    assert metrics.gauge("serving.kv.pages_total.gen.v1").value() == 10
+
+
+@pytest.mark.chaos
+def test_generate_reply_dropped_retry_is_dedup_exact(decode_server):
+    """Kill the generate REPLY mid-frame: the retransmit is answered
+    from the dedup cache WITHOUT re-decoding — the decode step counter
+    proves the sequence ran exactly once."""
+    from paddle_tpu.distributed import faults
+
+    srv, cli, _addr = decode_server
+    metrics.reset_metrics()  # isolate the faulted call's counters
+    with faults.scoped("drop@recv.generate:0") as plan:
+        out = cli.generate("gen", [2, 7], max_new_tokens=4)
+    assert [(k, s) for k, s, _i in plan.injected()] == \
+        [("drop", "recv.generate")]
+    assert len(out["tokens"]) == 4
+    assert metrics.counter("rpc.client.retries").value() == 1
+    assert metrics.counter("rpc.server.dedup_hits").value() == 1
+    assert metrics.counter("serving.decode.requests").value() == 1
+    assert metrics.counter("serving.decode.completions").value() == 1
+    # one step per consumed token: 2 prompt + 4 generated, minus the
+    # last prompt step doubling as the first sample = 5 steps, run ONCE
+    assert metrics.counter("serving.decode.steps").value() == 5
+
+
+# --- rpc zero-copy satellite --------------------------------------------
+
+def test_from_wire_zero_copy_view_is_readonly():
+    from paddle_tpu.distributed.rpc import from_wire, to_wire
+
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+    segs = []
+    wire = to_wire({"w": arr}, segs)
+
+    copied = from_wire(wire, segs)["w"]
+    assert copied.flags.writeable
+    copied[0, 0] = -1  # writable copy: mutation fine
+
+    view = from_wire(wire, segs, copy=False)["w"]
+    np.testing.assert_array_equal(view, arr)
+    assert not view.flags.writeable
+    with pytest.raises(ValueError):
+        view[0, 0] = -1  # loud, never silent corruption
+    # it really is backed by the frame bytes, not a copy
+    assert view.base is not None
+
+
+def test_get_param_zero_copy_and_exact_wire_bytes():
+    """The client-side satellite end to end: get_param returns a
+    read-only view; wire-byte counters are IDENTICAL to the copying
+    path (the satellite changed host copies, not wire bytes)."""
+    from paddle_tpu.distributed.rpc import RpcClient, RpcServer
+
+    table = {"w": np.arange(64, dtype=np.float32).reshape(8, 8)}
+    srv = RpcServer({"get_param": lambda n: table[n]},
+                    idempotent={"get_param"})
+    addr = srv.serve()
+    try:
+        cli = RpcClient(addr)
+        bytes_in = metrics.counter("rpc.client.bytes_in")
+        b0 = bytes_in.value()
+        got_copy = cli.call("get_param", "w")           # default: copy
+        per_call = bytes_in.value() - b0
+        got_view = cli.call("get_param", "w", copy_result=False)
+        assert bytes_in.value() - b0 == 2 * per_call  # exact, both modes
+        np.testing.assert_array_equal(got_view, table["w"])
+        assert got_copy.flags.writeable
+        assert not got_view.flags.writeable
+        # jnp.asarray (the real consumer) accepts the view fine
+        import jax.numpy as jnp
+
+        assert float(jnp.asarray(got_view).sum()) == float(table["w"].sum())
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+# --- slow lane: bench smoke ----------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_decode_bench_smoke():
+    proc = subprocess.run(
+        [sys.executable, "benchmarks/decode_bench.py", "--smoke"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    ev = json.loads(proc.stdout.strip().splitlines()[-1])
+    res = ev["results"]
+    # identical workload across all three strategies
+    gens = {m: r["generated_tokens"] for m, r in res.items()}
+    assert len(set(gens.values())) == 1 and gens["continuous"] > 0, gens
+    # the compile-bound claim holds inside the bench too
+    assert res["continuous"]["post_warm_compiles"] == 0
+    assert res["drain"]["post_warm_compiles"] == 0
+    # continuous needs FEWER decode steps for the same tokens — the
+    # scheduler-shape claim, counter-based so host load can't flake it
+    assert res["continuous"]["decode_steps"] <= res["drain"]["decode_steps"]
+    assert "framework_metrics" in ev and ev["results"]["reprefill"][
+        "full_forwards"] == gens["reprefill"]
