@@ -21,3 +21,11 @@ from . import observability  # noqa: F401  (no heavy deps; before fluid)
 from . import fluid  # noqa: F401
 from . import dataset, reader  # noqa: F401
 from .reader import batch  # noqa: F401
+
+# PADDLE_TPU_SANITIZE=guards: instrument the guarded-by-annotated runtime
+# classes so every declared-guard access asserts its lock is held (the
+# dynamic half of the analysis/guards.py lint). Zero import cost unset.
+if fluid.flags.FLAGS["sanitize"]:
+    from .analysis import sanitize as _sanitize
+
+    _sanitize.maybe_install()

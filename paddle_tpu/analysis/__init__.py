@@ -7,10 +7,16 @@ catalog and CLI usage):
     construction and lowering; `FLAGS["verify_programs"]` gates the
     executor on it, and the memory-optimization transpiler proves its
     rewrites against it.
-  - `locks` — concurrency lint (L1xx): lock-order graph + blocking-call-
-    under-lock over the distributed runtime and observability modules.
+  - `locks` — concurrency lint (L101–L103): lock-order graph +
+    blocking-call-under-lock over the distributed/serving/observability
+    runtime.
+  - `guards` — shared-state race lint (L104–L106, "TSan-lite"):
+    guarded-by inference + declarations over the same modules, with a
+    runtime sanitizer twin (PADDLE_TPU_SANITIZE=guards,
+    analysis/sanitize.py) asserting the declared guards at attribute
+    access.
   - `invariants` — registry drift lint (N2xx): fault sites, metric/span
-    names, FLAGS keys.
+    names, FLAGS keys, per-version gauge retirement.
 
 CLI: ``python -m paddle_tpu.analysis [--json] [--selftest]``.
 """

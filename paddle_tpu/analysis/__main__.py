@@ -40,7 +40,7 @@ def main(argv=None) -> int:
                     help="prove every diagnostic code fires on a "
                          "synthetic bad input")
     ap.add_argument("--skip", action="append", default=[],
-                    choices=["verify", "locks", "invariants"],
+                    choices=["verify", "locks", "guards", "invariants"],
                     help="skip a pass (repeatable)")
     ap.add_argument("--no-shapes", action="store_true",
                     help="skip the abstract-eval shape/dtype re-check "
@@ -87,6 +87,12 @@ def main(argv=None) -> int:
 
         ran.append("locks")
         diags += lint_paths(default_lint_paths(args.root))
+    if "guards" not in args.skip:
+        from .guards import default_lint_paths as guard_paths
+        from .guards import lint_paths as guard_lint
+
+        ran.append("guards")
+        diags += guard_lint(guard_paths(args.root))
     if "invariants" not in args.skip:
         from .invariants import check_repo
 

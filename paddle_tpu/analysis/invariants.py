@@ -22,10 +22,20 @@ drift a CI failure:
     N203 (error)   FLAGS key read/written that fluid/flags.py does not
                    define
     N204 (warning) FLAGS key defined but never read anywhere
+    N205 (error)   an instance-keyed gauge registration (an f-string
+                   gauge name — `<model>.v<version>` directly, or via a
+                   label variable like the KV pool's `{sfx}`) with no
+                   zero-at-retirement `.set(0)` site outside `__init__`
+                   in the same class — the PR 5/6 gauge-clobber class:
+                   during a hot-swap drain the old version's final
+                   value would linger (or clobber the live engine's)
+                   forever
 
 Suppress a deliberate bad name (grammar tests, docs of removed names)
 with `# lint: allow-site` / `# lint: allow-name` on the same line
-(docs: `<!-- lint: allow-name -->` anywhere on the line).
+(docs: `<!-- lint: allow-name -->` anywhere on the line); a versioned
+gauge whose lifetime really is the process's with
+`# lint: allow-unzeroed`.
 """
 from __future__ import annotations
 
@@ -446,6 +456,97 @@ def check_flags(defined: Set[str],
     return diags
 
 
+# --- instance-keyed gauges (N205) --------------------------------------
+
+def check_versioned_gauge_source(src: str, path: str = "<src>"
+                                 ) -> List[Diagnostic]:
+    """N205 over one source file: every class attribute assigned a
+    gauge with an INTERPOLATED (f-string) name — a per-instance series,
+    whether the key is spelled `<model>.v<version>` directly or built
+    through a label variable (`f"serving.kv.pages_used{sfx}"`) — must
+    have a `self.<attr>.set(0)` zero-at-retirement site in the same
+    class, OUTSIDE `__init__` (an init-time zero is initialization, not
+    retirement, and would let the clobber class back in)."""
+    diags: List[Diagnostic] = []
+    tree = _parse(path, src)
+    if tree is None:
+        return diags
+    suppressed = _suppressed_lines(src, "allow-unzeroed")
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        registered: List[Tuple[str, str, int]] = []  # attr, pattern, line
+        zeroed: Set[str] = set()
+        init_nodes: Set[int] = set()
+        # registration and zero site must be in the SAME class: nodes of
+        # nested ClassDefs are excluded (a nested class's same-named
+        # `self._g.set(0)` must not satisfy the outer class's rule)
+        nested: Set[int] = set()
+        for sub in ast.walk(cls):
+            if isinstance(sub, ast.ClassDef) and sub is not cls:
+                nested |= {id(x) for x in ast.walk(sub)}
+        for meth in cls.body:
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and meth.name == "__init__":
+                init_nodes = {id(sub) for sub in ast.walk(meth)}
+        for node in ast.walk(cls):
+            if id(node) in nested:
+                continue
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Attribute) \
+                    and isinstance(node.targets[0].value, ast.Name) \
+                    and node.targets[0].value.id == "self" \
+                    and isinstance(node.value, ast.Call):
+                fn = node.value.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None)
+                if name == "gauge" and node.value.args and \
+                        isinstance(node.value.args[0], ast.JoinedStr):
+                    pat = _joinedstr_pattern(node.value.args[0])
+                    if "*" in pat:  # >=1 interpolated segment
+                        # a suppression anywhere on the (possibly
+                        # multi-line) registration statement counts
+                        span = range(node.lineno,
+                                     (node.end_lineno or node.lineno) + 1)
+                        if not any(ln in suppressed for ln in span):
+                            registered.append(
+                                (node.targets[0].attr, pat, node.lineno))
+            if isinstance(node, ast.Call) and \
+                    id(node) not in init_nodes and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "set" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value in (0, 0.0):
+                base = node.func.value
+                if isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name) and \
+                        base.value.id == "self":
+                    zeroed.add(base.attr)
+        for attr, pat, line in registered:
+            if attr in zeroed:
+                continue
+            diags.append(_d(
+                "N205", ERROR,
+                f"instance-keyed gauge '{pat}' (self.{attr} in class "
+                f"{cls.name}) has no zero-at-retirement site: no "
+                f"'self.{attr}.set(0)' outside __init__ anywhere in "
+                "the class",
+                where=f"{path}:{line}",
+                hint="zero the gauge when the owning engine/version "
+                     "retires (the hot-swap drain otherwise leaves the "
+                     "old version's last value lingering as live "
+                     "occupancy — the PR 5/6 gauge-clobber bug class); "
+                     "or annotate '# lint: allow-unzeroed' if the "
+                     "series genuinely lives as long as the process"))
+    return diags
+
+
+def check_versioned_gauges(pkg_dir: str) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for path in _py_files(pkg_dir):
+        rel = os.path.relpath(path, _repo_root())
+        diags += check_versioned_gauge_source(_read(path), rel)
+    return diags
+
+
 # --- driver ------------------------------------------------------------
 
 def check_repo(root: Optional[str] = None) -> List[Diagnostic]:
@@ -477,4 +578,6 @@ def check_repo(root: Optional[str] = None) -> List[Diagnostic]:
     refs2 += [(k, flags_path, 0, "read")
               for k in _readthrough_keys(flags_path)]
     diags += check_flags(defined, refs2)
+
+    diags += check_versioned_gauges(pkg)
     return diags

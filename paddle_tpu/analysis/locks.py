@@ -500,12 +500,10 @@ def lint_source(src: str, filename: str = "<src>") -> List[Diagnostic]:
                                      lint.directives.order_decls)
 
 
-def lint_paths(paths) -> List[Diagnostic]:
-    """Lint every .py file under `paths` (files or directories); the
-    lock-order graph is global across all of them."""
-    diags: List[Diagnostic] = []
-    edges: Dict[Tuple[str, str], Tuple[str, Tuple[int, ...]]] = {}
-    decls: List[Tuple[str, str]] = []
+def iter_py_files(paths) -> List[str]:
+    """Every .py file under `paths` (files or directories), sorted and
+    deduped, __pycache__ skipped — the one walk both concurrency lints
+    (this pass and guards.py) share."""
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -516,7 +514,16 @@ def lint_paths(paths) -> List[Diagnostic]:
                           if n.endswith(".py")]
         elif p.endswith(".py"):
             files.append(p)
-    for f in sorted(set(files)):
+    return sorted(set(files))
+
+
+def lint_paths(paths) -> List[Diagnostic]:
+    """Lint every .py file under `paths` (files or directories); the
+    lock-order graph is global across all of them."""
+    diags: List[Diagnostic] = []
+    edges: Dict[Tuple[str, str], Tuple[str, Tuple[int, ...]]] = {}
+    decls: List[Tuple[str, str]] = []
+    for f in iter_py_files(paths):
         with open(f, "r", encoding="utf-8") as fh:
             src = fh.read()
         lint = _Lint(os.path.relpath(f), src)

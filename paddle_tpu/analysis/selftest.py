@@ -221,6 +221,106 @@ def case_l103():
     return lint_source(_L103_SRC, "snippet_l103.py")
 
 
+# --- guards-lint cases --------------------------------------------------
+
+# a thread-entry (Thread target) and a public method share _n; one
+# access (the scheduler's write) skips the majority guard
+_L104_SRC = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._n = 0
+        self._t = threading.Thread(target=self._loop)
+
+    def read(self):
+        with self._mu:
+            return self._n
+
+    def bump(self):
+        with self._mu:
+            self._n += 1
+
+    def _loop(self):
+        self._n = 0
+'''
+
+# declared guard: every unguarded access fires even without a majority
+_L104_DECL_SRC = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._q = []  # guarded-by: _mu
+
+    def put(self, x):
+        self._q.append(x)
+'''
+
+_L105_SRC = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._n = 0
+
+    def one(self):
+        with self._a:
+            self._n += 1
+
+    def two(self):
+        with self._b:
+            self._n += 1
+'''
+
+# the PR 5/6 double-answer shape: a guarded read, the lock released,
+# and the dependent write re-acquiring it
+_L106_SRC = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._n = 0  # guarded-by: _mu
+
+    def bump(self):
+        with self._mu:
+            seen = self._n
+        with self._mu:
+            self._n = seen + 1
+'''
+
+
+def case_l104():
+    from .guards import lint_source
+
+    diags = lint_source(_L104_SRC, "snippet_l104.py")
+    # the declared-guard form must fire too — inference and declaration
+    # are both load-bearing, so the case covers both or fails
+    diags += lint_source(_L104_DECL_SRC, "snippet_l104_decl.py")
+    if sum(1 for d in diags if d.code == "L104") < 2:
+        raise AssertionError(
+            "L104 must fire for BOTH the inferred and the declared "
+            f"guard: {[d.format() for d in diags]}")
+    return diags
+
+
+def case_l105():
+    from .guards import lint_source
+
+    return lint_source(_L105_SRC, "snippet_l105.py")
+
+
+def case_l106():
+    from .guards import lint_source
+
+    return lint_source(_L106_SRC, "snippet_l106.py")
+
+
 # --- invariant-lint cases ---------------------------------------------
 
 def case_n201():
@@ -267,6 +367,30 @@ def case_n204():
     return check_flags(defined, refs, warn_unread=True)
 
 
+def case_n205():
+    from .invariants import check_versioned_gauge_source
+
+    # a per-<model>.v<version> gauge with no .set(0) retirement site —
+    # the PR 5/6 hot-swap gauge-clobber shape, mechanized
+    src = '''
+class Engine:
+    def __init__(self, name, version):
+        self._g_depth = _metrics.gauge(
+            f"serving.queue_depth.{name}.v{version}")
+        self._g_ok = _metrics.gauge(f"serving.live.{name}.v{version}")
+
+    def stop(self):
+        self._g_ok.set(0)
+'''
+    diags = check_versioned_gauge_source(src, "snippet_n205.py")
+    # the zeroed gauge must NOT fire: a spurious hit here means the
+    # zero-site matcher rotted — crash the case so it fails
+    if any("_g_ok" in d.message for d in diags):
+        raise AssertionError(
+            "N205 fired on a gauge that HAS a .set(0) site")
+    return diags
+
+
 CASES: Dict[str, Callable[[], List[Diagnostic]]] = {
     "V001": case_v001,
     "V002": case_v002,
@@ -281,10 +405,14 @@ CASES: Dict[str, Callable[[], List[Diagnostic]]] = {
     "L101": case_l101,
     "L102": case_l102,
     "L103": case_l103,
+    "L104": case_l104,
+    "L105": case_l105,
+    "L106": case_l106,
     "N201": case_n201,
     "N202": case_n202,
     "N203": case_n203,
     "N204": case_n204,
+    "N205": case_n205,
 }
 
 

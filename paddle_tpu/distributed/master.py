@@ -589,7 +589,9 @@ class MasterClient:
         self._resolver = addr_resolver
         self._retries = int(reconnect_retries)
         self._backoff = float(reconnect_backoff)
-        self._sock = None
+        self._sock = None  # guarded-by: _lock
+        self._rfile = None  # guarded-by: _lock
+        self._wfile = None  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def _call(self, method: str, *args):
@@ -737,6 +739,10 @@ class MasterClient:
                              "re-serves via lease expiry", task.id, e)
 
     def close(self):
-        if self._sock is not None:
-            self._sock.close()
-            self._sock = None
+        # under _lock (guards-lint finding): a close() racing another
+        # thread's in-flight _call_once could tear the socket down
+        # mid-frame — or leak the one create_connection just opened
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None

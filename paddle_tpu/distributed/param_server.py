@@ -81,24 +81,24 @@ class ParameterServer:
         # (pushes piggyback a beat; ParameterClient can also run a
         # dedicated heartbeat thread), plus the evicted set. Guarded by
         # the big _cv lock like the rest of the sync bookkeeping.
-        self._beats: Dict[int, float] = {}
-        self._evicted: set = set()
+        self._beats: Dict[int, float] = {}  # guarded-by: _cv
+        self._evicted: set = set()  # guarded-by: _cv
         # trainer_id -> lifetime eviction count, echoed in barrier
         # replies so the EVICTED side learns its round was degraded (it
         # otherwise sees a successful barrier and never knows its
         # in-flight pushes were withdrawn)
-        self._evict_count: Dict[int, int] = {}
+        self._evict_count: Dict[int, int] = {}  # guarded-by: _cv
         self._scope = scope if scope is not None else fluid.Scope()
         self._exe = fluid.Executor()
         self._program = pserver_program
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
-        self._round = 0
+        self._round = 0  # guarded-by: _cv
         # sync: param -> {trainer_id: grad} — DISTINCT trainers complete a
         # round (a retransmitted push overwrites, it can't phantom-complete)
-        self._pending: Dict[str, Dict[int, Any]] = {}
-        self._applied_round: set = set()
-        self._steps = 0
+        self._pending: Dict[str, Dict[int, Any]] = {}  # guarded-by: _cv
+        self._applied_round: set = set()  # guarded-by: _cv
+        self._steps = 0  # guarded-by: _shared_mu
         # async: one lock per param (concurrent cross-param applies);
         # _shared_mu guards the cycle bookkeeping + counters, and
         # _shared_run_mu serializes the stateful LR-chain executions
@@ -110,7 +110,7 @@ class ParameterServer:
         # push means a new optimization step started — not once per
         # len(owned) raw pushes, which drifts when a sparse workload skips
         # params in a step (ADVICE r3)
-        self._applied_since_shared: set = set()
+        self._applied_since_shared: set = set()  # guarded-by: _shared_mu
 
         block = pserver_program.global_block()
         self._owned = sorted(
@@ -181,8 +181,8 @@ class ParameterServer:
         # to batch ids, not table size); incremented from concurrent
         # handler threads, so guarded by their own lock
         self._stats_mu = threading.Lock()
-        self._full_pull_rows = 0
-        self._prefetch_rows = 0
+        self._full_pull_rows = 0  # guarded-by: _stats_mu
+        self._prefetch_rows = 0  # guarded-by: _stats_mu
 
         self._server = RpcServer({
             "get_param": self.get_param,
@@ -205,15 +205,25 @@ class ParameterServer:
 
     def stats(self) -> Dict[str, int]:
         """Evidence of server-side work: optimize steps applied + round +
-        rows served via full pulls vs row-granular prefetches. Under the
-        _cv lock: barrier threads mutate _evicted concurrently, and
-        iterating a set mid-mutation raises."""
+        rows served via full pulls vs row-granular prefetches. Each field
+        is read under ITS guard (sequentially, never nested): _evicted /
+        _round under _cv (barrier threads mutate them concurrently, and
+        iterating a set mid-mutation raises), _steps under _shared_mu
+        (concurrent _apply threads increment it there), the pull-row
+        tallies under _stats_mu (guards-lint finding: they used to be
+        read under _cv while handler threads wrote them under
+        _stats_mu)."""
+        with self._shared_mu:
+            steps = self._steps
+        with self._stats_mu:
+            full_pull_rows = self._full_pull_rows
+            prefetch_rows = self._prefetch_rows
         with self._cv:
-            return {"steps": self._steps, "round": self._round,
+            return {"steps": steps, "round": self._round,
                     "sync": self._sync, "trainers": self._trainers,
                     "evicted": sorted(self._evicted),
-                    "full_pull_rows": self._full_pull_rows,
-                    "prefetch_rows": self._prefetch_rows}
+                    "full_pull_rows": full_pull_rows,
+                    "prefetch_rows": prefetch_rows}
 
     def heartbeat(self, trainer_id: int = 0):
         """Failure-detection beat (reference go/pserver etcd TTL-lease
@@ -269,7 +279,13 @@ class ParameterServer:
             # per-block locking (parameter_server2's block-sharded applies)
             with self._param_locks[name]:
                 self._apply(name, grad)
-            return {"step": self._steps, "round": self._round}
+            # monitoring echo, read under each field's own guard (the
+            # guards lint caught the bare reads racing concurrent applies)
+            with self._shared_mu:
+                step = self._steps
+            with self._cv:
+                rnd = self._round
+            return {"step": step, "round": rnd}
         with self._cv:
             tid = int(trainer_id)
             self._note_push_locked(tid)
@@ -280,7 +296,10 @@ class ParameterServer:
             round_of_push = self._round
             self._pending.setdefault(name, {})[tid] = grad
             self._try_complete_locked(name)
-            return {"step": self._steps, "round": round_of_push}
+        # step echo read under ITS guard (_shared_mu), after _cv released
+        with self._shared_mu:
+            step = self._steps
+        return {"step": step, "round": round_of_push}
 
     def barrier(self, known_round: Optional[int] = None,
                 trainer_id: Optional[int] = None):
@@ -298,7 +317,8 @@ class ParameterServer:
         the CALLER so its own lease refreshes while it is parked here (a
         waiting trainer is alive by definition)."""
         if not self._sync or known_round is None:
-            return {"round": self._round}
+            with self._cv:  # _round is _cv-guarded state
+                return {"round": self._round}
         target = int(known_round) + 1
         t0 = time.perf_counter()
         deadline = time.monotonic() + self._barrier_timeout
@@ -432,6 +452,8 @@ class ParameterServer:
             arr = np.asarray(v) if v is not None else None
             params[p] = ({"shape": list(arr.shape), "dtype": str(arr.dtype)}
                          if arr is not None else None)
+        with self._shared_mu:  # _steps is _shared_mu state, like stats()
+            steps = self._steps
         with self._cv:
             beats = {str(tid): round(now - t, 3)
                      for tid, t in self._beats.items()}
@@ -439,7 +461,7 @@ class ParameterServer:
                 "sync": self._sync,
                 "trainers": self._trainers,
                 "round": self._round,
-                "steps": self._steps,
+                "steps": steps,
                 "heartbeat_timeout_s": self._hb_timeout,
                 "heartbeat_age_s": beats,
                 "evicted": sorted(self._evicted),

@@ -264,8 +264,10 @@ class _DedupCache:
         self._cap = cap
         self._min_age = float(min_age)
         self._mu = threading.Lock()
-        self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
-        self._hits = 0  # lifetime retransmit answers, for stats()
+        self._entries: "OrderedDict[tuple, dict]" = \
+            OrderedDict()  # guarded-by: _mu
+        # lifetime retransmit answers, for stats()
+        self._hits = 0  # guarded-by: _mu
 
     def stats(self) -> Dict[str, int]:
         """Introspection for /statusz: size, in-flight count, lifetime
@@ -532,13 +534,16 @@ class RpcClient:
                                  else float(connect_timeout))
         self._retries = max(0, int(retries))
         self._backoff = float(backoff)
-        self._sock: Optional[socket.socket] = None
-        self._rfile = self._wfile = None
+        # connection state + token sequence all ride _mu — the same lock
+        # that serializes call() on this client's single connection
+        self._sock: Optional[socket.socket] = None  # guarded-by: _mu
+        self._rfile = None  # guarded-by: _mu
+        self._wfile = None  # guarded-by: _mu
         self._mu = threading.Lock()
         # token namespace: unique per client INSTANCE (uuid, not addr) —
         # two clients to one server must never collide in its dedup cache
         self._client_id = uuid.uuid4().hex[:16]
-        self._seq = 0
+        self._seq = 0  # guarded-by: _mu
 
     def call(self, method: str, *args, copy_result: bool = True):
         """``copy_result=False``: tensors in the response come back as

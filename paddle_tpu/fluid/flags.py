@@ -4,6 +4,7 @@ check_nan_inf, benchmark, fraction_of_gpu_memory_to_use, ...). Same shape
 here, with TPU-relevant knobs."""
 from __future__ import annotations
 
+import os
 from typing import Any, Dict
 
 # the recorder parses PADDLE_TPU_TRACE / PADDLE_TPU_TRACE_BUFFER once at
@@ -105,6 +106,13 @@ FLAGS: Dict[str, Any] = _Flags({
     # string, e.g. 'seed=7;drop@recv.push_grad:1,3'); None/'' = off.
     # Seeded from PADDLE_TPU_FAULTS; reads are live (see _Flags).
     "faults": None,
+    # runtime sanitizers (ISSUE 7). 'guards' instruments the annotated
+    # runtime classes (analysis/sanitize.py) so every access to a
+    # '# guarded-by:'-declared attribute asserts its lock is held —
+    # the dynamic validator of the static guards lint. Seeded from
+    # PADDLE_TPU_SANITIZE at import; paddle_tpu/__init__ installs the
+    # instrumentation at process start when set. '' = off.
+    "sanitize": os.environ.get("PADDLE_TPU_SANITIZE", ""),
     # serving defaults (paddle_tpu/serving, ISSUE 5). The bucket ladder
     # is THE compile-bound knob: dynamic batches pad up to the next
     # ladder entry, so the executor jit cache holds at most one entry

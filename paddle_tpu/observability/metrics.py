@@ -24,8 +24,17 @@ __all__ = [
     "snapshot", "prometheus_text", "reset_metrics", "reset_all",
 ]
 
-_registry: Dict[str, "_Metric"] = {}
+_registry: Dict[str, "_Metric"] = {}  # guarded-by: _registry_mu
 _registry_mu = threading.Lock()
+
+
+def _registered() -> List[tuple]:
+    """Consistent (name, metric) snapshot, sorted. Every iterator goes
+    through here: iterating the live dict while a find-or-create on
+    another thread inserts raises RuntimeError mid-scrape (guards-lint
+    finding on snapshot/prometheus_text/reset_metrics)."""
+    with _registry_mu:
+        return sorted(_registry.items())
 
 
 def _sanitize(name: str) -> str:
@@ -196,6 +205,9 @@ class Histogram(_Metric):
 
 
 def _get(name: str, cls, **kw):
+    # lint: allow-unguarded(_registry) — deliberate double-checked read:
+    # dict.get is GIL-atomic, a hit avoids the lock on the hot
+    # find-or-create path, and a miss re-validates under _registry_mu
     m = _registry.get(name)
     if m is not None:
         if not isinstance(m, cls):
@@ -231,10 +243,9 @@ def snapshot(prefix: str = "", skip_zero: bool = False) -> Dict[str, Any]:
     stats dict). `prefix` filters; `skip_zero` drops zero counters /
     empty histograms (the compact form BENCH artifacts embed)."""
     out: Dict[str, Any] = {}
-    for name in sorted(_registry):
+    for name, m in _registered():
         if prefix and not name.startswith(prefix):
             continue
-        m = _registry[name]
         v = m.value()
         if skip_zero:
             if isinstance(v, dict) and not v.get("count"):
@@ -247,15 +258,15 @@ def snapshot(prefix: str = "", skip_zero: bool = False) -> Dict[str, Any]:
 
 def prometheus_text() -> str:
     lines: List[str] = []
-    for name in sorted(_registry):
-        lines.extend(_registry[name].prom_lines())
+    for _name, m in _registered():
+        lines.extend(m.prom_lines())
     return "\n".join(lines) + ("\n" if lines else "")
 
 
 def reset_metrics(prefix: str = ""):
     """Zero every metric (or those under `prefix`). Handles stay valid —
     callers keep their cached Counter/Gauge/Histogram objects."""
-    for name, m in list(_registry.items()):
+    for name, m in _registered():
         if prefix and not name.startswith(prefix):
             continue
         m.reset()

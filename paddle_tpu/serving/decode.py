@@ -323,8 +323,10 @@ class DecodeEngine:
         self.name = str(name)
         self.version = int(version)
         self.spec = spec
+        # shares _step_mu with the compiled step + shape set: the lock
+        # serializes every read-step-rebind against retirement's drop
         self._params = (build_decoder_params(spec)
-                        if params is None else params)
+                        if params is None else params)  # guarded-by: _step_mu
         self._slot_ladder = parse_buckets(
             FLAGS["decode_slots"] if slots is None else slots)
         self._max_slots = self._slot_ladder[-1]
@@ -334,7 +336,8 @@ class DecodeEngine:
         self.max_seq_len = int(FLAGS["decode_max_seq_len"]
                                if max_seq_len is None else max_seq_len)
         self._max_queue = int(FLAGS["serving_max_queue"]
-                              if max_queue is None else max_queue)
+                              if max_queue is None
+                              else max_queue)  # guarded-by: _cond
         # drain-per-batch mode (continuous=False) exists ONLY as the
         # honest A/B baseline for decode_bench — same engine, same
         # compiled shapes, admission gated on an empty batch
@@ -346,14 +349,14 @@ class DecodeEngine:
         w_max = self.cache.allocator.pages_for_tokens(self.max_seq_len)
         self._width_ladder = width_ladder(w_max)
         self._cond = threading.Condition()
-        self._queue: List[_DecodeRequest] = []
-        self._slots: List[_Slot] = []
-        self._stopping = False
-        self._released = False
-        self._seq_counter = 0
-        self._n_requests = 0
-        self._n_steps = 0
-        self._compiled_shapes: set = set()
+        self._queue: List[_DecodeRequest] = []  # guarded-by: _cond
+        self._slots: List[_Slot] = []  # guarded-by: _cond
+        self._stopping = False  # guarded-by: _cond
+        self._released = False  # guarded-by: _cond
+        self._seq_counter = 0  # guarded-by: _cond
+        self._n_requests = 0  # guarded-by: _cond
+        self._n_steps = 0  # guarded-by: _cond
+        self._compiled_shapes: set = set()  # guarded-by: _step_mu
         self._g_depth = _metrics.gauge(
             f"serving.decode.queue_depth.{self.name}.v{self.version}")
         # per-instance for the same reason as queue_depth: a draining
@@ -376,7 +379,8 @@ class DecodeEngine:
                   and jax.default_backend() == "tpu")
         self._donate = donate
         self._step_fn = jax.jit(
-            _step, donate_argnums=(3, 4) if donate else ())
+            _step,
+            donate_argnums=(3, 4) if donate else ())  # guarded-by: _step_mu
         # serializes warm() (caller thread) against live steps (the
         # scheduler thread): read-pools -> step -> rebind must be
         # atomic or concurrent rebinds silently drop KV writes
@@ -544,10 +548,16 @@ class DecodeEngine:
         if self._thread.is_alive():  # pragma: no cover - wedged scheduler
             _log.error("decode scheduler for %s v%d did not exit in %.0fs",
                        self.name, self.version, timeout)
-        with self._cond:
+        # params/step/pools drop under _step_mu — THEIR guard (guards-lint
+        # finding: they used to drop under _cond while _run_step_arrays
+        # reads them under _step_mu; safe only by join-ordering, which a
+        # static model can't see and a future warm()-after-stop wouldn't
+        # honor)
+        with self._step_mu:
             self._params = None
             self._step_fn = None
             self.cache.release()
+        with self._cond:
             self._released = True
             self._g_depth.set(0)
             # the scheduler may exit between steps without a final
@@ -556,6 +566,13 @@ class DecodeEngine:
             self._g_live.set(0)
 
     def stats(self) -> Dict[str, Any]:
+        # _compiled_shapes is _step_mu state: snapshot it under ITS lock
+        # (guards-lint finding — sorted() here used to iterate the set
+        # under _cond while the scheduler's _run_step_arrays add()ed to
+        # it under _step_mu: a mid-iteration mutation raises
+        # "Set changed size during iteration" on a stats scrape)
+        with self._step_mu:
+            shapes = sorted(self._compiled_shapes)
         with self._cond:
             return {
                 "name": self.name,
@@ -573,7 +590,7 @@ class DecodeEngine:
                 "max_queue": self._max_queue,
                 "requests": self._n_requests,
                 "steps": self._n_steps,
-                "compiled_shapes": sorted(self._compiled_shapes),
+                "compiled_shapes": shapes,
                 "stopping": self._stopping,
             }
 

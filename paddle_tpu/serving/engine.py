@@ -193,21 +193,23 @@ class InferenceEngine:
             else default_buckets()
         self._max_batch = self._buckets[-1]
         self._max_queue = int(FLAGS["serving_max_queue"]
-                              if max_queue is None else max_queue)
+                              if max_queue is None
+                              else max_queue)  # guarded-by: _cond
         self._max_wait = float(FLAGS["serving_max_wait_ms"]
                                if max_wait_ms is None else max_wait_ms) / 1e3
         # refs the release path drops (program mode); exported mode keeps
-        # everything inside the runner closure
-        self._program = program
-        self._scope = scope
-        self._executor = executor
-        self._runner: Optional[Callable] = runner
+        # everything inside the runner closure. All _cond-guarded: stop()
+        # drops them, warm()/the scheduler snapshot them under the lock.
+        self._program = program  # guarded-by: _cond
+        self._scope = scope  # guarded-by: _cond
+        self._executor = executor  # guarded-by: _cond
+        self._runner: Optional[Callable] = runner  # guarded-by: _cond
         self._cond = threading.Condition()
-        self._queue: List[_Request] = []
-        self._stopping = False
-        self._released = False
-        self._n_requests = 0
-        self._n_batches = 0
+        self._queue: List[_Request] = []  # guarded-by: _cond
+        self._stopping = False  # guarded-by: _cond
+        self._released = False  # guarded-by: _cond
+        self._n_requests = 0  # guarded-by: _cond
+        self._n_batches = 0  # guarded-by: _cond
         # keyed by name AND version: during a hot-swap the draining old
         # engine and the live new one both report depth — sharing one
         # gauge would let the old engine's final 0 clobber the live
@@ -319,7 +321,8 @@ class InferenceEngine:
     def program(self):
         """The loaded inference Program (None for exported artifacts, or
         after release) — exposed so lifecycle tests can weakref it."""
-        return self._program
+        with self._cond:  # _program is _cond-guarded (stop() drops it)
+            return self._program
 
     def warm(self):
         """One synthetic batch per ladder entry: the full compile bill is
@@ -327,6 +330,10 @@ class InferenceEngine:
         registry can still roll back), never on live traffic. Free (-1)
         trailing dims warm at 1 — requests with other ragged shapes
         compile on first sight, one entry per distinct inner shape."""
+        with self._cond:  # snapshot under the runner's guard
+            runner = self._runner
+        if runner is None:
+            raise EngineRetired(f"model '{self.name}' released")
         with _tracing.span("serving.warmup", model=self.name,
                            version=self.version):
             for b in self._buckets:
@@ -336,7 +343,7 @@ class InferenceEngine:
                         dtype=s.dtype)
                     for s in self._specs
                 }
-                self._runner(feeds, b)
+                runner(feeds, b)
 
     def submit(self, feeds: Dict[str, Any],
                deadline_ms: Optional[float] = None) -> _Request:
@@ -555,13 +562,14 @@ class InferenceEngine:
             feeds[spec.name] = (parts[0] if len(parts) == 1
                                 else np.concatenate(parts, axis=0))
         t1 = time.perf_counter()
-        runner = self._runner
+        with self._cond:  # snapshot the runner under ITS guard
+            runner = self._runner
+            if runner is not None:
+                self._n_batches += 1
         if runner is None:  # pragma: no cover - stop() raced a late batch
             for r in live:
                 r.fail(EngineRetired(f"model '{self.name}' released"))
             return
-        with self._cond:
-            self._n_batches += 1
         # adopt the batch-TRIGGERING (oldest) request's context: a span
         # has one parent, so the batch joins the head request's trace
         with _tracing.adopt(live[0].trace_ctx), \

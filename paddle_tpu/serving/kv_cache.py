@@ -81,10 +81,11 @@ class PageAllocator:
         self._mu = threading.Lock()
         # stack: pop() yields 1, 2, 3, ... when fresh; freed pages are
         # pushed on top and reused first (LIFO)
-        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
-        self._owner: Dict[int, List[int]] = {}  # seq_id -> pages
-        self._tokens: Dict[int, int] = {}       # seq_id -> written tokens
-        self._total_tokens = 0                  # running sum(self._tokens)
+        self._free: List[int] = list(
+            range(self.num_pages - 1, 0, -1))  # guarded-by: _mu
+        self._owner: Dict[int, List[int]] = {}  # guarded-by: _mu
+        self._tokens: Dict[int, int] = {}  # guarded-by: _mu
+        self._total_tokens = 0  # guarded-by: _mu
         # gauges are keyed per allocator when a label (engine name.vN)
         # is given — coexisting pools (hot-swap drain, multi-model)
         # must not last-writer-wins-clobber each other's occupancy;

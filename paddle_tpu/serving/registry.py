@@ -48,7 +48,7 @@ class ModelRegistry:
 
     def __init__(self):
         self._mu = threading.Lock()
-        self._models: Dict[str, InferenceEngine] = {}
+        self._models: Dict[str, InferenceEngine] = {}  # guarded-by: _mu
 
     def deploy(self, name: str,
                build: Callable[[], InferenceEngine]) -> InferenceEngine:

@@ -181,11 +181,14 @@ def test_decode_churn_zero_new_compiles():
     # up to 20 usable pages
     eng = _engine(num_pages=24)
     try:
-        # warm compiled exactly the ladder product
+        # warm compiled exactly the ladder product (via stats(), which
+        # snapshots the shape set under ITS lock — this file also runs
+        # under the guard sanitizer, where a bare _compiled_shapes poke
+        # is a violation)
         assert eng.slot_ladder == [1, 2]
         assert eng.table_width_ladder == [1, 2]
-        assert sorted(eng._compiled_shapes) == [(1, 1), (1, 2),
-                                                (2, 1), (2, 2)]
+        assert eng.stats()["compiled_shapes"] == [(1, 1), (1, 2),
+                                                  (2, 1), (2, 2)]
         pool_shape = tuple(eng.cache.k.shape)
         base_decode = metrics.counter("serving.decode.compiles").value()
         base_exec = metrics.counter("executor.jit_compiles").value()
@@ -205,7 +208,7 @@ def test_decode_churn_zero_new_compiles():
             == base_decode, "sequence churn escaped the warmed ladder"
         assert metrics.counter("executor.jit_compiles").value() \
             == base_exec, "decode path leaked into the executor jit cache"
-        assert (len(eng._compiled_shapes) ==
+        assert (len(eng.stats()["compiled_shapes"]) ==
                 len(eng.slot_ladder) * len(eng.table_width_ladder))
         # footprint: the pool is the SAME preallocated arrays' shape,
         # and every page went back to the free list
@@ -372,7 +375,8 @@ def test_step_failure_with_donated_pools_retires_engine():
         def _boom(*a, **k):
             raise RuntimeError("injected step failure")
         eng._donate = True      # CPU tests never donate; force the path
-        eng._step_fn = _boom
+        with eng._step_mu:      # _step_fn is _step_mu-guarded state
+            eng._step_fn = _boom
         req = eng.submit([1, 2], max_new_tokens=4)
         assert req.ev.wait(60)
         assert isinstance(req.error, ServingError)
@@ -402,8 +406,13 @@ def test_registry_hot_swaps_decoders_with_release():
     assert out2["version"] == 2
     # same seeded spec -> the swap is invisible in the tokens
     assert out2["tokens"] == out1["tokens"]
-    # the retired engine released its params and KV pool
-    assert old._released and old._params is None and old.cache.k is None
+    # the retired engine released its params and KV pool (white-box
+    # reads under each attr's guard: this file runs sanitized too)
+    with old._cond:
+        assert old._released
+    with old._step_mu:
+        assert old._params is None
+    assert old.cache.k is None
     # ... and zeroed its per-version gauges — no phantom load on a
     # dead engine (live_slots included: the scheduler can exit between
     # steps without a final answer phase)

@@ -3,9 +3,15 @@
 
     python tools/check.py            # analysis CLI + collect-only smoke
     python tools/check.py --fast     # skip the (abstract-eval priced)
-                                     # V003/V004 shape re-check
+                                     # V003/V004 shape re-check; the
+                                     # cheap passes (locks/guards/
+                                     # invariants) still all run
     python tools/check.py --selftest # also prove every diagnostic code
                                      # still fires
+    python tools/check.py --sanitize tests/test_decode_serving.py
+                                     # re-run a test file under the
+                                     # runtime guard sanitizer
+                                     # (PADDLE_TPU_SANITIZE=guards)
 
 Runs the same things CI's cheap lane runs, in the same way, so "works
 locally" and "works in CI" are the same claim:
@@ -38,11 +44,12 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(title, cmd) -> int:
+def _run(title, cmd, extra_env=None) -> int:
     print(f"\n=== {title}: {' '.join(cmd)}")
     t0 = time.time()
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra_env or {})
     proc = subprocess.run(cmd, cwd=ROOT, env=env)
     print(f"=== {title}: rc={proc.returncode} "
           f"({time.time() - t0:.1f}s)")
@@ -55,6 +62,11 @@ def main(argv=None) -> int:
                     help="skip the shape/dtype abstract-eval re-check")
     ap.add_argument("--selftest", action="store_true",
                     help="also run the analysis selftest")
+    ap.add_argument("--sanitize", metavar="TESTFILE", default=None,
+                    help="re-run the named pytest file under "
+                         "PADDLE_TPU_SANITIZE=guards (runtime guard "
+                         "sanitizer: every '# guarded-by' declaration "
+                         "is asserted at attribute access)")
     args = ap.parse_args(argv)
 
     py = sys.executable
@@ -71,6 +83,11 @@ def main(argv=None) -> int:
     rc |= _run("pytest collect smoke",
                [py, "-m", "pytest", "tests/", "--collect-only", "-q",
                 "-p", "no:cacheprovider"])
+    if args.sanitize:
+        rc |= _run("guard-sanitized test run",
+                   [py, "-m", "pytest", args.sanitize, "-q",
+                    "-m", "not slow", "-p", "no:cacheprovider"],
+                   extra_env={"PADDLE_TPU_SANITIZE": "guards"})
     print(f"\ntools/check.py: {'OK' if rc == 0 else 'FAILED'}")
     return 1 if rc else 0
 
