@@ -217,6 +217,13 @@ def main() -> int:
     rows["reprefill"] = run_reprefill(spec, workload)
     cont, drain, straw = (rows["continuous"], rows["drain"],
                           rows["reprefill"])
+    # tuner input (ISSUE 8): the slot-demand histogram the engines'
+    # submit paths observed, plus any ladder derived/persisted from it
+    # (set PADDLE_TPU_AUTOTUNE_DIR to seed a future slots="auto" load)
+    from paddle_tpu import autotune
+
+    shape_hist = autotune.histograms()
+    derived = autotune.seed_cache_from_observed()
     evidence = {
         "what": "decode_bench: continuous batching vs drain-per-batch vs "
                 "re-prefill-per-token, identical workload + decoder",
@@ -233,6 +240,8 @@ def main() -> int:
             cont["tokens_per_s"] / max(drain["tokens_per_s"], 1e-9), 3),
         "speedup_continuous_vs_reprefill": round(
             cont["tokens_per_s"] / max(straw["tokens_per_s"], 1e-9), 3),
+        "shape_histogram": shape_hist,
+        "derived_ladders": derived,
         "framework_metrics": framework_metrics(),
     }
     print(json.dumps(evidence))

@@ -113,6 +113,15 @@ def main() -> int:
         wall_s = time.perf_counter() - t0
 
         snap = metrics.snapshot(prefix="serving.", skip_zero=True)
+        # the tuner's view of this run: the observed request-size
+        # histogram (recorded by the engine's submit path) plus any
+        # ladders derived from it — with PADDLE_TPU_AUTOTUNE_DIR set the
+        # derivation persists, so a bench session seeds the next serving
+        # session's buckets="auto" (ISSUE 8)
+        from paddle_tpu import autotune
+
+        shape_hist = autotune.histograms()
+        derived = autotune.seed_cache_from_observed()
         lat = np.asarray(sorted(lat_ms)) if lat_ms else np.zeros(1)
         evidence = {
             "what": "serving_bench open-loop",
@@ -136,6 +145,8 @@ def main() -> int:
             "batch_size": snap.get("serving.batch_size", {}),
             "queue_wait_ms": snap.get("serving.queue_wait_ms", {}),
             "compute_ms": snap.get("serving.compute_ms", {}),
+            "shape_histogram": shape_hist,
+            "derived_ladders": derived,
             "framework_metrics": framework_metrics(),
         }
         loader.close()

@@ -247,6 +247,8 @@ class NameUniverse:
         "fluid": "paddle_tpu.fluid",
         "executor": "paddle_tpu.fluid.executor",
         "io": "paddle_tpu.fluid.io",
+        "serving": "paddle_tpu.serving",
+        "autotune": "paddle_tpu.autotune",
     }
 
     def __init__(self, names: Tuple[Set[str], Set[str]],
@@ -397,7 +399,9 @@ def collect_defined_flags(flags_path: str) -> Set[str]:
 def collect_flag_refs(paths: Iterable[str], skip_files: Set[str] = frozenset()
                       ) -> List[Tuple[str, str, int, str]]:
     """(key, file, line, kind) of FLAGS["k"] subscripts, get_flag("k")
-    calls, and set_flags({"k": ...}) literal keys."""
+    / effective_flag("k") calls (the tuner read-through is still a
+    FLAGS read — the entry is its cold-cache default), and
+    set_flags({"k": ...}) literal keys."""
     out: List[Tuple[str, str, int, str]] = []
     for path in _py_files(*paths):
         if os.path.abspath(path) in skip_files:
@@ -420,8 +424,8 @@ def collect_flag_refs(paths: Iterable[str], skip_files: Set[str] = frozenset()
                 fn = node.func
                 name = fn.attr if isinstance(fn, ast.Attribute) else (
                     fn.id if isinstance(fn, ast.Name) else None)
-                if name == "get_flag" and node.args and \
-                        isinstance(node.args[0], ast.Constant):
+                if name in ("get_flag", "effective_flag") and node.args \
+                        and isinstance(node.args[0], ast.Constant):
                     out.append((node.args[0].value, path, node.lineno,
                                 "read"))
                 elif name == "set_flags" and node.args and \
@@ -556,7 +560,7 @@ def check_repo(root: Optional[str] = None) -> List[Diagnostic]:
     tools = os.path.join(root, "tools")
     docs = [os.path.join(root, "docs", n)
             for n in ("OBSERVABILITY.md", "FAULT_TOLERANCE.md",
-                      "STATIC_ANALYSIS.md", "SERVING.md")]
+                      "STATIC_ANALYSIS.md", "SERVING.md", "AUTOTUNE.md")]
     diags: List[Diagnostic] = []
 
     sites = collect_declared_sites(pkg)

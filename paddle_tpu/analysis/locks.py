@@ -541,4 +541,5 @@ def default_lint_paths(repo_root: Optional[str] = None) -> List[str]:
     pkg = os.path.join(root, "paddle_tpu")
     return [os.path.join(pkg, "distributed"),
             os.path.join(pkg, "observability"),
-            os.path.join(pkg, "serving")]
+            os.path.join(pkg, "serving"),
+            os.path.join(pkg, "autotune")]

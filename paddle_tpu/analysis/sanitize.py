@@ -57,6 +57,8 @@ _RUNTIME_CLASSES: Tuple[Tuple[str, str], ...] = (
     ("paddle_tpu.distributed.rpc", "RpcClient"),
     ("paddle_tpu.distributed.param_server", "ParameterServer"),
     ("paddle_tpu.distributed.master", "MasterClient"),
+    ("paddle_tpu.autotune.cache", "TuningCache"),
+    ("paddle_tpu.autotune.ladder", "ShapeHistogram"),
 )
 
 _ARMED_FLAG = "_guard_sanitizer_armed_"

@@ -1,0 +1,49 @@
+"""paddle_tpu.autotune — cost-model-driven autotuning (ISSUE 8).
+
+Turns the measurement substrate the framework already has (XLA
+cost_analysis per compiled executable, request shapes flowing through
+serving, step wall times) into DECISIONS, TVM-style (PAPERS.md):
+
+  - a persistent **tuning cache** keyed ``(device_kind, tunable_id,
+    shape_key)`` (cache.py — atomic JSON under
+    ``PADDLE_TPU_AUTOTUNE_DIR``, corrupt files degrade to defaults);
+  - a **measure-or-model engine** (measure.py — median-of-k timed runs
+    when an executable exists, cost_analysis proxy as the zero-run
+    fallback, repeat sessions answered from the cache);
+  - a **shape-histogram recorder + ladder deriver** (ladder.py —
+    observed request-size distributions become ``buckets="auto"`` /
+    ``slots="auto"`` serving ladders that minimize expected padding
+    waste).
+
+Consumers: attention routing reads ``flash_min_seq`` and
+``paged_min_slots`` through ``fluid.flags.effective_flag`` (the FLAGS
+constants are the cold-cache defaults, overridden per device kind);
+the serving engines resolve ``"auto"`` ladders at load; the executor
+logs per-shape step timings. All of it is inert until
+``FLAGS['autotune']`` is on — except the histogram recorder, which is
+metrics-cheap and always on so bench sessions double as tuner input.
+
+    python -m paddle_tpu.autotune --selftest   # in-process proof
+    python -m paddle_tpu.autotune --dump       # cache + histograms
+
+See docs/AUTOTUNE.md.
+"""
+from .cache import (CACHE_FILENAME, TuningCache, device_kind, get_cache,
+                    reset_cache, scoped, tuned_value)
+from .ladder import (ShapeHistogram, derive_ladder, expected_padding_waste,
+                     histogram, histograms, merge_observed, observe,
+                     percentile_size, reset_histograms, resolve_ladder,
+                     seed_cache_from_observed)
+from .measure import (cached_step_ms, jit_cost, measure_or_model,
+                      model_score, note_step_timing, step_shape_key)
+
+__all__ = [
+    "TuningCache", "CACHE_FILENAME", "device_kind", "get_cache",
+    "reset_cache", "scoped", "tuned_value",
+    "ShapeHistogram", "observe", "histogram", "histograms",
+    "merge_observed", "reset_histograms", "derive_ladder",
+    "expected_padding_waste",
+    "percentile_size", "resolve_ladder", "seed_cache_from_observed",
+    "measure_or_model", "jit_cost", "model_score", "step_shape_key",
+    "note_step_timing", "cached_step_ms",
+]

@@ -62,6 +62,27 @@ FLAGS: Dict[str, Any] = _Flags({
     # comfortably in HBM bandwidth, flash wins once it doesn't. 0 = always
     # flash (and long-seq tests force it to exercise the kernel).
     "flash_min_seq": 3072,
+    # cost-model-driven autotuning (ISSUE 8; paddle_tpu/autotune).
+    # False = every knob is exactly its hand-set FLAGS default (zero
+    # overhead, the pre-autotune behavior); True = routing thresholds
+    # (flash_min_seq, paged_min_slots) and "auto" serving ladders read
+    # through the tuning cache per DEVICE KIND (the FLAGS constants
+    # demote to cold-cache defaults), and the executor logs per-shape
+    # step timings into the cache
+    "autotune": False,
+    # where the tuning cache persists (tuning_cache.json, atomic
+    # tmp-write+rename like master.snapshot). Seeded from
+    # PADDLE_TPU_AUTOTUNE_DIR; '' = in-memory only. Read once, when the
+    # process cache is first created (autotune.get_cache)
+    "autotune_dir": os.environ.get("PADDLE_TPU_AUTOTUNE_DIR", ""),
+    # minimum decode batch (slot count) at which paged attention routes
+    # to the Pallas kernel instead of the pure-jax reference when
+    # kernels are enabled. 1 = kernel always (the measured PR 6 answer
+    # on v5e: decode attention is bandwidth-bound, the paged kernel
+    # wins at every batch) — a cold-cache default the tuner overrides
+    # per device kind (Ragged Paged Attention motivates per-chip
+    # routing; a future chip's crossover need not be 1)
+    "paged_min_slots": 1,
     # mixed precision: bf16 MXU operands with f32 accumulation for
     # conv/matmul (master weights and the rest of the graph stay f32) —
     # the standard TPU training configuration
@@ -192,6 +213,24 @@ def get_flag(name: str):
     return FLAGS[name]
 
 
+def effective_flag(name: str, count: bool = True):
+    """A routing knob's EFFECTIVE value: the FLAGS entry is the
+    cold-cache default; with FLAGS['autotune'] on, a measured/derived/
+    override record for this device kind in the tuning cache wins
+    (each resolution counts autotune.cache.hits/misses — the evidence
+    that routing reads THROUGH the cache; trace_flags passes
+    count=False so per-step jit-key construction doesn't drown the
+    handful of real route resolutions in thousands of increments).
+    Off, this is exactly get_flag — zero overhead, bit-identical
+    behavior."""
+    base = FLAGS[name]
+    if not FLAGS["autotune"]:
+        return base
+    from ..autotune import tuned_value
+
+    return tuned_value(name, default=base, count=count)
+
+
 def init_gflags(args=None):
     """reference core.init_gflags (pybind.cc:465) — accepts '--name=value'."""
     for a in args or []:
@@ -208,7 +247,11 @@ def init_gflags(args=None):
 def trace_flags() -> tuple:
     """Flags that change what gets TRACED (and therefore compiled): any
     executor jit-cache key must include them, or toggling a flag after the
-    first run of a program would be silently ignored."""
+    first run of a program would be silently ignored. Routing thresholds
+    enter at their EFFECTIVE (tuner-resolved) value: a tuning-cache
+    update changes the key, so stale executables compiled under the old
+    threshold are never replayed for the new routing."""
     return (FLAGS["matmul_precision"], FLAGS["use_pallas_kernels"],
             FLAGS["amp"], FLAGS["count_while_step_evals"],
-            FLAGS["flash_min_seq"])
+            effective_flag("flash_min_seq", count=False),
+            effective_flag("paged_min_slots", count=False))

@@ -8,8 +8,15 @@ Ulysses sequence parallelism over a mesh axis when lowered under a mesh.
 """
 from __future__ import annotations
 
+from ...observability import metrics as _metrics
 from ..registry import register_op
 from .common import one
+
+# routing decisions are taken at TRACE time (this op body is Python run
+# once per compile, not per step), so these count compiled routes — the
+# counter pair the autotune routing tests assert on
+_m_route_flash = _metrics.counter("attention.route.flash")
+_m_route_dense = _metrics.counter("attention.route.dense")
 
 
 @register_op("ring_attention", no_grad=(),
@@ -37,11 +44,20 @@ def ring_attention(ctx, ins, attrs):
 
     mesh = current_mesh()
     if mesh is None or seq_axis not in mesh.axis_names:
-        from ..flags import get_flag, pallas_enabled, pallas_interpret
+        from ..flags import effective_flag, pallas_enabled, pallas_interpret
 
         # route by measured crossover: XLA's dense path beats the flash
-        # kernel below flash_min_seq (see flags.py for the v5e table)
-        if pallas_enabled() and q.shape[1] >= int(get_flag("flash_min_seq")):
+        # kernel below flash_min_seq. The FLAGS constant (the v5e bench
+        # table) is only the cold-cache default — with autotune on, the
+        # tuning cache's per-device-kind value wins (and trace_flags
+        # keys the jit cache on the effective value, so a cache update
+        # can never replay a stale-routed executable)
+        use_flash = (pallas_enabled()
+                     and q.shape[1] >= int(effective_flag("flash_min_seq")))
+        # counts the THRESHOLD decision (the rare mesh-without-
+        # dividable-axis fallthrough below still lands on XLA)
+        (_m_route_flash if use_flash else _m_route_dense).inc()
+        if use_flash:
             from .pallas_kernels import flash_attention
 
             if mesh is None:

@@ -34,10 +34,15 @@ tests/test_decode_serving.py):
     the [B, W*page_size] score matrix never materializes.
 
 ``paged_attention`` routes between them via flags (the same
-``use_pallas_kernels`` surface that routes flash attention; decode
-attention is bandwidth-bound so there is no ``flash_min_seq``-style
-crossover — on TPU the paged kernel always wins over gather-then-dense,
-which would materialize every page table's worth of K/V per step).
+``use_pallas_kernels`` surface that routes flash attention) plus a
+``flash_min_seq``-style crossover, ``paged_min_slots``: the kernel
+engages at batches of at least that many slots. The cold-cache default
+is 1 — on the measured v5e the paged kernel always wins over
+gather-then-dense, which materializes every page table's worth of K/V
+per step — but the threshold reads through the autotune cache
+(``fluid.flags.effective_flag``), so a device kind where the crossover
+sits elsewhere re-routes without a code change (ISSUE 8; Ragged Paged
+Attention motivates per-chip routing).
 """
 from __future__ import annotations
 
@@ -49,9 +54,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ....observability import metrics as _metrics
+
 NEG_INF = -1e30
 
 __all__ = ["paged_attention", "paged_attention_reference"]
+
+# trace-time routing counters (this function body runs once per
+# compiled shape, n_layers times per decoder trace — not per step):
+# the autotune per-device-kind override test pins these
+_m_route_kernel = _metrics.counter("attention.route.paged_kernel")
+_m_route_ref = _metrics.counter("attention.route.paged_reference")
 
 
 def _check_shapes(q, k_pages, v_pages, page_tables, kv_lens):
@@ -190,13 +203,18 @@ def paged_attention(q, k_pages, v_pages, page_tables, kv_lens,
     """Route between the Pallas kernel (TPU, or forced via
     ``use_pallas_kernels=True`` in interpret mode for tests) and the
     pure-jax reference — the same flags surface flash attention uses
-    (fluid/ops/attention_ops.py)."""
-    from ...flags import pallas_enabled, pallas_interpret
+    (fluid/ops/attention_ops.py), with the ``paged_min_slots``
+    crossover read through the autotune cache per device kind (the
+    hard-coded always-kernel answer survives as the cold default)."""
+    from ...flags import effective_flag, pallas_enabled, pallas_interpret
 
-    if pallas_enabled():
+    if pallas_enabled() and \
+            q.shape[0] >= int(effective_flag("paged_min_slots")):
+        _m_route_kernel.inc()
         return _paged_attention_pallas(
             q, k_pages, v_pages, page_tables, kv_lens, scale=scale,
             interpret=pallas_interpret() if interpret is None
             else interpret)
+    _m_route_ref.inc()
     return paged_attention_reference(q, k_pages, v_pages, page_tables,
                                      kv_lens, scale=scale)

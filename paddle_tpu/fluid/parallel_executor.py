@@ -192,11 +192,12 @@ class ParallelExecutor:
         feed_sig = tuple(
             sorted((k, tuple(v.shape), str(v.dtype)) for k, v in feed_arrays.items())
         )
-        from .flags import trace_flags
+        from .flags import FLAGS, trace_flags
 
         cache_key = (id(program), program._version, feed_sig, fetch_names,
                      trace_flags())
         entry = self._cache.get(cache_key)
+        fresh_compile = entry is None
         if entry is not None:
             _m_pe_cache_hits.inc()
         if entry is None:
@@ -281,8 +282,26 @@ class ParallelExecutor:
         if return_numpy:
             from .selected_rows import is_selected_rows
 
-            return [f if is_selected_rows(f) else np.asarray(f)
-                    for f in fetches]
+            out = [f if is_selected_rows(f) else np.asarray(f)
+                   for f in fetches]
+            if FLAGS["autotune"] and not fresh_compile:
+                # same per-shape step log the single-device executor
+                # feeds (ISSUE 8). Logged AFTER the numpy conversion —
+                # np.asarray is the only honest device barrier
+                # (block_until_ready lies through the axon tunnel,
+                # benchmarks/_timing.py); timing the bare jfn() return
+                # would persist async-DISPATCH latency as the step
+                # cost. Compile runs excluded; return_numpy=False runs
+                # have no barrier, so they are not logged at all.
+                from ..autotune.measure import note_step_timing
+
+                try:
+                    note_step_timing(
+                        "parallel_executor.step", program, feed,
+                        (_time.perf_counter() - t0) * 1e3)
+                except Exception:
+                    pass
+            return out
         return list(fetches)
 
     def bcast_params(self):

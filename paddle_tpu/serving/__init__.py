@@ -15,8 +15,9 @@ docs/SERVING.md.
     python -m paddle_tpu.serving --selftest   # in-process end-to-end
 """
 from .client import ServingClient
-from .decode import DecodeEngine, DecoderSpec
-from .engine import InferenceEngine, default_buckets, parse_buckets
+from .decode import DecodeEngine, DecoderSpec, sample_token
+from .engine import (InferenceEngine, default_buckets, parse_buckets,
+                     resolve_bucket_spec)
 from .errors import (DeadlineExceeded, EngineRetired, ModelNotFound,
                      RequestTooLarge, ServerOverloaded, ServingError)
 from .kv_cache import PageAllocator, PagedKvCache
@@ -28,5 +29,6 @@ __all__ = [
     "ServingServer", "ServingClient", "PageAllocator", "PagedKvCache",
     "ServingError", "ServerOverloaded", "DeadlineExceeded",
     "ModelNotFound", "RequestTooLarge", "EngineRetired",
-    "default_buckets", "parse_buckets",
+    "default_buckets", "parse_buckets", "resolve_bucket_spec",
+    "sample_token",
 ]

@@ -29,6 +29,16 @@ _TYPED = {cls.__name__: cls for cls in
 _ERR_RE = re.compile(r"^RPC \S+ failed: (\w+): (.*)$", re.DOTALL)
 
 
+def _ladder_arg(v):
+    """Bucket/slot ladders ride the wire as int lists — except the
+    literal string 'auto', which must reach the SERVER intact so the
+    ladder resolves against the server's device kind, observed traffic,
+    and tuning cache (autotune), not the client's."""
+    if v is None or (isinstance(v, str) and v.strip().lower() == "auto"):
+        return v
+    return [int(x) for x in v]
+
+
 def _raise_typed(e: RuntimeError):
     m = _ERR_RE.match(str(e))
     if m and m.group(1) in _TYPED:
@@ -57,15 +67,21 @@ class ServingClient:
 
     def generate(self, model: str, prompt: Sequence[int],
                  max_new_tokens: int = 16,
-                 deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+                 deadline_ms: Optional[float] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0) -> Dict[str, Any]:
         """Autoregressive decode on a loaded decoder. Returns
         ``{"model", "version", "tokens", "prompt_len"}``. Transport
         retries are dedup-safe: a retransmitted generate is answered
-        from the server's cache without re-decoding the sequence."""
+        from the server's cache without re-decoding the sequence.
+        ``temperature``/``top_k``/``seed`` select the per-request
+        sampling policy (0.0 = greedy argmax; sampled output is
+        deterministic given the seed)."""
         try:
             return self._rpc.call(
                 "generate", model, [int(t) for t in prompt],
-                int(max_new_tokens), deadline_ms)
+                int(max_new_tokens), deadline_ms, float(temperature),
+                int(top_k), int(seed))
         except RuntimeError as e:
             _raise_typed(e)
 
@@ -81,7 +97,7 @@ class ServingClient:
         try:
             return self._rpc.call(
                 "load_decoder", model, dict(spec), version,
-                None if slots is None else [int(s) for s in slots],
+                _ladder_arg(slots),
                 page_size, num_pages, max_seq_len, max_queue)
         except RuntimeError as e:
             _raise_typed(e)
@@ -93,8 +109,7 @@ class ServingClient:
                    max_wait_ms: Optional[float] = None) -> Dict[str, Any]:
         try:
             return self._rpc.call("load_model", model, dirname, version,
-                                  kind, None if buckets is None
-                                  else [int(b) for b in buckets],
+                                  kind, _ladder_arg(buckets),
                                   max_queue, max_wait_ms)
         except RuntimeError as e:
             _raise_typed(e)

@@ -57,15 +57,16 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..autotune.ladder import observe as _observe_shape
 from ..observability import metrics as _metrics, tracing as _tracing
 from ..observability.log import get_logger
-from .engine import bucket_for as _bucket_for, parse_buckets
+from .engine import bucket_for as _bucket_for, resolve_bucket_spec
 from .errors import (DeadlineExceeded, EngineRetired, RequestTooLarge,
                      ServerOverloaded, ServingError)
 from .kv_cache import GARBAGE_PAGE, PagedKvCache
 
 __all__ = ["DecoderSpec", "DecodeEngine", "build_decoder_params",
-           "decoder_step", "width_ladder"]
+           "decoder_step", "width_ladder", "sample_token"]
 
 _log = get_logger("serving")
 
@@ -244,6 +245,38 @@ def decoder_step(params, spec: DecoderSpec, tokens, positions,
     return k_pool, v_pool, logits
 
 
+# --- sampling -----------------------------------------------------------
+
+def sample_token(logits_row, temperature: float = 0.0, top_k: int = 0,
+                 seed: int = 0, position: int = 0) -> int:
+    """Sampling policy for ONE generated token (the ROADMAP
+    sampling-beyond-greedy residual): greedy argmax at temperature 0
+    (the default — bitwise the PR 6 behavior), else temperature-scaled
+    softmax over the ``top_k`` highest logits (0 = full vocab), drawn
+    from an rng derived ONLY from ``(seed, position)``.
+
+    Deterministic given the request's seed, and — because position is
+    the token's absolute index in ITS sequence — independent of batch
+    composition, slot assignment, and admission order: continuous
+    batching cannot perturb a request's sampled output (tier-1 pins a
+    request decoding identically through two differently-loaded
+    engines)."""
+    row = np.asarray(logits_row, np.float64)
+    if temperature <= 0.0:
+        return int(np.argmax(row))
+    row = row / float(temperature)
+    k = int(top_k)
+    if 0 < k < row.size:
+        kth = np.partition(row, -k)[-k]
+        row = np.where(row < kth, -np.inf, row)
+    row = row - row.max()
+    p = np.exp(row)
+    p /= p.sum()
+    rng = np.random.Generator(np.random.PCG64(np.random.SeedSequence(
+        [int(seed) & 0xFFFFFFFF, int(position)])))
+    return int(rng.choice(row.size, p=p))
+
+
 # --- ladders ------------------------------------------------------------
 
 def width_ladder(max_pages: int) -> List[int]:
@@ -264,10 +297,12 @@ def width_ladder(max_pages: int) -> List[int]:
 
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "deadline", "ev", "result", "error",
-                 "t_enq", "seq_id", "trace_ctx")
+                 "t_enq", "seq_id", "trace_ctx", "temperature", "top_k",
+                 "seed")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
-                 deadline: Optional[float], seq_id: int):
+                 deadline: Optional[float], seq_id: int,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
         self.prompt = prompt
         self.max_new = int(max_new)
         self.deadline = deadline
@@ -277,6 +312,9 @@ class _DecodeRequest:
         self.t_enq = time.monotonic()
         self.seq_id = seq_id
         self.trace_ctx = _tracing.wire_context()
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
 
     def fail(self, err: BaseException):
         self.error = err
@@ -327,8 +365,13 @@ class DecodeEngine:
         # serializes every read-step-rebind against retirement's drop
         self._params = (build_decoder_params(spec)
                         if params is None else params)  # guarded-by: _step_mu
-        self._slot_ladder = parse_buckets(
-            FLAGS["decode_slots"] if slots is None else slots)
+        # slots="auto" resolves through the tuner exactly like the
+        # one-shot engine's buckets="auto": a derived ladder from the
+        # observed slot-demand histogram (or the cached one), else the
+        # static FLAGS default — fixed before warm() either way
+        self._slot_ladder = resolve_bucket_spec(
+            FLAGS["decode_slots"] if slots is None else slots,
+            tunable_id="decode_slots", fallback="1,2,4")
         self._max_slots = self._slot_ladder[-1]
         ps = int(FLAGS["kv_page_size"] if page_size is None else page_size)
         npages = int(FLAGS["kv_num_pages"] if num_pages is None
@@ -423,11 +466,15 @@ class DecodeEngine:
                         np.zeros(s, np.int32))
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
-               deadline_ms: Optional[float] = None) -> _DecodeRequest:
+               deadline_ms: Optional[float] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               seed: int = 0) -> _DecodeRequest:
         """Validate + reserve KV pages + enqueue. All refusals are
         synchronous and typed: ``ServerOverloaded`` (queue full OR page
         pool exhausted), ``RequestTooLarge`` (can't ever fit),
-        ``EngineRetired``, ``ValueError`` (bad tokens)."""
+        ``EngineRetired``, ``ValueError`` (bad tokens / bad sampling
+        params). ``temperature``/``top_k``/``seed`` select the sampling
+        policy per request (``sample_token``; 0.0 = greedy)."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -442,6 +489,12 @@ class DecodeEngine:
             raise RequestTooLarge(
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new}) = "
                 f"{total} exceeds max_seq_len {self.max_seq_len}")
+        temperature = float(temperature)
+        top_k = int(top_k)
+        if temperature < 0.0 or not math.isfinite(temperature):
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
         deadline = (None if deadline_ms is None
                     else time.monotonic() + float(deadline_ms) / 1e3)
         with self._cond:
@@ -463,20 +516,30 @@ class DecodeEngine:
             except ServerOverloaded:
                 _m_overloads.inc()
                 raise
-            req = _DecodeRequest(prompt, max_new, deadline, seq_id)
+            req = _DecodeRequest(prompt, max_new, deadline, seq_id,
+                                 temperature=temperature, top_k=top_k,
+                                 seed=seed)
             self._queue.append(req)
             self._n_requests += 1
             self._g_depth.set(len(self._queue))
+            # instantaneous concurrency demand — what slots="auto"
+            # derives its ladder from (observed outside the lock)
+            demand = len(self._queue) + len(self._slots)
             self._cond.notify()
+        _observe_shape("decode_slots", demand)
         _m_requests.inc()
         return req
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
                  deadline_ms: Optional[float] = None,
-                 timeout: float = 300.0) -> Dict[str, Any]:
+                 timeout: float = 300.0, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0) -> Dict[str, Any]:
         """Blocking convenience: submit + wait. Returns
-        ``{"tokens": [...], "prompt_len": n, "version": v}``."""
-        req = self.submit(prompt, max_new_tokens, deadline_ms=deadline_ms)
+        ``{"tokens": [...], "prompt_len": n, "version": v}``.
+        ``temperature``/``top_k``/``seed`` thread through to the
+        per-request sampler (0.0 = greedy, the default)."""
+        req = self.submit(prompt, max_new_tokens, deadline_ms=deadline_ms,
+                          temperature=temperature, top_k=top_k, seed=seed)
         if not req.ev.wait(timeout):
             # withdraw before raising: an abandoned sequence must not
             # keep its page reservation or burn further decode steps.
@@ -726,7 +789,10 @@ class DecodeEngine:
                               version=self.version, slots=s_bucket,
                               width=w_bucket, live=len(live)):
             logits = self._run_step_arrays(tokens, positions, tables, lens)
-        sampled = np.asarray(np.argmax(np.asarray(logits), axis=-1))
+        logits_np = np.asarray(logits)
+        # the greedy fast path for the whole batch; per-request sampling
+        # policies (temperature/top_k/seed) resolve per slot below
+        sampled = np.asarray(np.argmax(logits_np, axis=-1))
         _m_step_ms.observe((time.perf_counter() - t0) * 1e3)
         _m_steps.inc()
         _m_occupancy.observe(len(live) / float(s_bucket))
@@ -750,7 +816,13 @@ class DecodeEngine:
                 notes[s.req.seq_id] = s.pos
                 tok = None
                 if s.pos >= len(s.req.prompt):
-                    tok = int(sampled[i])
+                    # s.pos is the new token's absolute index in its
+                    # sequence — the (seed, position) pair that makes
+                    # sampling independent of batch composition
+                    tok = (int(sampled[i]) if s.req.temperature <= 0.0
+                           else sample_token(
+                               logits_np[i], s.req.temperature,
+                               s.req.top_k, s.req.seed, s.pos))
                     s.produced.append(tok)
                     _m_tokens.inc()
                 finished = (len(s.produced) >= s.req.max_new

@@ -52,12 +52,14 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..autotune.ladder import observe as _observe_shape
 from ..observability import metrics as _metrics, tracing as _tracing
 from ..observability.log import get_logger
 from .errors import (DeadlineExceeded, EngineRetired, RequestTooLarge,
                      ServerOverloaded, ServingError)
 
-__all__ = ["InferenceEngine", "parse_buckets", "default_buckets"]
+__all__ = ["InferenceEngine", "parse_buckets", "default_buckets",
+           "resolve_bucket_spec"]
 
 _log = get_logger("serving")
 
@@ -81,10 +83,40 @@ _m_overloads = _metrics.counter("serving.overloads")
 _m_deadline_miss = _metrics.counter("serving.deadline_misses")
 
 
+# the hand-set geometric ladder — the cold-cache fallback when "auto"
+# has nothing observed and nothing cached (matches the FLAGS default)
+_STATIC_BUCKETS = "1,2,4,8,16"
+
+
 def default_buckets() -> List[int]:
     from ..fluid.flags import FLAGS
 
-    return parse_buckets(FLAGS["serving_buckets"])
+    return resolve_bucket_spec(FLAGS["serving_buckets"])
+
+
+def _is_auto(spec) -> bool:
+    return isinstance(spec, str) and spec.strip().lower() == "auto"
+
+
+def resolve_bucket_spec(spec, *, tunable_id: str = "serving_buckets",
+                        fallback: str = _STATIC_BUCKETS) -> List[int]:
+    """``"auto"`` resolves through the tuner (ISSUE 8): a cached
+    derived ladder for this device kind, else a ladder derived from the
+    observed request-shape histogram, else the static default — the
+    operator's FLAGS ladder when one is set (``tunable_id`` doubles as
+    the FLAGS key), the shipped ``fallback`` only when the flag itself
+    says "auto". Anything else parses as a literal ladder. Resolution
+    happens ONCE, at engine load (before ``warm()``) — the ladder is
+    fixed after warm, so the bounded-jit-cache / zero-post-warm-compiles
+    invariants are untouched by autotuning."""
+    if _is_auto(spec):
+        from ..autotune.ladder import resolve_ladder
+        from ..fluid.flags import FLAGS
+
+        flag_val = FLAGS[tunable_id] if tunable_id in FLAGS else fallback
+        base = fallback if _is_auto(flag_val) else flag_val
+        return resolve_ladder(tunable_id, default=parse_buckets(base))
+    return parse_buckets(spec)
 
 
 def parse_buckets(spec) -> List[int]:
@@ -189,8 +221,8 @@ class InferenceEngine:
         # the shape[0]==bucket heuristic per batch.
         self._fetch_batched = (None if fetch_batched is None
                                else list(fetch_batched))
-        self._buckets = parse_buckets(buckets) if buckets is not None \
-            else default_buckets()
+        self._buckets = resolve_bucket_spec(buckets) \
+            if buckets is not None else default_buckets()
         self._max_batch = self._buckets[-1]
         self._max_queue = int(FLAGS["serving_max_queue"]
                               if max_queue is None
@@ -370,6 +402,12 @@ class InferenceEngine:
             arrs[spec.name] = a
         if not rows:
             raise ValueError("empty request (zero rows)")
+        # the tuner's shape recorder: every VALID request's row count —
+        # including ones the incumbent ladder is about to refuse, or a
+        # future auto-derived ladder could never learn to grow past the
+        # current top bucket (autotune/ladder.py); metrics-cheap and
+        # deliberately outside the engine lock
+        _observe_shape("serving_buckets", rows)
         if rows > self._max_batch:
             raise RequestTooLarge(
                 f"request of {rows} rows exceeds model '{self.name}' "

@@ -175,10 +175,15 @@ class ServingServer:
 
     def _generate(self, model: str, prompt: Sequence[int],
                   max_new_tokens: int = 16,
-                  deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+                  deadline_ms: Optional[float] = None,
+                  temperature: float = 0.0, top_k: int = 0,
+                  seed: int = 0) -> Dict[str, Any]:
         """Autoregressive decode on a loaded DecodeEngine. Same swap-
         resubmit contract as _infer: racing a hot-swap re-enqueues on
-        the replacement decoder instead of failing the request."""
+        the replacement decoder instead of failing the request.
+        Sampling params thread through per request (decode.sample_token;
+        deterministic given seed, so the dedup cache's answer to a
+        retransmit equals what a re-decode would have produced)."""
         with _tracing.span("serving.decode.request", model=str(model)):
             for _ in range(self._SWAP_RETRIES):
                 engine = self._registry.get(str(model))
@@ -189,7 +194,8 @@ class ServingServer:
                 try:
                     out = engine.generate(
                         prompt, max_new_tokens=max_new_tokens,
-                        deadline_ms=deadline_ms)
+                        deadline_ms=deadline_ms,
+                        temperature=temperature, top_k=top_k, seed=seed)
                 except EngineRetired:
                     _m_resubmits.inc()
                     continue

@@ -13,6 +13,10 @@ Coverage map:
     compiles (the tier-1 acceptance guard — counter-asserted, and the
     fluid executor's jit counter stays untouched), KV footprint fixed,
     greedy decode deterministic;
+  - sampling (ISSUE 8 satellite): temperature/top-k/seed per request,
+    deterministic given seed and independent of batch composition,
+    temperature 0 / top_k 1 bitwise-greedy, typed validation, RPC
+    pass-through;
   - continuous batching beats drain-per-batch by EXACT step counts
     (the scheduler-shape claim, proven with counters, not clocks);
   - admission: queue overload, page-pool exhaustion, RequestTooLarge,
@@ -236,6 +240,76 @@ def test_decode_greedy_is_deterministic():
             eng2.stop()
     finally:
         eng.stop()
+
+
+def test_sampling_deterministic_given_seed_and_batch_independent():
+    """temperature/top-k sampling (ISSUE 8 satellite, the ROADMAP
+    beyond-greedy residual): the rng derives only from (request seed,
+    token position), so a request's sampled output is identical across
+    engines, slot ladders, and co-riding traffic — continuous batching
+    cannot perturb it."""
+    from paddle_tpu.serving.decode import sample_token
+
+    eng = _engine()
+    try:
+        a = eng.generate([3, 1, 4], max_new_tokens=5, temperature=0.9,
+                         top_k=8, seed=1234)
+        b = eng.generate([3, 1, 4], max_new_tokens=5, temperature=0.9,
+                         top_k=8, seed=1234)
+        assert a["tokens"] == b["tokens"]
+        # a different engine shape AND concurrent traffic: same tokens
+        eng2 = _engine(name="toy_s2", slots=[1, 2, 4], num_pages=16)
+        try:
+            noise = [eng2.submit([7], max_new_tokens=3,
+                                 temperature=0.5, seed=i)
+                     for i in range(3)]
+            c = eng2.generate([3, 1, 4], max_new_tokens=5,
+                              temperature=0.9, top_k=8, seed=1234)
+            for r in noise:
+                assert r.ev.wait(120) and r.error is None
+            assert c["tokens"] == a["tokens"]
+        finally:
+            eng2.stop()
+    finally:
+        eng.stop()
+    # the pure sampler: top_k masks everything below the k-th logit
+    row = np.array([0.1, 2.0, -1.0, 1.5, 0.0], np.float32)
+    for pos in range(32):
+        tok = sample_token(row, temperature=5.0, top_k=2, seed=9,
+                           position=pos)
+        assert tok in (1, 3), tok
+
+
+def test_temperature_zero_and_topk1_match_greedy():
+    eng = _engine()
+    try:
+        greedy = eng.generate([5, 2], max_new_tokens=4)
+        t0 = eng.generate([5, 2], max_new_tokens=4, temperature=0.0,
+                          seed=77)
+        k1 = eng.generate([5, 2], max_new_tokens=4, temperature=2.0,
+                          top_k=1, seed=77)
+        assert t0["tokens"] == greedy["tokens"]
+        assert k1["tokens"] == greedy["tokens"]
+        with pytest.raises(ValueError, match="temperature"):
+            eng.submit([1], max_new_tokens=2, temperature=-0.5)
+        with pytest.raises(ValueError, match="top_k"):
+            eng.submit([1], max_new_tokens=2, top_k=-1)
+    finally:
+        eng.stop()
+
+
+def test_sampling_rpc_roundtrip(decode_server):
+    """Sampling params thread through generate on the wire; the result
+    is deterministic given the seed, so a retransmitted frame answered
+    from the dedup cache equals what a re-decode would have produced."""
+    srv, cli, _addr = decode_server
+    out1 = cli.generate("gen", [3, 1], max_new_tokens=4, temperature=0.8,
+                        top_k=4, seed=42)
+    out2 = cli.generate("gen", [3, 1], max_new_tokens=4, temperature=0.8,
+                        top_k=4, seed=42)
+    assert out1["tokens"] == out2["tokens"] and len(out1["tokens"]) == 4
+    with pytest.raises(ValueError, match="temperature"):
+        cli.generate("gen", [1], max_new_tokens=2, temperature=-1.0)
 
 
 def test_continuous_beats_drain_by_exact_step_count():

@@ -23,7 +23,11 @@ locally" and "works in CI" are the same claim:
   3. `python -m paddle_tpu.serving --selftest`    (in-process serving
                                                    smoke: bucketed batch,
                                                    hot-swap, overload)
-  4. `python -m pytest tests/ --collect-only -q`  (imports every test
+  4. `python -m paddle_tpu.autotune --selftest`   (tuning cache, ladder
+                                                   derivation, measure-
+                                                   or-model, routing
+                                                   read-through)
+  5. `python -m pytest tests/ --collect-only -q`  (imports every test
                                                    module under
                                                    --strict-markers: a
                                                    bad import or an
@@ -80,6 +84,8 @@ def main(argv=None) -> int:
     rc |= _run("static analysis", analysis_cmd)
     rc |= _run("serving selftest",
                [py, "-m", "paddle_tpu.serving", "--selftest"])
+    rc |= _run("autotune selftest",
+               [py, "-m", "paddle_tpu.autotune", "--selftest"])
     rc |= _run("pytest collect smoke",
                [py, "-m", "pytest", "tests/", "--collect-only", "-q",
                 "-p", "no:cacheprovider"])
