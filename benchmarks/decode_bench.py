@@ -1,10 +1,11 @@
 """Decode-serving benchmark: tokens/s for three decode strategies over
 the SAME seeded toy decoder and the SAME mixed-length workload
-(ISSUE 6 acceptance evidence -> BENCH_SESSION_r07.json):
+(ISSUE 6 evidence -> BENCH_SESSION_r07.json), plus the chunked-prefill
+long-prompt section (ISSUE 10 -> BENCH_SESSION_r08.json):
 
   continuous — DecodeEngine(continuous=True): paged KV cache, new
                sequences admitted into in-flight decode steps as slots
-               free (the tentpole).
+               free (the PR 6 tentpole).
   drain      — DecodeEngine(continuous=False): same engine, same
                compiled shapes, but a batch must fully complete before
                the next is admitted — finished slots idle behind the
@@ -15,20 +16,36 @@ the SAME seeded toy decoder and the SAME mixed-length workload
                strawman is not ALSO compile-bound — it loses on
                recompute alone, which is the honest comparison).
 
-The workload is submitted as one burst (every strategy sees the
-identical queue), wall time runs from first submit to last completion,
-and tokens/s counts GENERATED tokens only. The framework_metrics
-snapshot rides the evidence (decode step counts, occupancy histogram,
-KV pool gauges), per benchmarks/_timing.py convention.
+Long-prompt section (prompts DEC_LP_PROMPT_MIN..MAX, default 32-256 —
+the lengths where one-token-per-step prefill is unacceptable):
+
+  chunked    — prefill_chunk = DEC_LP_CHUNK (default 16): a P-token
+               prompt prefills in ceil(P/chunk) steps.
+  unchunked  — prefill_chunk = 1: bitwise the PR 6 schedule, P steps.
+
+Both rows report **steps-to-first-token** (mean/max over requests) —
+the load-independent evidence, like PR 6's step counts: wall clocks
+swing with host load on a contended box, scheduler step counts don't.
+The chunked/unchunked sttf ratio is the headline (target >= 4x at
+these lengths). The observed prompt-length histogram rides the
+evidence and — with PADDLE_TPU_AUTOTUNE_DIR set — seeds the
+``prefill_chunk`` tuner: a measure-or-model session times the chunk
+candidates on this device kind and persists the winner where
+``fluid.flags.effective_flag("prefill_chunk")`` reads it.
 
 Env knobs:
-    DEC_REQUESTS    workload size              (default 48; smoke 16)
-    DEC_SLOTS       slot ladder                (default "1,2,4")
-    DEC_PAGE        KV page size               (default 4)
-    DEC_MAXSEQ      per-sequence token cap     (default 32; smoke 16)
-    DEC_PROMPT_MAX  max prompt length          (default 8; smoke 4)
-    DEC_NEW_MAX     max generated per request  (default 16; smoke 8)
-    --smoke         tiny fixed run for CI's slow lane
+    DEC_REQUESTS       short-mix workload size    (default 48; smoke 16)
+    DEC_SLOTS          slot ladder                (default "1,2,4")
+    DEC_PAGE           KV page size               (default 4)
+    DEC_MAXSEQ         short-mix token cap        (default 32; smoke 16)
+    DEC_PROMPT_MAX     short-mix max prompt       (default 8; smoke 4)
+    DEC_NEW_MAX        short-mix max generated    (default 16; smoke 8)
+    DEC_LP_REQUESTS    long-prompt workload size  (default 6; smoke 3)
+    DEC_LP_PROMPT_MIN  long-prompt min length     (default 32; smoke 12)
+    DEC_LP_PROMPT_MAX  long-prompt max length     (default 256; smoke 24)
+    DEC_LP_NEW         tokens generated per long request (default 4)
+    DEC_LP_CHUNK       prefill chunk for the chunked row  (default 16)
+    --smoke            tiny fixed run for CI's slow lane
 """
 import json
 import math
@@ -49,9 +66,19 @@ PAGE = int(os.environ.get("DEC_PAGE", "4"))
 MAXSEQ = int(os.environ.get("DEC_MAXSEQ", "16" if SMOKE else "32"))
 PROMPT_MAX = int(os.environ.get("DEC_PROMPT_MAX", "4" if SMOKE else "8"))
 NEW_MAX = int(os.environ.get("DEC_NEW_MAX", "8" if SMOKE else "16"))
+LP_REQUESTS = int(os.environ.get("DEC_LP_REQUESTS", "3" if SMOKE else "6"))
+LP_PROMPT_MIN = int(os.environ.get("DEC_LP_PROMPT_MIN",
+                                   "12" if SMOKE else "32"))
+LP_PROMPT_MAX = int(os.environ.get("DEC_LP_PROMPT_MAX",
+                                   "24" if SMOKE else "256"))
+LP_NEW = int(os.environ.get("DEC_LP_NEW", "2" if SMOKE else "4"))
+LP_CHUNK = int(os.environ.get("DEC_LP_CHUNK", "4" if SMOKE else "16"))
 if PROMPT_MAX >= MAXSEQ:
     sys.exit(f"DEC_PROMPT_MAX ({PROMPT_MAX}) must be < DEC_MAXSEQ "
              f"({MAXSEQ}): every sequence needs room for >= 1 new token")
+if LP_PROMPT_MIN > LP_PROMPT_MAX:
+    sys.exit(f"DEC_LP_PROMPT_MIN ({LP_PROMPT_MIN}) must be <= "
+             f"DEC_LP_PROMPT_MAX ({LP_PROMPT_MAX})")
 
 
 def _workload(seed=0):
@@ -62,6 +89,20 @@ def _workload(seed=0):
         max_new = 1 + int(rng.randint(min(NEW_MAX, MAXSEQ - plen)))
         out.append((rng.randint(0, 32, size=plen).astype(np.int32),
                     max_new))
+    return out
+
+
+def _long_workload(seed=1):
+    """The chunked-prefill workload: prompts uniform in
+    [LP_PROMPT_MIN, LP_PROMPT_MAX] — real lengths, where time-to-first-
+    token is the number that matters."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(LP_REQUESTS):
+        plen = LP_PROMPT_MIN + int(rng.randint(
+            LP_PROMPT_MAX - LP_PROMPT_MIN + 1))
+        out.append((rng.randint(0, 32, size=plen).astype(np.int32),
+                    LP_NEW))
     return out
 
 
@@ -80,16 +121,20 @@ def _occupancy():
     return float(o.get("sum", 0.0)), int(o.get("count", 0))
 
 
-def run_engine(spec, workload, continuous):
+def run_engine(spec, workload, continuous, *, name, max_seq_len,
+               prefill_chunk=None, slots=None):
     from paddle_tpu.serving import DecodeEngine
 
     # pool sized for the whole burst: pages are reserved at admission
     pages = 1 + sum(-(-(len(p) + n) // PAGE) for p, n in workload)
     names = ("serving.decode.steps", "serving.decode.compiles",
-             "serving.decode.completions", "serving.decode.tokens")
-    eng = DecodeEngine(spec, name="bench", slots=SLOTS, page_size=PAGE,
-                       num_pages=pages, max_seq_len=MAXSEQ,
-                       max_queue=len(workload) + 1, continuous=continuous)
+             "serving.decode.completions", "serving.decode.tokens",
+             "serving.decode.prefill_tokens")
+    eng = DecodeEngine(spec, name=name, slots=slots or SLOTS,
+                       page_size=PAGE, num_pages=pages,
+                       max_seq_len=max_seq_len,
+                       max_queue=len(workload) + 1, continuous=continuous,
+                       prefill_chunk=prefill_chunk)
     try:
         before = _counters(*names)
         occ_sum0, occ_n0 = _occupancy()
@@ -103,18 +148,26 @@ def run_engine(spec, workload, continuous):
         toks = after["serving.decode.tokens"] - \
             before["serving.decode.tokens"]
         occ_sum1, occ_n1 = _occupancy()
+        sttf = [int(r.result["steps_to_first_token"]) for r in reqs]
         return {
             "mode": "continuous" if continuous else "drain",
+            "prefill_chunk": eng.prefill_chunk,
             "wall_s": round(wall, 3),
             "generated_tokens": int(toks),
             "tokens_per_s": round(toks / wall, 2),
             "decode_steps": after["serving.decode.steps"]
             - before["serving.decode.steps"],
+            "prefill_tokens": after["serving.decode.prefill_tokens"]
+            - before["serving.decode.prefill_tokens"],
+            # scheduler steps from admission to each request's FIRST
+            # generated token — the load-independent chunking evidence
+            "steps_to_first_token_mean": round(float(np.mean(sttf)), 2),
+            "steps_to_first_token_max": int(max(sttf)),
             # `before` is captured after the constructor's warm(), so
             # this delta is exactly the churn's new compiles (target: 0)
             "post_warm_compiles": after["serving.decode.compiles"]
             - before["serving.decode.compiles"],
-            "warmed_shapes": sorted(eng._compiled_shapes),
+            "warmed_shapes": eng.stats()["compiled_shapes"],
             "occupancy_mean": round((occ_sum1 - occ_sum0)
                                     / max(occ_n1 - occ_n0, 1), 3),
             "kv": eng.cache.allocator.stats(),
@@ -204,7 +257,59 @@ def run_reprefill(spec, workload):
     }
 
 
+def tune_prefill_chunk(spec, candidates, prompt_len):
+    """Measure-or-model session for the ``prefill_chunk`` crossover
+    (ISSUE 10 / PR 8): time prefilling one ``prompt_len``-token
+    sequence at each candidate chunk — ``ceil(P/c)`` jitted chunked
+    steps — and persist the winner under this DEVICE KIND where
+    ``effective_flag("prefill_chunk")`` reads it. A repeat session
+    with the same cache answers from it with zero timed runs
+    (``autotune.measurements`` delta 0, same as PR 8's loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import autotune
+    from paddle_tpu.serving.decode import (build_decoder_params,
+                                           decoder_step_chunked)
+
+    params = build_decoder_params(spec)
+    n_pages = 2 + (-(-prompt_len // PAGE))
+    width = n_pages - 1
+    pool_shape = (spec.n_layers, n_pages, PAGE, spec.n_kv_heads,
+                  spec.head_dim)
+    table = np.arange(1, width + 1, dtype=np.int32)[None, :]
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, spec.vocab, size=prompt_len).astype(np.int32)
+
+    jitted = jax.jit(lambda p, t, pos, ql, k, v, tab, kl:
+                     decoder_step_chunked(p, spec, t, pos, ql, k, v,
+                                          tab, kl))
+
+    def runner(chunk):
+        c = int(chunk)
+        k = jnp.zeros(pool_shape, jnp.float32)
+        v = jnp.zeros(pool_shape, jnp.float32)
+        pos = 0
+        while pos < prompt_len:
+            g = min(c, prompt_len - pos)
+            toks = np.zeros((1, c), np.int32)
+            poss = np.zeros((1, c), np.int32)
+            toks[0, :g] = prompt[pos:pos + g]
+            poss[0, :g] = np.arange(pos, pos + g)
+            k, v, logits = jitted(
+                params, toks, poss, np.array([g], np.int32), k, v,
+                table, np.array([pos + g], np.int32))
+            pos += g
+        np.asarray(logits)  # materialize: the one honest barrier
+
+    best, evidence = autotune.measure_or_model(
+        "prefill_chunk", [int(c) for c in candidates], runner=runner,
+        k=3)
+    return {"best": int(best), **evidence}
+
+
 def main() -> int:
+    from paddle_tpu import autotune
     from paddle_tpu.serving import DecoderSpec
 
     spec = DecoderSpec(vocab=32, d_model=16, n_layers=2, n_heads=2,
@@ -212,21 +317,48 @@ def main() -> int:
     workload = _workload()
     rows = {}
     for continuous in (False, True):
-        rows["continuous" if continuous else "drain"] = run_engine(
-            spec, workload, continuous)
+        mode = "continuous" if continuous else "drain"
+        rows[mode] = run_engine(spec, workload, continuous,
+                                name=f"bench_{mode}", max_seq_len=MAXSEQ)
     rows["reprefill"] = run_reprefill(spec, workload)
     cont, drain, straw = (rows["continuous"], rows["drain"],
                           rows["reprefill"])
-    # tuner input (ISSUE 8): the slot-demand histogram the engines'
-    # submit paths observed, plus any ladder derived/persisted from it
-    # (set PADDLE_TPU_AUTOTUNE_DIR to seed a future slots="auto" load)
-    from paddle_tpu import autotune
 
+    # long-prompt section (ISSUE 10): same seeded workload through a
+    # chunked and an unchunked engine — steps-to-first-token is the
+    # headline, and it is a pure scheduler-shape number
+    long_wl = _long_workload()
+    lp_maxseq = LP_PROMPT_MAX + LP_NEW
+    lp_rows = {
+        "chunked": run_engine(spec, long_wl, True, name="bench_lp_chunked",
+                              max_seq_len=lp_maxseq,
+                              prefill_chunk=LP_CHUNK),
+        "unchunked": run_engine(spec, long_wl, True,
+                                name="bench_lp_unchunked",
+                                max_seq_len=lp_maxseq, prefill_chunk=1),
+    }
+    sttf_speedup = (lp_rows["unchunked"]["steps_to_first_token_mean"]
+                    / max(lp_rows["chunked"]["steps_to_first_token_mean"],
+                          1e-9))
+
+    # the measured crossover for THIS device kind (persisted when
+    # PADDLE_TPU_AUTOTUNE_DIR is set; a warm cache answers with zero
+    # timed runs)
+    chunk_tuning = tune_prefill_chunk(
+        spec, candidates=[1, LP_CHUNK // 2 or 1, LP_CHUNK, 2 * LP_CHUNK],
+        prompt_len=min(LP_PROMPT_MAX, 64))
+
+    # tuner input (ISSUE 8/10): the slot-demand and prompt-length
+    # histograms the submit paths observed, plus any ladder derived/
+    # persisted from them (set PADDLE_TPU_AUTOTUNE_DIR to seed a
+    # future slots="auto" load and the prefill_chunk crossover)
     shape_hist = autotune.histograms()
     derived = autotune.seed_cache_from_observed()
     evidence = {
         "what": "decode_bench: continuous batching vs drain-per-batch vs "
-                "re-prefill-per-token, identical workload + decoder",
+                "re-prefill-per-token, identical workload + decoder; "
+                "chunked-prefill long-prompt section (steps-to-first-"
+                "token, ISSUE 10)",
         "smoke": SMOKE,
         "spec": spec.to_dict(),
         "requests": REQUESTS,
@@ -240,6 +372,16 @@ def main() -> int:
             cont["tokens_per_s"] / max(drain["tokens_per_s"], 1e-9), 3),
         "speedup_continuous_vs_reprefill": round(
             cont["tokens_per_s"] / max(straw["tokens_per_s"], 1e-9), 3),
+        "long_prompt": {
+            "requests": LP_REQUESTS,
+            "prompt_min": LP_PROMPT_MIN,
+            "prompt_max": LP_PROMPT_MAX,
+            "max_new": LP_NEW,
+            "prefill_chunk": LP_CHUNK,
+            "results": lp_rows,
+            "steps_to_first_token_speedup": round(sttf_speedup, 2),
+        },
+        "prefill_chunk_tuning": chunk_tuning,
         "shape_histogram": shape_hist,
         "derived_ladders": derived,
         "framework_metrics": framework_metrics(),

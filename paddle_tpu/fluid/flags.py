@@ -165,6 +165,15 @@ FLAGS: Dict[str, Any] = _Flags({
     # page-table width ladder (ceil(max_seq_len / kv_page_size) is the
     # widest compiled table)
     "decode_max_seq_len": 128,
+    # chunked prefill (ISSUE 10): per-step prompt-token budget AND the
+    # compiled chunk width of the mixed decode step — a P-token prompt
+    # completes prefill in ceil(P/prefill_chunk) steps instead of P.
+    # 16 (= one kv_page_size of tokens per step) is the hand-set cold
+    # default; the autotune cache overrides it per device kind
+    # (DecodeEngine reads it through effective_flag; decode_bench's
+    # measure-or-model session seeds measured values). 1 = chunking
+    # off (bitwise the PR 6 one-token-per-step behavior)
+    "prefill_chunk": 16,
 })
 
 

@@ -1,5 +1,5 @@
-"""Ragged paged attention for single-token decode (PAPERS.md: Ragged
-Paged Attention).
+"""Ragged paged attention for decode serving (PAPERS.md: Ragged Paged
+Attention) — single-token decode AND multi-token prefill chunks.
 
 The decode-serving shape problem: each live sequence has a different KV
 length that grows every step. Dense batched attention would need either
@@ -10,15 +10,29 @@ reads them THROUGH per-sequence page tables, so one compiled shape —
 ``[slots, table_width]`` — serves every ragged length mix up to
 ``table_width * page_size`` tokens.
 
-Layouts (one query token per sequence — the decode step):
+Chunked prefill (ISSUE 10) adds the second ragged axis: a slot may
+carry a CHUNK of ``q_len ∈ [0, C]`` query tokens (a slice of its
+prompt) instead of exactly one, attending causally within the chunk —
+query ``j`` of the chunk sees keys up to absolute position
+``kv_len - q_len + j``. One compiled ``[slots, C, ...]`` shape then
+serves every mix of prefill chunks and single-token decode slots
+(Sarathi-style mixed batches; serving/decode.py packs them).
 
-    q            [B, Hq, D]            this step's query per slot
+Layouts:
+
+    q            [B, Hq, D]            single token per slot, OR
+                 [B, C, Hq, D]         a chunk of C query tokens/slot
+    q_lens       [B] int32             valid query tokens per slot
+                                       (chunked form only; 0 = dead)
     k/v_pages    [P, page_size, Hkv, D]   the shared page pool
     page_tables  [B, W] int32          page ids per slot, GARBAGE-padded
-    kv_lens      [B] int32             valid keys per slot (0 = dead)
+    kv_lens      [B] int32             valid keys per slot INCLUDING
+                                       this call's q_len tokens
 
 GQA: ``Hq % Hkv == 0``; query head h attends kv head ``h // (Hq/Hkv)``.
-Dead slots (kv_lens == 0) produce exact zeros.
+Dead slots (q_lens == 0, or kv_lens == 0 in the single-token form)
+produce exact zeros; so do dead query lanes ``j >= q_len`` of a live
+slot.
 
 Two implementations with IDENTICAL semantics (A/B-tested against each
 other and against the flash kernel's dense path in
@@ -27,11 +41,16 @@ tests/test_decode_serving.py):
   - ``paged_attention_reference`` — pure-jax gather (k_pages[tables]):
     the CPU path tier-1 exercises, and the numerics oracle.
   - ``_paged_attention_pallas`` — a Pallas TPU kernel on grid
-    ``(B, W)`` with the page table as a SCALAR-PREFETCH operand: the
-    BlockSpec index_map reads ``tables[b, w]`` so the pipeline DMAs
-    exactly the pages each sequence owns, page by page, with an online
-    softmax across pages (flash-attention style running max/sum) —
-    the [B, W*page_size] score matrix never materializes.
+    ``(B, W)`` with the page table (and both length vectors) as
+    SCALAR-PREFETCH operands: the BlockSpec index_map reads
+    ``tables[b, w]`` so the pipeline DMAs exactly the pages each
+    sequence owns, page by page, with an online softmax across pages
+    (flash-attention style running max/sum) — the [B, C, W*page_size]
+    score tensor never materializes.
+
+The single-token form is exactly the chunked form at C=1 with
+``q_len = (kv_len > 0)`` — both implementations canonicalize to the
+chunked layout internally, so the two forms cannot drift.
 
 ``paged_attention`` routes between them via flags (the same
 ``use_pallas_kernels`` surface that routes flash attention) plus a
@@ -67,8 +86,13 @@ _m_route_kernel = _metrics.counter("attention.route.paged_kernel")
 _m_route_ref = _metrics.counter("attention.route.paged_reference")
 
 
-def _check_shapes(q, k_pages, v_pages, page_tables, kv_lens):
-    b, hq, d = q.shape
+def _check_shapes(q, k_pages, v_pages, page_tables, kv_lens, q_lens):
+    if q.ndim not in (3, 4):
+        raise ValueError(f"q must be [B, Hq, D] or [B, C, Hq, D], got "
+                         f"{q.shape}")
+    b = q.shape[0]
+    c = q.shape[1] if q.ndim == 4 else 1
+    hq, d = q.shape[-2], q.shape[-1]
     p, ps, hkv, d2 = k_pages.shape
     if v_pages.shape != k_pages.shape:
         raise ValueError(f"k_pages {k_pages.shape} != v_pages "
@@ -83,15 +107,36 @@ def _check_shapes(q, k_pages, v_pages, page_tables, kv_lens):
                          f"batch {b}")
     if kv_lens.shape != (b,):
         raise ValueError(f"kv_lens {kv_lens.shape} != ({b},)")
-    return b, hq, d, ps, hkv, page_tables.shape[1]
+    if q.ndim == 4:
+        if q_lens is None:
+            raise ValueError("chunked q [B, C, Hq, D] requires q_lens")
+        if q_lens.shape != (b,):
+            raise ValueError(f"q_lens {q_lens.shape} != ({b},)")
+    elif q_lens is not None:
+        raise ValueError("q_lens only applies to chunked q [B, C, Hq, D]")
+    return b, c, hq, d, ps, hkv, page_tables.shape[1]
+
+
+def _canon_chunked(q, kv_lens, q_lens):
+    """Canonicalize both call forms to (q [B, C, Hq, D], q_lens [B]):
+    the single-token form is C=1 with one valid query iff the slot is
+    live (kv_len > 0) — the PR 6 dead-slot convention."""
+    if q.ndim == 3:
+        q = q[:, None]
+        q_lens = (kv_lens > 0).astype(jnp.int32)
+    return q, q_lens
 
 
 def paged_attention_reference(q, k_pages, v_pages, page_tables, kv_lens,
-                              *, scale: Optional[float] = None):
-    """Pure-jax oracle: gather the pages, mask past each sequence's
-    length, dense softmax. Same signature/semantics as the kernel."""
-    b, hq, d, ps, hkv, w = _check_shapes(q, k_pages, v_pages, page_tables,
-                                         kv_lens)
+                              *, q_lens=None,
+                              scale: Optional[float] = None):
+    """Pure-jax oracle: gather the pages, mask causally past each
+    query's visibility limit, dense softmax. Same signature/semantics
+    as the kernel. Returns the same rank as ``q``."""
+    b, c, hq, d, ps, hkv, w = _check_shapes(q, k_pages, v_pages,
+                                            page_tables, kv_lens, q_lens)
+    squeeze = q.ndim == 3
+    q, q_lens = _canon_chunked(q, kv_lens, q_lens)
     scale = float(scale) if scale else d ** -0.5
     rep = hq // hkv
     # [B, W, ps, Hkv, D] -> [B, T, Hkv, D], T = W * ps
@@ -101,22 +146,33 @@ def paged_attention_reference(q, k_pages, v_pages, page_tables, kv_lens,
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     qf = q.astype(jnp.float32) * scale
-    s = jnp.einsum("bhd,bthd->bht", qf, k.astype(jnp.float32))
-    keep = (jnp.arange(w * ps)[None, :] < kv_lens[:, None])[:, None, :]
+    s = jnp.einsum("bchd,bthd->bcht", qf, k.astype(jnp.float32))
+    # chunk-causal visibility: query j (absolute position
+    # kv_len - q_len + j) sees keys at positions <= its own; dead
+    # lanes (j >= q_len) see nothing -> exact-zero rows
+    lane = jnp.arange(c)[None, :]                       # [1, C]
+    limit = kv_lens[:, None] - q_lens[:, None] + lane   # [B, C]
+    valid = lane < q_lens[:, None]                      # [B, C]
+    t = jnp.arange(w * ps)[None, None, :]               # [1, 1, T]
+    keep = (t <= limit[:, :, None]) & valid[:, :, None]  # [B, C, T]
+    keep = keep[:, :, None, :]                          # [B, C, 1, T]
     s = jnp.where(keep, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m) * keep
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bht,bthd->bhd", p, v.astype(jnp.float32))
-    return (o / jnp.maximum(l, jnp.finfo(jnp.float32).tiny)).astype(q.dtype)
+    o = jnp.einsum("bcht,bthd->bchd", p, v.astype(jnp.float32))
+    o = (o / jnp.maximum(l, jnp.finfo(jnp.float32).tiny)).astype(q.dtype)
+    return o[:, 0] if squeeze else o
 
 
-def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_sc, l_sc, acc_sc, *, scale, page_size, rep):
+def _paged_kernel(tables_ref, kv_lens_ref, q_lens_ref, q_ref, k_ref,
+                  v_ref, o_ref, m_sc, l_sc, acc_sc, *, scale, page_size,
+                  rep, chunk):
     """One (sequence b, page w) grid step: fold this page's keys into
-    the running online softmax. W iterates innermost (TPU grids run
-    sequentially), so the scratch accumulators carry across a
-    sequence's pages and reset at its first."""
+    the running online softmax for every query lane of the chunk. W
+    iterates innermost (TPU grids run sequentially), so the scratch
+    accumulators carry across a sequence's pages and reset at its
+    first."""
     w = pl.program_id(1)
     nw = pl.num_programs(1)
 
@@ -127,94 +183,119 @@ def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         acc_sc[...] = jnp.zeros_like(acc_sc)
 
     b = pl.program_id(0)
-    kv_len = lens_ref[b]
-    q = q_ref[0].astype(jnp.float32) * scale          # [Hq, D]
+    kv_len = kv_lens_ref[b]
+    q_len = q_lens_ref[b]
+    q = q_ref[0].astype(jnp.float32) * scale          # [C, Hq, D]
     k = k_ref[0].astype(jnp.float32)                  # [ps, Hkv, D]
     v = v_ref[0].astype(jnp.float32)
     if rep > 1:
         k = jnp.repeat(k, rep, axis=1)                # [ps, Hq, D]
         v = jnp.repeat(v, rep, axis=1)
-    # this page covers absolute key positions [w*ps, w*ps + ps)
+    # this page covers absolute key positions [w*ps, w*ps + ps);
+    # query lane j sits at absolute position kv_len - q_len + j and
+    # sees keys at positions <= its own (chunk-causal); dead lanes
+    # (j >= q_len) see nothing
     offs = w * page_size + jax.lax.broadcasted_iota(
         jnp.int32, (1, page_size), 1)                 # [1, ps]
-    keep = offs < kv_len                              # [1, ps]
-    # s[h, p] = q[h, :] . k[p, h, :]  (head-batched matvec: the decode
-    # step is bandwidth-bound — VPU elementwise+reduce is fine)
-    s = jnp.sum(q[:, None, :] * k.transpose(1, 0, 2), axis=-1)  # [Hq, ps]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (chunk, 1), 0)  # [C, 1]
+    limit = kv_len - q_len + lane                     # [C, 1]
+    keep = (offs <= limit) & (lane < q_len)           # [C, ps]
+    keep = keep[:, None, :]                           # [C, 1, ps]
+    # s[c, h, p] = q[c, h, :] . k[p, h, :]  (head-batched matvec: the
+    # decode step is bandwidth-bound — VPU elementwise+reduce is fine)
+    s = jnp.sum(q[:, :, None, :] * k.transpose(1, 0, 2)[None],
+                axis=-1)                              # [C, Hq, ps]
     s = jnp.where(keep, s, NEG_INF)
-    m_old = m_sc[...]                                 # [Hq, 1]
-    m_new = jnp.maximum(m_old, jnp.max(s, axis=1, keepdims=True))
+    m_old = m_sc[...].reshape(chunk, q.shape[1], 1)   # [C, Hq, 1]
+    m_new = jnp.maximum(m_old, jnp.max(s, axis=2, keepdims=True))
     alpha = jnp.exp(m_old - m_new)
-    p = jnp.exp(s - m_new) * keep                     # [Hq, ps]
-    l_new = l_sc[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    pv = jnp.sum(p.transpose(1, 0)[:, :, None] * v, axis=0)  # [Hq, D]
-    m_sc[...] = m_new
-    l_sc[...] = l_new
-    acc_sc[...] = acc_sc[...] * alpha + pv
+    p = jnp.exp(s - m_new) * keep                     # [C, Hq, ps]
+    l_old = l_sc[...].reshape(chunk, q.shape[1], 1)
+    l_new = l_old * alpha + jnp.sum(p, axis=2, keepdims=True)
+    # pv[c, h, d] = sum_p p[c, h, p] * v[p, h, d]
+    pv = jnp.sum(p[:, :, :, None] * v.transpose(1, 0, 2)[None],
+                 axis=2)                              # [C, Hq, D]
+    m_sc[...] = m_new.reshape(m_sc.shape)
+    l_sc[...] = l_new.reshape(l_sc.shape)
+    acc_flat = acc_sc[...].reshape(chunk, q.shape[1], q.shape[2])
+    acc_sc[...] = (acc_flat * alpha + pv).reshape(acc_sc.shape)
 
     @pl.when(w == nw - 1)
     def _emit():
-        l = jnp.maximum(l_sc[...], jnp.finfo(jnp.float32).tiny)
-        o_ref[0] = (acc_sc[...] / l).astype(o_ref.dtype)
+        l = jnp.maximum(l_sc[...].reshape(chunk, q.shape[1], 1),
+                        jnp.finfo(jnp.float32).tiny)
+        acc = acc_sc[...].reshape(chunk, q.shape[1], q.shape[2])
+        o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
 def _paged_attention_pallas(q, k_pages, v_pages, page_tables, kv_lens,
-                            *, scale: Optional[float] = None,
+                            *, q_lens=None,
+                            scale: Optional[float] = None,
                             interpret: bool = False):
-    b, hq, d, ps, hkv, w = _check_shapes(q, k_pages, v_pages, page_tables,
-                                         kv_lens)
+    b, c, hq, d, ps, hkv, w = _check_shapes(q, k_pages, v_pages,
+                                            page_tables, kv_lens, q_lens)
+    squeeze = q.ndim == 3
+    q, q_lens = _canon_chunked(q, kv_lens, q_lens)
     scale = float(scale) if scale else d ** -0.5
     rep = hq // hkv
     tables = page_tables.astype(jnp.int32)
-    lens = kv_lens.astype(jnp.int32)
+    kv_l = kv_lens.astype(jnp.int32)
+    q_l = q_lens.astype(jnp.int32)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,   # page_tables, kv_lens ride in SMEM
+        num_scalar_prefetch=3,   # page_tables, kv_lens, q_lens in SMEM
         grid=(b, w),
         in_specs=[
-            pl.BlockSpec((1, hq, d), lambda bb, ww, t, n: (bb, 0, 0)),
+            pl.BlockSpec((1, c, hq, d), lambda bb, ww, t, n, m: (bb, 0, 0,
+                                                                 0)),
             # THE paged read: the index map picks each sequence's w-th
             # page out of the pool, so the pipeline DMAs only owned
             # pages (garbage-padded entries fetch page 0, fully masked)
             pl.BlockSpec((1, ps, hkv, d),
-                         lambda bb, ww, t, n: (t[bb, ww], 0, 0, 0)),
+                         lambda bb, ww, t, n, m: (t[bb, ww], 0, 0, 0)),
             pl.BlockSpec((1, ps, hkv, d),
-                         lambda bb, ww, t, n: (t[bb, ww], 0, 0, 0)),
+                         lambda bb, ww, t, n, m: (t[bb, ww], 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, hq, d), lambda bb, ww, t, n: (bb, 0, 0)),
+        out_specs=pl.BlockSpec((1, c, hq, d),
+                               lambda bb, ww, t, n, m: (bb, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((hq, 1), jnp.float32),   # running max
-            pltpu.VMEM((hq, 1), jnp.float32),   # running sum
-            pltpu.VMEM((hq, d), jnp.float32),   # output accumulator
+            pltpu.VMEM((c * hq, 1), jnp.float32),   # running max
+            pltpu.VMEM((c * hq, 1), jnp.float32),   # running sum
+            pltpu.VMEM((c * hq, d), jnp.float32),   # output accumulator
         ],
     )
     kernel = functools.partial(_paged_kernel, scale=scale, page_size=ps,
-                               rep=rep)
-    return pl.pallas_call(
+                               rep=rep, chunk=c)
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, c, hq, d), q.dtype),
         interpret=interpret,
-    )(tables, lens, q, k_pages, v_pages)
+    )(tables, kv_l, q_l, q, k_pages, v_pages)
+    return out[:, 0] if squeeze else out
 
 
 def paged_attention(q, k_pages, v_pages, page_tables, kv_lens,
-                    *, scale: Optional[float] = None,
+                    *, q_lens=None, scale: Optional[float] = None,
                     interpret: Optional[bool] = None):
     """Route between the Pallas kernel (TPU, or forced via
     ``use_pallas_kernels=True`` in interpret mode for tests) and the
     pure-jax reference — the same flags surface flash attention uses
     (fluid/ops/attention_ops.py), with the ``paged_min_slots``
     crossover read through the autotune cache per device kind (the
-    hard-coded always-kernel answer survives as the cold default)."""
+    hard-coded always-kernel answer survives as the cold default).
+    ``q`` may be ``[B, Hq, D]`` (one token per slot) or
+    ``[B, C, Hq, D]`` with ``q_lens`` (a prefill chunk per slot,
+    causal within the chunk)."""
     from ...flags import effective_flag, pallas_enabled, pallas_interpret
 
     if pallas_enabled() and \
             q.shape[0] >= int(effective_flag("paged_min_slots")):
         _m_route_kernel.inc()
         return _paged_attention_pallas(
-            q, k_pages, v_pages, page_tables, kv_lens, scale=scale,
+            q, k_pages, v_pages, page_tables, kv_lens, q_lens=q_lens,
+            scale=scale,
             interpret=pallas_interpret() if interpret is None
             else interpret)
     _m_route_ref.inc()
     return paged_attention_reference(q, k_pages, v_pages, page_tables,
-                                     kv_lens, scale=scale)
+                                     kv_lens, q_lens=q_lens, scale=scale)

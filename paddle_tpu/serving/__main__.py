@@ -183,8 +183,9 @@ def run_selftest(verbose: bool = True) -> int:
                             num_pages=24, max_seq_len=8)
         try:
             n_shapes = (len(deng.slot_ladder)
-                        * len(deng.table_width_ladder))
-            check(len(deng._compiled_shapes) == n_shapes,
+                        * len(deng.table_width_ladder)
+                        * len(deng.chunk_ladder))
+            check(len(deng.stats()["compiled_shapes"]) == n_shapes,
                   f"decode warm compiled the full ladder ({n_shapes} "
                   "shapes)")
             dc = _metrics.counter("serving.decode.compiles")
@@ -212,6 +213,50 @@ def run_selftest(verbose: bool = True) -> int:
                 deng.cache.allocator.free(9999)
         finally:
             deng.stop()
+
+        # -- 4. chunked prefill (ISSUE 10): token-budget mixed steps ----
+        ceng = DecodeEngine(spec, name="chunked", slots=[2], page_size=4,
+                            num_pages=24, max_seq_len=20,
+                            prefill_chunk=4)
+        try:
+            steps = _metrics.counter("serving.decode.steps")
+            base = steps.value()
+            prompt = list(range(12))
+            out = ceng.generate(prompt, max_new_tokens=3)
+            # steps-to-first-token bound: ceil(12/4) = 3, not 12
+            check(out["steps_to_first_token"] == 3,
+                  f"12-token prompt prefilled in "
+                  f"{out['steps_to_first_token']} steps (== ceil(12/4))")
+            check(steps.value() - base == 3 + 2,
+                  "total steps = ceil(P/chunk) + (new - 1)")
+            # mixed step: a decoding sequence co-rides a fresh prompt's
+            # prefill chunks and never stalls behind them
+            a = ceng.submit([5], max_new_tokens=6)
+            b = ceng.submit(prompt, max_new_tokens=2)
+            ok = a.ev.wait(120) and b.ev.wait(120) and \
+                a.error is None and b.error is None
+            check(ok and len(a.result["tokens"]) == 6
+                  and len(b.result["tokens"]) == 2,
+                  "mixed prefill+decode step completed both sequences")
+            check(_metrics.counter(
+                      "serving.decode.prefill_tokens").value() > 0,
+                  "prefill token budget accounted "
+                  "(serving.decode.prefill_tokens)")
+            # chunking is engine-internal: greedy tokens identical with
+            # chunking off (the PR 6 one-token-per-step behavior)
+            ueng = DecodeEngine(spec, name="unchunked", slots=[2],
+                                page_size=4, num_pages=24,
+                                max_seq_len=20, prefill_chunk=1)
+            try:
+                u = ueng.generate(prompt, max_new_tokens=3)
+                check(u["tokens"] == out["tokens"]
+                      and u["steps_to_first_token"] == 12,
+                      "greedy tokens identical with chunking on vs off "
+                      "(12 steps unchunked, 3 chunked)")
+            finally:
+                ueng.stop()
+        finally:
+            ceng.stop()
 
         # decode over RPC with a hot-swap
         srv2 = ServingServer()

@@ -91,14 +91,19 @@ class ServingClient:
                      page_size: Optional[int] = None,
                      num_pages: Optional[int] = None,
                      max_seq_len: Optional[int] = None,
-                     max_queue: Optional[int] = None) -> Dict[str, Any]:
+                     max_queue: Optional[int] = None,
+                     prefill_chunk: Optional[int] = None
+                     ) -> Dict[str, Any]:
         """Deploy a DecodeEngine from an architecture/seed spec dict
-        (see serving.decode.DecoderSpec); hot-swaps like load_model."""
+        (see serving.decode.DecoderSpec); hot-swaps like load_model.
+        ``prefill_chunk`` pins the chunked-prefill token budget (None =
+        the server resolves it through its autotune cache/FLAGS)."""
         try:
             return self._rpc.call(
                 "load_decoder", model, dict(spec), version,
                 _ladder_arg(slots),
-                page_size, num_pages, max_seq_len, max_queue)
+                page_size, num_pages, max_seq_len, max_queue,
+                None if prefill_chunk is None else int(prefill_chunk))
         except RuntimeError as e:
             _raise_typed(e)
 

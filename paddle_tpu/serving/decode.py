@@ -23,12 +23,18 @@ This engine does CONTINUOUS batching over FIXED compiled shapes:
     engine's bucket warm. After warmup a churn of admits/completions
     at ragged lengths performs ZERO new compiles (tier-1 pins the
     ``serving.decode.compiles`` counter);
-  - every step consumes ONE token per live slot: a sequence still in
-    its prompt consumes the next prompt token (prefill rides the same
-    compiled step — no separate prefill graph), a sequence past it
-    consumes its previously sampled token. New sequences are admitted
-    into free slots BETWEEN steps, mid-flight of everyone else —
-    admission never waits for a batch boundary;
+  - every step consumes up to ``prefill_chunk`` PROMPT tokens plus one
+    generated token per decoding slot (ISSUE 10, chunked prefill):
+    sequences still in their prompt are granted chunks of it — causal
+    within the chunk, all slots sharing a per-step token BUDGET of
+    ``prefill_chunk`` prompt tokens — while sequences past their
+    prompt consume their previously sampled token, all in the SAME
+    compiled mixed batch (Sarathi-style). A P-token prompt completes
+    prefill in ``ceil(P / prefill_chunk)`` steps instead of P, so
+    time-to-first-token stops being linear in prompt length, and
+    in-flight decodes never stall behind a long prompt. New sequences
+    are admitted into free slots BETWEEN steps, mid-flight of everyone
+    else — admission never waits for a batch boundary;
   - K/V live in the preallocated paged pool (kv_cache.py): HBM is
     bounded at construction, pages are reserved at admission (refusal
     is an immediate structured ``ServerOverloaded``) and recycled at
@@ -66,7 +72,8 @@ from .errors import (DeadlineExceeded, EngineRetired, RequestTooLarge,
 from .kv_cache import GARBAGE_PAGE, PagedKvCache
 
 __all__ = ["DecoderSpec", "DecodeEngine", "build_decoder_params",
-           "decoder_step", "width_ladder", "sample_token"]
+           "decoder_step", "decoder_step_chunked", "width_ladder",
+           "sample_token"]
 
 _log = get_logger("serving")
 
@@ -87,6 +94,17 @@ _m_total = _metrics.histogram("serving.decode.total_ms")
 # live slots / slot bucket per step: the continuous-batching win is
 # this histogram staying fat while drain-per-batch's decays
 _m_occupancy = _metrics.histogram("serving.decode.occupancy")
+# chunked prefill (ISSUE 10): prompt tokens consumed via prefill
+# grants, per-step grant totals (prices the token-budget policy next
+# to the occupancy/fragmentation gauges), and how many scheduler steps
+# each request waited for its FIRST generated token — the
+# load-independent evidence chunking exists for (ceil(P/chunk) + queue
+# wait, vs P + queue wait unchunked)
+_m_prefill_tokens = _metrics.counter("serving.decode.prefill_tokens")
+_m_prefill_per_step = _metrics.histogram(
+    "serving.decode.prefill_tokens_per_step")
+_m_first_token_steps = _metrics.histogram(
+    "serving.decode.steps_to_first_token")
 
 
 # --- the pluggable decoder model ----------------------------------------
@@ -202,47 +220,90 @@ def _pos_encoding(positions, d_model):
     return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
 
 
-def decoder_step(params, spec: DecoderSpec, tokens, positions,
-                 k_pool, v_pool, page_tables, kv_lens):
-    """ONE decode step for a fixed-slot batch. Functional: writes this
-    step's K/V into the paged pools (dead slots write the garbage
-    page), attends through the page tables, returns
+def decoder_step_chunked(params, spec: DecoderSpec, tokens, positions,
+                         q_lens, k_pool, v_pool, page_tables, kv_lens):
+    """ONE mixed decode/prefill step for a fixed-slot batch
+    (ISSUE 10). Each slot carries up to C tokens of ITS sequence — a
+    prefill chunk, a single decode token at C lane 0, or nothing —
+    attending causally within the chunk. Functional: writes every
+    valid lane's K/V into the paged pools (dead lanes and dead slots
+    write the garbage page), attends through the page tables, returns
     ``(k_pool, v_pool, logits [B, vocab])``.
 
-    tokens/positions: [B] int32 (dead slots: 0/0 with an all-garbage
-    table row). kv_lens: [B] int32 — valid keys INCLUDING this step's
-    token (0 = dead slot -> exact-zero attention output).
+    tokens/positions: [B, C] int32, lane ``j`` of slot ``i`` valid iff
+    ``j < q_lens[i]`` (invalid lanes: 0/0 — masked to the garbage
+    page, never trusted). kv_lens: [B] int32 — valid keys INCLUDING
+    this step's q_len tokens. Chunking is pure packing: the math per
+    token is identical to feeding the same tokens one step at a time
+    (the chunked-vs-unchunked greedy-equality test pins it).
+
+    Logits come back ONLY for each slot's newest lane (``q_len - 1``)
+    — the one position the scheduler ever samples from (a chunk that
+    doesn't finish its prompt uses no logits at all). Unembedding is
+    the widest matmul of the step: unembedding all C lanes would waste
+    ~(C-1)/C of it plus a C-times-larger device->host transfer on
+    every prefill step.
     """
     import jax
     import jax.numpy as jnp
 
     from ..fluid.ops.pallas_kernels.paged_attention import paged_attention
 
-    b = tokens.shape[0]
+    b, c = tokens.shape
     ps = k_pool.shape[2]
     dm, dh = spec.d_model, spec.head_dim
+    lane = jnp.arange(c)[None, :]                      # [1, C]
+    valid = lane < q_lens[:, None]                     # [B, C]
     x = params["tok_emb"][tokens] * math.sqrt(dm) + \
-        _pos_encoding(positions, dm)
+        _pos_encoding(positions.reshape(-1), dm).reshape(b, c, dm)
     page_idx = positions // ps
-    # each slot's physical page for this token: its table row at the
-    # token's page index (garbage rows resolve to the garbage page)
-    page = jnp.take_along_axis(page_tables, page_idx[:, None], axis=1)[:, 0]
-    off = positions % ps
+    # each lane's physical page: its slot's table row at the token's
+    # page index. Invalid lanes (j >= q_len, padded dead slots) are
+    # FORCED to the garbage page — a live slot's row 0 must never be
+    # clobbered by a dead lane's position-0 write
+    page = jnp.where(valid,
+                     jnp.take_along_axis(page_tables, page_idx, axis=1),
+                     GARBAGE_PAGE)                     # [B, C]
+    off = jnp.where(valid, positions % ps, 0)
     for l in range(spec.n_layers):
         lp = params[f"layer{l}"]
         h = _ln(x, lp["ln1"])
-        q = (h @ lp["wq"]).reshape(b, spec.n_heads, dh)
-        k = (h @ lp["wk"]).reshape(b, spec.n_kv_heads, dh)
-        v = (h @ lp["wv"]).reshape(b, spec.n_kv_heads, dh)
+        q = (h @ lp["wq"]).reshape(b, c, spec.n_heads, dh)
+        k = (h @ lp["wk"]).reshape(b, c, spec.n_kv_heads, dh)
+        v = (h @ lp["wv"]).reshape(b, c, spec.n_kv_heads, dh)
+        # write the whole chunk's K/V, THEN attend: within the chunk,
+        # query j sees keys i <= j of the same chunk — write-before-
+        # attend makes the chunk exactly equal to sequential steps
         k_pool = k_pool.at[l, page, off].set(k.astype(k_pool.dtype))
         v_pool = v_pool.at[l, page, off].set(v.astype(v_pool.dtype))
         attn = paged_attention(q, k_pool[l], v_pool[l], page_tables,
-                               kv_lens)
-        x = x + attn.reshape(b, spec.n_heads * dh) @ lp["wo"]
+                               kv_lens, q_lens=q_lens)
+        x = x + attn.reshape(b, c, spec.n_heads * dh) @ lp["wo"]
         h2 = _ln(x, lp["ln2"])
         x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
-    logits = _ln(x, params["lnf"]) @ params["tok_emb"].T
+    # unembed only each slot's newest lane (dead slots gather lane 0 —
+    # garbage the scheduler never samples)
+    last = jnp.maximum(q_lens - 1, 0)[:, None, None]       # [B, 1, 1]
+    x_last = jnp.take_along_axis(
+        x, jnp.broadcast_to(last, (b, 1, dm)), axis=1)[:, 0]
+    logits = _ln(x_last, params["lnf"]) @ params["tok_emb"].T
     return k_pool, v_pool, logits
+
+
+def decoder_step(params, spec: DecoderSpec, tokens, positions,
+                 k_pool, v_pool, page_tables, kv_lens):
+    """The PR 6 single-token step — now the C=1 case of
+    ``decoder_step_chunked`` (one implementation, so the two forms
+    cannot drift). tokens/positions: [B] int32 (dead slots: 0/0 with
+    an all-garbage table row); kv_lens: [B] int32 — valid keys
+    INCLUDING this step's token (0 = dead slot -> exact-zero attention
+    output). Returns ``(k_pool, v_pool, logits [B, vocab])``."""
+    import jax.numpy as jnp
+
+    q_lens = (kv_lens > 0).astype(jnp.int32)
+    return decoder_step_chunked(
+        params, spec, tokens[:, None], positions[:, None], q_lens,
+        k_pool, v_pool, page_tables, kv_lens)
 
 
 # --- sampling -----------------------------------------------------------
@@ -322,18 +383,22 @@ class _DecodeRequest:
 
 
 class _Slot:
-    __slots__ = ("req", "pos", "produced", "pages_held")
+    __slots__ = ("req", "pos", "produced", "pages_held", "steps",
+                 "first_token_steps")
 
     def __init__(self, req: _DecodeRequest, pages_held: int):
         self.req = req
         self.pos = 0                # tokens already written to the cache
         self.produced: List[int] = []
         self.pages_held = pages_held
+        self.steps = 0              # scheduler steps this slot has ridden
+        self.first_token_steps: Optional[int] = None
 
-    def next_token(self) -> int:
+    def token_at(self, idx: int) -> int:
+        """The sequence's token at absolute position ``idx``: a prompt
+        token, or a previously generated one."""
         p = self.req.prompt
-        return int(p[self.pos]) if self.pos < len(p) \
-            else self.produced[self.pos - len(p)]
+        return int(p[idx]) if idx < len(p) else self.produced[idx - len(p)]
 
 
 # --- the engine ---------------------------------------------------------
@@ -353,10 +418,11 @@ class DecodeEngine:
                  num_pages: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
                  max_queue: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
                  continuous: bool = True,
                  params: Optional[Dict[str, Any]] = None,
                  warm: bool = True):
-        from ..fluid.flags import FLAGS
+        from ..fluid.flags import FLAGS, effective_flag
 
         self.name = str(name)
         self.version = int(version)
@@ -391,6 +457,23 @@ class DecodeEngine:
             label=f"{self.name}.v{self.version}")
         w_max = self.cache.allocator.pages_for_tokens(self.max_seq_len)
         self._width_ladder = width_ladder(w_max)
+        # chunked prefill (ISSUE 10): the per-step prompt-token budget
+        # AND the compiled chunk width. A PR 8 tunable: the FLAGS
+        # constant is the cold default, the autotune cache overrides
+        # per device kind (decode_bench seeds it via measure-or-model
+        # and the observed prompt-length histogram). Clamped to the
+        # longest admissible prompt (max_seq_len - 1: max_new >= 1) —
+        # a wider chunk than any prompt only burns warm compiles.
+        # Resolved ONCE, before warm(), like every other ladder knob.
+        chunk = int(effective_flag("prefill_chunk")
+                    if prefill_chunk is None else prefill_chunk)
+        self._prefill_chunk = max(1, min(chunk, max(1,
+                                                    self.max_seq_len - 1)))
+        # the third padded dimension of the compiled step: pure-decode
+        # steps ride the C=1 shapes (exactly the PR 6 step — chunking
+        # costs nothing when no prompt is in flight), steps carrying a
+        # prefill grant ride the C=chunk shapes
+        self._chunk_ladder = sorted({1, self._prefill_chunk})
         self._cond = threading.Condition()
         self._queue: List[_DecodeRequest] = []  # guarded-by: _cond
         self._slots: List[_Slot] = []  # guarded-by: _cond
@@ -411,9 +494,11 @@ class DecodeEngine:
 
         spec_ref = spec  # closed over; jit retraces only on shape change
 
-        def _step(params, tokens, positions, k_pool, v_pool, tables, lens):
-            return decoder_step(params, spec_ref, tokens, positions,
-                                k_pool, v_pool, tables, lens)
+        def _step(params, tokens, positions, q_lens, k_pool, v_pool,
+                  tables, lens):
+            return decoder_step_chunked(params, spec_ref, tokens,
+                                        positions, q_lens, k_pool,
+                                        v_pool, tables, lens)
 
         # donate the pools on TPU so XLA updates the KV pages in place
         # (HBM footprint stays the preallocated pool); CPU ignores
@@ -423,7 +508,7 @@ class DecodeEngine:
         self._donate = donate
         self._step_fn = jax.jit(
             _step,
-            donate_argnums=(3, 4) if donate else ())  # guarded-by: _step_mu
+            donate_argnums=(4, 5) if donate else ())  # guarded-by: _step_mu
         # serializes warm() (caller thread) against live steps (the
         # scheduler thread): read-pools -> step -> rebind must be
         # atomic or concurrent rebinds silently drop KV writes
@@ -451,19 +536,31 @@ class DecodeEngine:
     def table_width_ladder(self) -> List[int]:
         return list(self._width_ladder)
 
+    @property
+    def prefill_chunk(self) -> int:
+        return self._prefill_chunk
+
+    @property
+    def chunk_ladder(self) -> List[int]:
+        return list(self._chunk_ladder)
+
     def warm(self):
-        """Pre-compile EVERY (slot-count, table-width) pair on an
-        all-dead synthetic batch (writes land on the garbage page).
-        After this, sequence churn at ragged lengths compiles nothing:
-        both padded dimensions only ever take ladder values."""
+        """Pre-compile EVERY (slot-count, table-width, chunk) triple on
+        an all-dead synthetic batch (writes land on the garbage page).
+        After this, sequence churn at ragged lengths — prefill chunks
+        included — compiles nothing: all three padded dimensions only
+        ever take ladder values."""
         with _tracing.span("serving.decode.warmup", model=self.name,
                            version=self.version):
             for s in self._slot_ladder:
                 for w in self._width_ladder:
-                    self._run_step_arrays(
-                        np.zeros(s, np.int32), np.zeros(s, np.int32),
-                        np.full((s, w), GARBAGE_PAGE, np.int32),
-                        np.zeros(s, np.int32))
+                    for c in self._chunk_ladder:
+                        self._run_step_arrays(
+                            np.zeros((s, c), np.int32),
+                            np.zeros((s, c), np.int32),
+                            np.zeros(s, np.int32),
+                            np.full((s, w), GARBAGE_PAGE, np.int32),
+                            np.zeros(s, np.int32))
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
                deadline_ms: Optional[float] = None,
@@ -527,6 +624,9 @@ class DecodeEngine:
             demand = len(self._queue) + len(self._slots)
             self._cond.notify()
         _observe_shape("decode_slots", demand)
+        # the prompt-length histogram the prefill_chunk tuner derives
+        # its crossover from (bench sessions seed it, ISSUE 10)
+        _observe_shape("prefill_chunk", int(prompt.size))
         _m_requests.inc()
         return req
 
@@ -535,7 +635,8 @@ class DecodeEngine:
                  timeout: float = 300.0, temperature: float = 0.0,
                  top_k: int = 0, seed: int = 0) -> Dict[str, Any]:
         """Blocking convenience: submit + wait. Returns
-        ``{"tokens": [...], "prompt_len": n, "version": v}``.
+        ``{"tokens": [...], "prompt_len": n, "version": v,
+        "steps_to_first_token": k}``.
         ``temperature``/``top_k``/``seed`` thread through to the
         per-request sampler (0.0 = greedy, the default)."""
         req = self.submit(prompt, max_new_tokens, deadline_ms=deadline_ms,
@@ -644,6 +745,8 @@ class DecodeEngine:
                 "spec": self.spec.to_dict(),
                 "slots": list(self._slot_ladder),
                 "table_widths": list(self._width_ladder),
+                "prefill_chunk": self._prefill_chunk,
+                "chunk_ladder": list(self._chunk_ladder),
                 "page_size": self.cache.page_size,
                 "max_seq_len": self.max_seq_len,
                 "continuous": self._continuous,
@@ -754,31 +857,77 @@ class DecodeEngine:
                         self._cond.notify_all()
                         return
 
-    def _run_step_arrays(self, tokens, positions, tables, lens):
+    def _run_step_arrays(self, tokens, positions, q_lens, tables, lens):
         """Shared by warm() and live steps: count a DISTINCT-shape
         compile, run the jitted step, rebind the pools."""
         with self._step_mu:
-            key = (len(tokens), tables.shape[1])
+            key = (len(tokens), tables.shape[1], tokens.shape[1])
             if key not in self._compiled_shapes:
                 self._compiled_shapes.add(key)
                 _m_compiles.inc()
             k, v, logits = self._step_fn(
-                self._params, tokens, positions, self.cache.k,
+                self._params, tokens, positions, q_lens, self.cache.k,
                 self.cache.v, tables, lens)
             self.cache.rebind(k, v)
             return logits
+
+    def _grants(self, live: List[_Slot]) -> List[int]:
+        """Token-budget scheduling (Sarathi-style, ISSUE 10): every
+        slot past its prompt gets its one decode token unconditionally
+        — in-flight decodes NEVER stall behind a prompt — while slots
+        still in prefill share a per-step budget of ``prefill_chunk``
+        prompt tokens, granted in slot order. Every prefill slot is
+        guaranteed at least one token per step (at ``prefill_chunk=1``
+        this is bitwise the PR 6 one-token-per-slot schedule; no slot
+        ever starves), so the budget caps the CHUNKS, not progress. A
+        solo prompt takes the whole budget every step: P prompt tokens
+        cost ceil(P / prefill_chunk) steps instead of P."""
+        budget = self._prefill_chunk
+        grants = []
+        for s in live:
+            remaining_prompt = len(s.req.prompt) - s.pos
+            if remaining_prompt > 0:
+                g = max(1, min(remaining_prompt, budget))
+                budget = max(0, budget - g)
+            else:
+                g = 1
+            grants.append(g)
+        return grants
 
     def _step(self, live: List[_Slot]):
         s_bucket = _bucket_for(self._slot_ladder, len(live))
         w_need = max(s.pages_held for s in live)
         w_bucket = _bucket_for(self._width_ladder, w_need)
-        tokens = np.zeros(s_bucket, np.int32)
-        positions = np.zeros(s_bucket, np.int32)
+        grants = self._grants(live)
+        # pure-decode steps (and 1-token prefill tails) ride the C=1
+        # shapes — exactly the PR 6 step; only steps carrying a real
+        # chunk pay the chunk-wide compute
+        c_bucket = _bucket_for(self._chunk_ladder, max(max(grants), 1))
+        prefill_toks = sum(g for s, g in zip(live, grants)
+                           if s.pos < len(s.req.prompt))
+        tokens = np.zeros((s_bucket, c_bucket), np.int32)
+        positions = np.zeros((s_bucket, c_bucket), np.int32)
+        q_lens = np.zeros(s_bucket, np.int32)
         lens = np.zeros(s_bucket, np.int32)
-        for i, s in enumerate(live):
-            tokens[i] = s.next_token()
-            positions[i] = s.pos
-            lens[i] = s.pos + 1  # the token written this step attends self
+        for i, (s, g) in enumerate(zip(live, grants)):
+            for j in range(g):
+                tokens[i, j] = s.token_at(s.pos + j)
+                positions[i, j] = s.pos + j
+            q_lens[i] = g
+            # keys INCLUDING this chunk; within it, query j attends
+            # only keys up to its own position (chunk-causal)
+            lens[i] = s.pos + g
+            # reserve-at-admission must hold under chunking: a grant
+            # can never write past the pages reserved at submit (the
+            # prompt is part of the worst case the admission priced).
+            # A real raise, not an assert: writing through a page index
+            # past the reservation would corrupt another sequence's
+            # pages, and `python -O` strips asserts
+            if lens[i] > s.pages_held * self.cache.page_size:
+                raise ServingError(
+                    f"chunk grant escaped seq {s.req.seq_id}'s page "
+                    f"reservation ({lens[i]} tokens > "
+                    f"{s.pages_held} pages x {self.cache.page_size})")
         tables = self.cache.table_array(
             [s.req.seq_id for s in live], w_bucket, rows=s_bucket)
         t0 = time.perf_counter()
@@ -787,15 +936,23 @@ class DecodeEngine:
         with _tracing.adopt(live[0].req.trace_ctx), \
                 _tracing.span("serving.decode.step", model=self.name,
                               version=self.version, slots=s_bucket,
-                              width=w_bucket, live=len(live)):
-            logits = self._run_step_arrays(tokens, positions, tables, lens)
-        logits_np = np.asarray(logits)
+                              width=w_bucket, chunk=c_bucket,
+                              prefill_tokens=prefill_toks,
+                              live=len(live)):
+            logits = self._run_step_arrays(tokens, positions, q_lens,
+                                           tables, lens)
+        logits_np = np.asarray(logits)   # [B, vocab] — newest lane only
         # the greedy fast path for the whole batch; per-request sampling
         # policies (temperature/top_k/seed) resolve per slot below
-        sampled = np.asarray(np.argmax(logits_np, axis=-1))
+        sampled = np.asarray(np.argmax(logits_np, axis=-1))  # [B]
         _m_step_ms.observe((time.perf_counter() - t0) * 1e3)
         _m_steps.inc()
         _m_occupancy.observe(len(live) / float(s_bucket))
+        # prices the token-budget policy next to occupancy: how much of
+        # each step's budget real prefill work consumed
+        _m_prefill_per_step.observe(prefill_toks)
+        if prefill_toks:
+            _m_prefill_tokens.inc(prefill_toks)
         with self._cond:
             self._n_steps += 1
         now = time.monotonic()
@@ -812,19 +969,29 @@ class DecodeEngine:
                     # or count a completion/token for it
                     done.append(s)
                     continue
-                s.pos += 1
+                g = grants[i]        # >= 1: every live slot progresses
+                s.steps += 1
+                s.pos += g
                 notes[s.req.seq_id] = s.pos
                 tok = None
                 if s.pos >= len(s.req.prompt):
-                    # s.pos is the new token's absolute index in its
-                    # sequence — the (seed, position) pair that makes
-                    # sampling independent of batch composition
-                    tok = (int(sampled[i]) if s.req.temperature <= 0.0
+                    # logits_np[i] is the slot's newest lane (the step
+                    # unembeds only lane q_len-1): prompt token P-1
+                    # when the chunk just finished prefill, else the
+                    # decode token. s.pos is the new token's absolute
+                    # index in its sequence — the (seed, position) pair
+                    # that makes sampling independent of batch
+                    # composition AND of chunking
+                    tok = (int(sampled[i])
+                           if s.req.temperature <= 0.0
                            else sample_token(
                                logits_np[i], s.req.temperature,
                                s.req.top_k, s.req.seed, s.pos))
                     s.produced.append(tok)
                     _m_tokens.inc()
+                    if s.first_token_steps is None:
+                        s.first_token_steps = s.steps
+                        _m_first_token_steps.observe(s.steps)
                 finished = (len(s.produced) >= s.req.max_new
                             or (tok is not None
                                 and self.spec.eos_id is not None
@@ -856,5 +1023,9 @@ class DecodeEngine:
             "tokens": list(s.produced),
             "prompt_len": int(len(s.req.prompt)),
             "version": self.version,
+            # scheduler steps from admission to the first generated
+            # token — the load-independent chunked-prefill evidence
+            # (ceil(P/chunk) + co-riding, vs P unchunked)
+            "steps_to_first_token": int(s.first_token_steps or s.steps),
         }
         s.req.ev.set()

@@ -170,6 +170,15 @@ class PageAllocator:
             self._publish_locked()
             return list(pages)
 
+    def reserved_tokens(self, seq_id: int) -> int:
+        """Token capacity of the sequence's reservation (held pages x
+        page_size). Reserve-at-admission means appends — single decode
+        tokens AND multi-token prefill chunks alike — always land
+        inside this bound; it never grows after ``alloc`` (the
+        chunked-prefill invariant test reads it)."""
+        with self._mu:
+            return len(self._owner.get(seq_id, ())) * self.page_size
+
     def note_tokens(self, seq_id: int, n_tokens: int):
         """Record how many tokens the sequence has actually written —
         feeds the fragmentation gauge; never moves pages."""

@@ -232,7 +232,9 @@ class ServingServer:
                       page_size: Optional[int] = None,
                       num_pages: Optional[int] = None,
                       max_seq_len: Optional[int] = None,
-                      max_queue: Optional[int] = None) -> Dict[str, Any]:
+                      max_queue: Optional[int] = None,
+                      prefill_chunk: Optional[int] = None
+                      ) -> Dict[str, Any]:
         """Build + warm (every slot/width shape) + atomically install a
         DecodeEngine from an architecture/seed spec dict. Hot-swapping
         a decoder drains the old engine — every in-flight SEQUENCE
@@ -250,7 +252,7 @@ class ServingServer:
                     DecoderSpec.from_dict(spec), name=model,
                     version=version, slots=slots, page_size=page_size,
                     num_pages=num_pages, max_seq_len=max_seq_len,
-                    max_queue=max_queue)
+                    max_queue=max_queue, prefill_chunk=prefill_chunk)
 
             engine = self._registry.deploy(model, build)
             return engine.stats()

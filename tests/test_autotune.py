@@ -341,7 +341,7 @@ def test_decode_auto_slots_zero_post_warm_compiles():
             compiles = metrics.counter("serving.decode.compiles")
             c_warm = compiles.value()
             assert c_warm == len(eng.slot_ladder) * \
-                len(eng.table_width_ladder)
+                len(eng.table_width_ladder) * len(eng.chunk_ladder)
             rng = np.random.RandomState(3)
             reqs = [eng.submit(rng.randint(0, 32,
                                            size=1 + int(rng.randint(4))),

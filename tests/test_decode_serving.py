@@ -7,12 +7,20 @@ Coverage map:
     padding stability, fragmentation accounting;
   - paged_attention: reference path vs a dense numpy oracle, vs the
     flash kernel's dense path, vs the Pallas paged kernel in interpret
-    mode — identical numerics across all four;
-  - DecodeEngine: warm pre-compiles exactly the (slots x widths)
-    ladder and sequence CHURN AT RAGGED LENGTHS performs ZERO new
-    compiles (the tier-1 acceptance guard — counter-asserted, and the
-    fluid executor's jit counter stays untouched), KV footprint fixed,
-    greedy decode deterministic;
+    mode — identical numerics across all four; the MULTI-TOKEN chunked
+    form (ISSUE 10): GQA, dead slots (q_len 0) exact-zero, a chunk
+    crossing a page boundary, causal masking within the chunk, and
+    flash-causal agreement on a pure-prefill chunk;
+  - DecodeEngine: warm pre-compiles exactly the (slots x widths x
+    chunks) ladder and sequence CHURN AT RAGGED LENGTHS performs ZERO
+    new compiles (the tier-1 acceptance guard — counter-asserted, and
+    the fluid executor's jit counter stays untouched), KV footprint
+    fixed, greedy decode deterministic;
+  - chunked prefill (ISSUE 10): a P-token prompt prefills in
+    ceil(P/chunk) scheduler steps (counter-pinned), greedy tokens
+    identical with chunking on vs off, in-flight decodes never stall
+    behind a prefilling prompt, reserve-at-admission holds exactly
+    under multi-token appends, prefill_* metrics populated;
   - sampling (ISSUE 8 satellite): temperature/top-k/seed per request,
     deterministic given seed and independent of batch composition,
     temperature 0 / top_k 1 bitwise-greedy, typed validation, RPC
@@ -59,13 +67,14 @@ def _spec():
 
 
 def _engine(**kw):
-    """Tiny ladders so warm compiles 4 shapes: slots [1,2] x widths
-    [1,2] (max_seq_len 8 / page_size 4)."""
+    """Tiny ladders so warm compiles 8 shapes: slots [1,2] x widths
+    [1,2] x chunks [1,4] (max_seq_len 8 / page_size 4)."""
     kw.setdefault("slots", [1, 2])
     kw.setdefault("page_size", 4)
     kw.setdefault("num_pages", 10)
     kw.setdefault("max_seq_len", 8)
     kw.setdefault("max_queue", 16)
+    kw.setdefault("prefill_chunk", 4)
     return DecodeEngine(_spec(), name=kw.pop("name", "toy"), **kw)
 
 
@@ -173,6 +182,76 @@ def test_paged_attention_matches_dense_and_flash():
     np.testing.assert_allclose(pal, ref, rtol=2e-5, atol=2e-6)
 
 
+def test_paged_attention_chunked_matches_reference_and_flash():
+    """The ISSUE 10 kernel A/B: the MULTI-TOKEN form (q [B, C, Hq, D] +
+    q_lens) against a per-query numpy oracle, against the Pallas kernel
+    in interpret mode, and against the flash kernel's CAUSAL dense path
+    on a pure-prefill chunk. Covers GQA (2 q heads per kv head), a dead
+    slot (q_len 0 -> exact zero), dead lanes of a live slot, a chunk
+    whose tokens cross a page boundary, and causal masking within the
+    chunk."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.fluid.ops.pallas_kernels.flash_attention import \
+        flash_attention
+    from paddle_tpu.fluid.ops.pallas_kernels.paged_attention import (
+        _paged_attention_pallas, paged_attention_reference)
+
+    rng = np.random.RandomState(1)
+    B, C, Hq, Hkv, D, ps = 3, 6, 4, 2, 8, 8
+    P, W = 10, 3
+    # slot 0: 20 keys, 6-query chunk ending at key 20 — the chunk spans
+    # absolute positions 14..19, CROSSING the page boundary at 16;
+    # slot 1: pure-prefill chunk (kv_len == q_len: the whole sequence
+    # IS the chunk) -> plain causal attention;
+    # slot 2: dead (q_len 0, garbage table)
+    kv_lens = np.array([20, 5, 0], np.int32)
+    q_lens = np.array([6, 5, 0], np.int32)
+    tables = np.array([[1, 2, 3], [4, 0, 0], [0, 0, 0]], np.int32)
+    q = rng.randn(B, C, Hq, D).astype(np.float32)
+    kp = rng.randn(P, ps, Hkv, D).astype(np.float32)
+    vp = rng.randn(P, ps, Hkv, D).astype(np.float32)
+
+    ref = np.asarray(paged_attention_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(kv_lens),
+        q_lens=jnp.asarray(q_lens)))
+
+    # numpy oracle: query j of slot b sees keys <= kv_len - q_len + j
+    for b in range(B):
+        k = kp[tables[b]].reshape(-1, Hkv, D).repeat(Hq // Hkv, 1)
+        v = vp[tables[b]].reshape(-1, Hkv, D).repeat(Hq // Hkv, 1)
+        for j in range(C):
+            if j >= q_lens[b]:
+                np.testing.assert_array_equal(ref[b, j], 0.0)
+                continue
+            L = int(kv_lens[b]) - int(q_lens[b]) + j + 1
+            s = np.einsum("hd,thd->ht", q[b, j] * D ** -0.5, k[:L])
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            np.testing.assert_allclose(
+                ref[b, j], np.einsum("ht,thd->hd", p, v[:L]),
+                rtol=2e-5, atol=2e-6)
+
+    # the Pallas kernel (page tables + both length vectors in
+    # scalar-prefetch), interpret mode
+    pal = np.asarray(_paged_attention_pallas(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(kv_lens),
+        q_lens=jnp.asarray(q_lens), interpret=True))
+    np.testing.assert_allclose(pal, ref, rtol=2e-5, atol=2e-6)
+
+    # slot 1 is a pure-prefill chunk: chunk-causal == flash causal
+    k1 = kp[tables[1]].reshape(-1, Hkv, D)[:5]
+    v1 = vp[tables[1]].reshape(-1, Hkv, D)[:5]
+    fl = np.asarray(flash_attention(
+        jnp.asarray(q[1, :5][None]),                  # [1, 5, Hq, D]
+        jnp.asarray(k1.repeat(Hq // Hkv, 1)[None]),
+        jnp.asarray(v1.repeat(Hq // Hkv, 1)[None]),
+        causal=True, block_q=8, block_k=8, interpret=True))
+    np.testing.assert_allclose(ref[1, :5], fl[0], rtol=2e-4, atol=2e-5)
+
+
 # --- the engine: compile guard, determinism, footprint -------------------
 
 def test_decode_churn_zero_new_compiles():
@@ -191,8 +270,10 @@ def test_decode_churn_zero_new_compiles():
         # is a violation)
         assert eng.slot_ladder == [1, 2]
         assert eng.table_width_ladder == [1, 2]
-        assert eng.stats()["compiled_shapes"] == [(1, 1), (1, 2),
-                                                  (2, 1), (2, 2)]
+        assert eng.chunk_ladder == [1, 4]
+        assert eng.stats()["compiled_shapes"] == [
+            (1, 1, 1), (1, 1, 4), (1, 2, 1), (1, 2, 4),
+            (2, 1, 1), (2, 1, 4), (2, 2, 1), (2, 2, 4)]
         pool_shape = tuple(eng.cache.k.shape)
         base_decode = metrics.counter("serving.decode.compiles").value()
         base_exec = metrics.counter("executor.jit_compiles").value()
@@ -213,7 +294,8 @@ def test_decode_churn_zero_new_compiles():
         assert metrics.counter("executor.jit_compiles").value() \
             == base_exec, "decode path leaked into the executor jit cache"
         assert (len(eng.stats()["compiled_shapes"]) ==
-                len(eng.slot_ladder) * len(eng.table_width_ladder))
+                len(eng.slot_ladder) * len(eng.table_width_ladder)
+                * len(eng.chunk_ladder))
         # footprint: the pool is the SAME preallocated arrays' shape,
         # and every page went back to the free list
         assert tuple(eng.cache.k.shape) == pool_shape
@@ -344,6 +426,126 @@ def test_continuous_beats_drain_by_exact_step_count():
     assert results["cont"] < results["drain"], results
     occ = metrics.snapshot()["serving.decode.occupancy"]
     assert occ["count"] > 0
+
+
+# --- chunked prefill (ISSUE 10) ------------------------------------------
+
+def test_chunked_prefill_steps_counter_pinned():
+    """THE ISSUE 10 acceptance: a P-token prompt (P = 4*chunk) prefills
+    in exactly ceil(P/chunk) scheduler steps (vs P before), total steps
+    = ceil(P/chunk) + (max_new - 1), serving.decode.compiles stays at
+    its post-warm value across the churn, and the prefill_* metrics
+    surface the budget spend."""
+    # pool sized for the whole churn burst: pages are reserved at
+    # admission (up to 6 x 4 pages live at once in the churn below)
+    eng = _engine(name="chunky", max_seq_len=20, num_pages=26,
+                  prefill_chunk=4)
+    try:
+        base_c = metrics.counter("serving.decode.compiles").value()
+        base_s = metrics.counter("serving.decode.steps").value()
+        base_p = metrics.counter("serving.decode.prefill_tokens").value()
+        prompt = list(np.random.RandomState(5).randint(0, 32, size=16))
+        out = eng.generate(prompt, max_new_tokens=3)     # P = 4 * chunk
+        assert out["steps_to_first_token"] == 4, out     # ceil(16/4)
+        assert metrics.counter("serving.decode.steps").value() \
+            - base_s == 4 + 2
+        assert metrics.counter("serving.decode.compiles").value() \
+            == base_c, "chunked prefill escaped the warmed ladder"
+        # every prompt token rode a prefill grant, and the per-step
+        # budget histogram priced them
+        assert metrics.counter(
+            "serving.decode.prefill_tokens").value() - base_p == 16
+        hist = metrics.snapshot()
+        assert hist["serving.decode.prefill_tokens_per_step"]["count"] > 0
+        assert hist["serving.decode.steps_to_first_token"]["count"] > 0
+        # more churn at ragged prompt lengths: still zero new compiles
+        rng = np.random.RandomState(6)
+        reqs = [eng.submit(rng.randint(0, 32, size=1 + int(rng.randint(12))),
+                           max_new_tokens=2) for _ in range(6)]
+        for r in reqs:
+            assert r.ev.wait(120) and r.error is None, r.error
+        assert metrics.counter("serving.decode.compiles").value() == base_c
+        assert eng.cache.allocator.stats()["pages_used"] == 0
+    finally:
+        eng.stop()
+
+
+def test_greedy_tokens_identical_chunking_on_vs_off():
+    """Chunking is pure packing: the same prompt greedy-decodes to the
+    SAME tokens at chunk 4 and chunk 1 (the PR 6 one-token-per-step
+    schedule) — only the step counts differ (4 vs 13 to first token)."""
+    prompt = list(np.random.RandomState(9).randint(0, 32, size=13))
+    outs = {}
+    for chunk in (4, 1):
+        eng = _engine(name=f"ab{chunk}", max_seq_len=20, num_pages=16,
+                      prefill_chunk=chunk)
+        try:
+            outs[chunk] = eng.generate(prompt, max_new_tokens=4)
+        finally:
+            eng.stop()
+    assert outs[4]["tokens"] == outs[1]["tokens"], outs
+    assert outs[4]["steps_to_first_token"] == 4      # ceil(13/4)
+    assert outs[1]["steps_to_first_token"] == 13
+
+
+def test_mixed_step_decode_never_stalls_behind_prefill():
+    """Sarathi-style mixed batches: a sequence mid-decode co-rides a
+    fresh prompt's prefill chunks — the prompt still prefills in
+    ceil(P/chunk) of ITS OWN steps (prefill budget untouched by decode
+    slots), and the decoding sequence's tokens keep arriving (both
+    complete; neither waits for the other)."""
+    eng = _engine(name="mixed", slots=[2], max_seq_len=20, num_pages=16,
+                  prefill_chunk=4)
+    try:
+        a = eng.submit([1], max_new_tokens=10)
+        # wait until A is decoding (its 1-token prompt consumed)
+        for _ in range(2000):
+            with eng._cond:
+                sa = next((s for s in eng._slots if s.req is a), None)
+                if sa is not None and sa.produced:
+                    break
+            time.sleep(0.002)
+        b = eng.submit(list(range(16)), max_new_tokens=2)
+        assert a.ev.wait(120) and a.error is None, a.error
+        assert b.ev.wait(120) and b.error is None, b.error
+        assert len(a.result["tokens"]) == 10
+        # B's prompt prefilled at the full budget despite A decoding
+        # alongside: ceil(16/4) steps from B's admission
+        assert b.result["steps_to_first_token"] == 4, b.result
+    finally:
+        eng.stop()
+
+
+def test_reserve_at_admission_holds_exactly_under_chunking():
+    """The ISSUE 10 small fix: admission reserves
+    ceil((prompt+max_new)/page_size) pages up front, and chunked
+    multi-token appends never write outside that reservation — proven
+    by a pool sized EXACTLY for one request (reserve + garbage page):
+    if any chunk escaped its reservation the step would trip the
+    engine's reservation assert (failing the request) or corrupt page
+    accounting (pages_used != 0 after completion)."""
+    from paddle_tpu.serving import PageAllocator
+
+    # 16 prompt + 4 new = 20 tokens = 5 pages of 4; pool = 5 + garbage
+    eng = _engine(name="exact", slots=[1], max_seq_len=20, num_pages=6,
+                  prefill_chunk=4)
+    try:
+        out = eng.generate(list(range(16)), max_new_tokens=4)
+        assert len(out["tokens"]) == 4
+        st = eng.cache.allocator.stats()
+        assert st["pages_used"] == 0 and st["pages_free"] == 5
+    finally:
+        eng.stop()
+    # the allocator-side bound the engine asserts against: a
+    # reservation's token capacity is pages * page_size and never grows
+    a = PageAllocator(num_pages=8, page_size=4)
+    a.alloc(1, 10)                       # 3 pages -> 12-token capacity
+    assert a.reserved_tokens(1) == 12
+    a.note_tokens_many({1: 10})          # a chunked append's accounting
+    assert a.reserved_tokens(1) == 12    # capacity unchanged
+    assert a.stats()["tokens"] == 10
+    a.free(1)
+    assert a.reserved_tokens(1) == 0
 
 
 # --- admission / deadlines ----------------------------------------------
@@ -518,7 +720,7 @@ def decode_server():
     addr = srv.serve()
     cli = ServingClient(addr)
     cli.load_decoder("gen", _spec().to_dict(), slots=[1, 2], page_size=4,
-                     num_pages=10, max_seq_len=8)
+                     num_pages=10, max_seq_len=8, prefill_chunk=4)
     yield srv, cli, addr
     cli.close()
     srv.shutdown()
@@ -562,9 +764,9 @@ def test_generate_reply_dropped_retry_is_dedup_exact(decode_server):
     assert metrics.counter("rpc.server.dedup_hits").value() == 1
     assert metrics.counter("serving.decode.requests").value() == 1
     assert metrics.counter("serving.decode.completions").value() == 1
-    # one step per consumed token: 2 prompt + 4 generated, minus the
-    # last prompt step doubling as the first sample = 5 steps, run ONCE
-    assert metrics.counter("serving.decode.steps").value() == 5
+    # chunked prefill: the 2-token prompt is one chunk (one step, whose
+    # logits sample the first token) + 3 more decode steps, run ONCE
+    assert metrics.counter("serving.decode.steps").value() == 4
 
 
 # --- rpc zero-copy satellite --------------------------------------------
@@ -645,3 +847,14 @@ def test_decode_bench_smoke():
     assert res["continuous"]["decode_steps"] <= res["drain"]["decode_steps"]
     assert "framework_metrics" in ev and ev["results"]["reprefill"][
         "full_forwards"] == gens["reprefill"]
+    # chunked prefill (ISSUE 10): the long-prompt rows reach their
+    # first token in strictly fewer scheduler steps than the
+    # one-token-per-step baseline, still with zero post-warm compiles,
+    # and the observed prompt-length histogram rides the evidence
+    lp = ev["long_prompt"]["results"]
+    assert lp["chunked"]["steps_to_first_token_mean"] \
+        < lp["unchunked"]["steps_to_first_token_mean"]
+    assert lp["chunked"]["post_warm_compiles"] == 0
+    assert lp["unchunked"]["post_warm_compiles"] == 0
+    assert ev["shape_histogram"].get("prefill_chunk"), \
+        "prompt-length histogram missing from the bench evidence"

@@ -117,13 +117,14 @@ def test_decode_stats_compiled_shapes_regression(guard_sanitizer):
 
     eng = DecodeEngine(
         DecoderSpec(vocab=16, d_model=8, n_layers=1, n_heads=2),
-        name="san", slots=[1], num_pages=8, max_seq_len=16)
+        name="san", slots=[1], num_pages=8, max_seq_len=16,
+        prefill_chunk=1)
     try:
         req = eng.submit([1, 2], max_new_tokens=8)
         # scrape stats live, mid-decode — the fixed path must not trip
         for _ in range(20):
             st = eng.stats()
-            assert st["compiled_shapes"] == [(1, 1)]
+            assert st["compiled_shapes"] == [(1, 1, 1)]
         assert req.ev.wait(60) and req.error is None
         assert sanitize.violations() == []
         # and the pre-fix access shape (read without _step_mu) DOES
