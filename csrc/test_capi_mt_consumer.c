@@ -3,7 +3,12 @@
  * test_multi_thread_helper.h: N threads, each with its own executor/scope
  * over one loaded model). Each thread creates its OWN predictor for the
  * model dir, runs the same fixed input, and the main thread checks every
- * thread produced byte-identical results. */
+ * thread produced byte-identical results.
+ *
+ * usage: mt_consumer <model_dir> [nthreads]
+ * nthreads defaults to 4; the Python test scales it to the machine's
+ * core count (4 embedded interpreters time-slicing one core blew the
+ * test's own subprocess timeout on an nproc=1 box). */
 #include <pthread.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -11,7 +16,8 @@
 
 #include "inference_capi.h"
 
-#define NTHREADS 4
+#define DEFAULT_NTHREADS 4
+#define MAX_NTHREADS 16
 #define NROWS 2
 #define NFEAT 13
 
@@ -58,13 +64,22 @@ static void* worker(void* arg) {
 
 int main(int argc, char** argv) {
   if (argc < 2) {
-    fprintf(stderr, "usage: %s <model_dir>\n", argv[0]);
+    fprintf(stderr, "usage: %s <model_dir> [nthreads]\n", argv[0]);
     return 2;
   }
-  pthread_t th[NTHREADS];
-  job_t jobs[NTHREADS];
-  int spawned[NTHREADS];
-  for (int t = 0; t < NTHREADS; ++t) {
+  int nthreads = DEFAULT_NTHREADS;
+  if (argc >= 3) {
+    nthreads = atoi(argv[2]);
+    if (nthreads < 2 || nthreads > MAX_NTHREADS) {
+      fprintf(stderr, "nthreads must be in [2, %d], got %s\n",
+              MAX_NTHREADS, argv[2]);
+      return 2;
+    }
+  }
+  pthread_t th[MAX_NTHREADS];
+  job_t jobs[MAX_NTHREADS];
+  int spawned[MAX_NTHREADS];
+  for (int t = 0; t < nthreads; ++t) {
     jobs[t].model_dir = argv[1];
     jobs[t].id = t;
     jobs[t].ok = 0;
@@ -73,10 +88,10 @@ int main(int argc, char** argv) {
     spawned[t] = pthread_create(&th[t], NULL, worker, &jobs[t]) == 0;
     if (!spawned[t]) fprintf(stderr, "pthread_create failed for %d\n", t);
   }
-  for (int t = 0; t < NTHREADS; ++t)
+  for (int t = 0; t < nthreads; ++t)
     if (spawned[t]) pthread_join(th[t], NULL);
 
-  for (int t = 0; t < NTHREADS; ++t) {
+  for (int t = 0; t < nthreads; ++t) {
     if (!jobs[t].ok) {
       fprintf(stderr, "thread %d failed\n", t);
       return 1;
@@ -88,11 +103,11 @@ int main(int argc, char** argv) {
       return 1;
     }
   }
-  printf("threads=%d agree total=%lld\nvalues:", NTHREADS,
+  printf("threads=%d agree total=%lld\nvalues:", nthreads,
          jobs[0].total);
   for (long long i = 0; i < jobs[0].total; ++i)
     printf(" %.6f", jobs[0].values[i]);
   printf("\n");
-  for (int t = 0; t < NTHREADS; ++t) free(jobs[t].values);
+  for (int t = 0; t < nthreads; ++t) free(jobs[t].values);
   return 0;
 }

@@ -249,6 +249,7 @@ class NameUniverse:
         "io": "paddle_tpu.fluid.io",
         "serving": "paddle_tpu.serving",
         "autotune": "paddle_tpu.autotune",
+        "fleet": "paddle_tpu.fleet",
     }
 
     def __init__(self, names: Tuple[Set[str], Set[str]],
@@ -560,7 +561,8 @@ def check_repo(root: Optional[str] = None) -> List[Diagnostic]:
     tools = os.path.join(root, "tools")
     docs = [os.path.join(root, "docs", n)
             for n in ("OBSERVABILITY.md", "FAULT_TOLERANCE.md",
-                      "STATIC_ANALYSIS.md", "SERVING.md", "AUTOTUNE.md")]
+                      "STATIC_ANALYSIS.md", "SERVING.md", "AUTOTUNE.md",
+                      "FLEET.md")]
     diags: List[Diagnostic] = []
 
     sites = collect_declared_sites(pkg)

@@ -542,4 +542,5 @@ def default_lint_paths(repo_root: Optional[str] = None) -> List[str]:
     return [os.path.join(pkg, "distributed"),
             os.path.join(pkg, "observability"),
             os.path.join(pkg, "serving"),
-            os.path.join(pkg, "autotune")]
+            os.path.join(pkg, "autotune"),
+            os.path.join(pkg, "fleet")]

@@ -59,6 +59,9 @@ _RUNTIME_CLASSES: Tuple[Tuple[str, str], ...] = (
     ("paddle_tpu.distributed.master", "MasterClient"),
     ("paddle_tpu.autotune.cache", "TuningCache"),
     ("paddle_tpu.autotune.ladder", "ShapeHistogram"),
+    ("paddle_tpu.fleet.controller", "FleetController"),
+    ("paddle_tpu.fleet.router", "FleetRouter"),
+    ("paddle_tpu.fleet.member", "FleetMember"),
 )
 
 _ARMED_FLAG = "_guard_sanitizer_armed_"

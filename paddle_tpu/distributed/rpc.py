@@ -484,7 +484,62 @@ class RpcServer:
             allow_reuse_address = True
             daemon_threads = True
 
-        self._server = Server((host, port), Handler)
+            def __init__(self, addr, handler_cls):
+                super().__init__(addr, handler_cls)
+                # established connections, tracked so kill() can sever
+                # them the way a SIGKILLed process's sockets die —
+                # shutdown() alone only closes the LISTENER, and an
+                # in-process chaos "kill" that leaves accepted
+                # connections answering proves nothing about failover
+                self._conns_mu = threading.Lock()
+                self._conns: set = set()
+                self._severed = False  # guarded-by: _conns_mu
+
+            @staticmethod
+            def _sever(conn):
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+            def track(self, conn, on: bool):
+                with self._conns_mu:
+                    if on and self._severed:
+                        # a handler thread whose accept raced kill():
+                        # it reached setup() only after sever_all()
+                        # snapshotted the set — without this late kill
+                        # the connection would survive the "SIGKILL"
+                        # and keep answering
+                        late_kill = True
+                    else:
+                        late_kill = False
+                        (self._conns.add if on
+                         else self._conns.discard)(conn)
+                if late_kill:
+                    self._sever(conn)
+
+            def sever_all(self):
+                with self._conns_mu:
+                    self._severed = True
+                    conns = list(self._conns)
+                    self._conns.clear()
+                for c in conns:
+                    self._sever(c)
+
+        class TrackedHandler(Handler):
+            def setup(self):
+                super().setup()
+                self.server.track(self.connection, True)
+
+            def finish(self):
+                self.server.track(self.connection, False)
+                super().finish()
+
+        self._server = Server((host, port), TrackedHandler)
         t = threading.Thread(target=self._server.serve_forever, daemon=True)
         t.start()
         return self._server.server_address
@@ -496,6 +551,19 @@ class RpcServer:
     def shutdown(self):
         if self._server is not None:
             self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def kill(self):
+        """Abrupt transport death for chaos tests: stop accepting AND
+        sever every ESTABLISHED connection, so peers mid-call see a
+        connection reset — what a SIGKILLed process's sockets do.
+        Nothing else is torn down: handlers that were executing keep
+        running to completion (their replies go nowhere), exactly like
+        work in flight when a real process dies mid-reply."""
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.sever_all()
             self._server.server_close()
             self._server = None
 

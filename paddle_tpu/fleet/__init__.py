@@ -1,0 +1,29 @@
+"""Serving fleet (ISSUE 11): N ServingServer replicas composed into one
+service — the ROADMAP's "millions of users" layer.
+
+    FleetController  replica membership (TTL leases, heartbeat/eviction,
+                     rejoin) + the replicated model-deploy intent log
+    FleetMember      joins one ServingServer to a fleet: registers,
+                     beats, converges the model set to the intent log
+    FleetRouter      capacity-aware client/proxy: routes on scraped
+                     load_report (free KV pages for decoders, queue
+                     headroom for engines), sheds cluster-wide only
+                     when NO replica has capacity, fails over off dead
+                     replicas with dedup-safe retransmits
+    RolloutDriver    training→serving loop: canary → health-gate →
+                     durable intent → fleet-wide roll with zero
+                     dropped requests
+
+See docs/FLEET.md for the full model; `python -m paddle_tpu.fleet
+--selftest` is the in-process end-to-end proof.
+"""
+from .controller import FleetController
+from .member import FleetMember
+from .rollout import (RolloutDriver, RolloutError, decoder_artifact,
+                      model_artifact)
+from .router import FleetRouter, NoReplicasError
+
+__all__ = [
+    "FleetController", "FleetMember", "FleetRouter", "NoReplicasError",
+    "RolloutDriver", "RolloutError", "decoder_artifact", "model_artifact",
+]

@@ -1,0 +1,204 @@
+"""CLI driver for the serving fleet.
+
+    python -m paddle_tpu.fleet --selftest
+        In-process end-to-end proof (no external network): a
+        controller, two ServingServer replicas joined by FleetMembers,
+        a FleetRouter, and a RolloutDriver. Proves the ISSUE 11
+        acceptance shapes from counters:
+          * rollout: canary → health-gate → fleet-wide, both replicas
+            converge to the version
+          * decode-aware routing: with one replica's KV pool pinned
+            full, every request lands on the free replica
+            (fleet.routed.<replica> counters)
+          * cluster-wide shed: only when BOTH replicas report zero
+            capacity does the router shed (fleet.sheds +
+            ServerOverloaded)
+          * failover-no-reexecute: a dropped reply is answered from
+            the SAME replica's dedup cache (rpc.server.dedup_hits,
+            zero extra engine work); a killed replica's traffic fails
+            over to the survivor (fleet.failovers)
+        Exit-nonzero on any failure — wired into tools/check.py.
+
+    python -m paddle_tpu.fleet --controller [--port N]
+        Operator mode: run a FleetController until interrupted.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _force_cpu():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def run_selftest(verbose: bool = True) -> int:
+    import numpy as np
+
+    from paddle_tpu.distributed import faults
+    from paddle_tpu.observability import metrics as _metrics
+    from paddle_tpu.serving import ServerOverloaded, ServingServer
+    from paddle_tpu.serving.decode import DecoderSpec
+
+    from . import (FleetController, FleetMember, FleetRouter,
+                   RolloutDriver, decoder_artifact)
+
+    def say(msg):
+        if verbose:
+            print(f"  {msg}")
+
+    failures = []
+
+    def check(ok, what):
+        say(("ok  " if ok else "FAIL") + f" {what}")
+        if not ok:
+            failures.append(what)
+
+    spec = DecoderSpec(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                       n_kv_heads=1, seed=3)
+    ctl = FleetController(lease_ttl=30.0, sweep_interval=0)
+    ctl_addr = ctl.serve()
+    servers, members = [], []
+    for i in range(2):
+        srv = ServingServer()
+        srv.serve()
+        servers.append(srv)
+        members.append(FleetMember(srv, ctl_addr, replica_id=f"r{i}",
+                                   beat_interval=0.1))
+    router = FleetRouter(ctl_addr, scrape_ttl=0.0, replica_ttl=0.0)
+    try:
+        check(all(m.wait_registered(30.0) for m in members),
+              "both replicas registered with the controller")
+        # -- 1. rollout: canary → gate → fleet-wide ----------------------
+        art = decoder_artifact(spec.to_dict(), slots=[1, 2], page_size=4,
+                               num_pages=24, max_seq_len=12,
+                               prefill_chunk=1)
+        drv = RolloutDriver(ctl_addr)
+        summary = drv.rollout(
+            "m", art, version=1, canary="r0",
+            probe=lambda cli: cli.generate("m", [1, 2], max_new_tokens=2))
+        check(summary["canary"] == "r0"
+              and sorted(summary["converged"]) == ["r0", "r1"],
+              f"rollout converged fleet-wide ({summary['converged']})")
+
+        # -- 2. decode-aware routing: freer replica wins -----------------
+        alloc0 = servers[0].registry.get("m").cache.allocator
+        held = alloc0.alloc(99001, alloc0.pages_free * alloc0.page_size)
+        del held
+        n = 6
+        for i in range(n):
+            router.generate("m", [1, 2, 3], max_new_tokens=2)
+        routed1 = _metrics.counter("fleet.routed.r1").value()
+        routed0 = _metrics.counter("fleet.routed.r0").value()
+        check(routed1 >= n and routed0 == 0,
+              f"KV-saturated r0 took nothing; r1 took all "
+              f"({routed1} routed to r1, {routed0} to r0)")
+
+        # -- 3. cluster-wide shed only at zero capacity ------------------
+        alloc1 = servers[1].registry.get("m").cache.allocator
+        held1 = alloc1.alloc(99002,
+                             alloc1.pages_free * alloc1.page_size)
+        del held1
+        base_sheds = _metrics.counter("fleet.sheds").value()
+        try:
+            router.generate("m", [1, 2, 3], max_new_tokens=2)
+            check(False, "cluster-wide shed raises ServerOverloaded")
+        except ServerOverloaded:
+            check(True, "cluster-wide shed raises ServerOverloaded")
+        check(_metrics.counter("fleet.sheds").value() == base_sheds + 1,
+              "fleet.sheds counted the cluster-wide shed")
+        alloc0.free(99001)
+        alloc1.free(99002)
+        out = router.generate("m", [1, 2, 3], max_new_tokens=2)
+        check(len(out["tokens"]) == 2, "capacity back, routing resumed")
+
+        # -- 4. failover-no-reexecute ------------------------------------
+        # 4a: dropped reply on a live replica = dedup answer, zero extra
+        # engine work (the retransmit rides the SAME (client_id, seq))
+        _metrics.reset_metrics()
+        with faults.scoped("drop@recv.generate:0") as plan:
+            out = router.generate("m", [3, 1], max_new_tokens=2)
+        drops = [s for _k, s, _i in plan.injected()]
+        check(drops == ["recv.generate"] and len(out["tokens"]) == 2,
+              "dropped reply answered on retransmit")
+        check(_metrics.counter("rpc.server.dedup_hits").value() == 1
+              and _metrics.counter("serving.decode.requests").value() == 1,
+              "retransmit was dedup-answered, NOT re-executed "
+              "(1 dedup hit, 1 engine request)")
+        # 4b: killed replica = failover to the survivor. A long
+        # scrape-TTL router holds a cached load snapshot in which r0
+        # (more free pages: r1 gets some pinned) ranks FIRST, so the
+        # post-kill request deterministically contacts the dead r0,
+        # fails over, and lands on r1.
+        router2 = FleetRouter(ctl_addr, scrape_ttl=60.0, replica_ttl=60.0)
+        try:
+            held1 = alloc1.alloc(99003, 4 * alloc1.page_size)
+            del held1
+            out = router2.generate("m", [1], max_new_tokens=1)
+            check(len(out["tokens"]) == 1, "pre-kill probe through r0")
+            servers[0].kill()  # SIGKILL-shaped: connections sever
+            base_fo = _metrics.counter("fleet.failovers").value()
+            out = router2.generate("m", [2, 4], max_new_tokens=2)
+            check(len(out["tokens"]) == 2,
+                  "request answered after replica kill")
+            fo = _metrics.counter("fleet.failovers").value() - base_fo
+            check(fo == 1, f"exactly one failover for the kill ({fo})")
+            alloc1.free(99003)
+        finally:
+            router2.close()
+    finally:
+        router.close()
+        for m in members:
+            m.stop(deregister=False)
+        for srv in servers:
+            try:
+                srv.shutdown(drain=False)
+            except Exception:
+                pass
+        ctl.shutdown()
+
+    if failures:
+        print(f"fleet selftest: {len(failures)} FAILURE(S): {failures}")
+        return 1
+    print("fleet selftest: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m paddle_tpu.fleet")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the in-process end-to-end selftest")
+    ap.add_argument("--controller", action="store_true",
+                    help="run a FleetController until interrupted")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--lease-ttl", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    _force_cpu()
+    if args.controller:
+        from . import FleetController
+
+        ctl = FleetController(lease_ttl=args.lease_ttl)
+        host, port = ctl.serve(args.host, args.port)
+        print(f"fleet controller on {host}:{port} (ctrl-c to stop)")
+        try:
+            import time
+
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            ctl.shutdown()
+        return 0
+    return run_selftest()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

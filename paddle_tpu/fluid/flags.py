@@ -174,6 +174,16 @@ FLAGS: Dict[str, Any] = _Flags({
     # measure-or-model session seeds measured values). 1 = chunking
     # off (bitwise the PR 6 one-token-per-step behavior)
     "prefill_chunk": 16,
+    # serving fleet (paddle_tpu/fleet, ISSUE 11). Replica lease TTL in
+    # seconds: a replica that misses heartbeats for this long is
+    # evicted from the routing table (the pserver heartbeat/eviction
+    # discipline applied to serving replicas; members beat at ttl/3)
+    "fleet_lease_ttl": 5.0,
+    # router-side load-report cache TTL in seconds: how stale a scraped
+    # per-replica load report (free KV pages, queue depths) may be
+    # before the next routing decision re-scrapes. Small = accurate
+    # balancing, large = fewer load_report RPCs per routed request
+    "fleet_scrape_ttl": 0.25,
 })
 
 

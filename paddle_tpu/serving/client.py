@@ -128,6 +128,13 @@ class ServingClient:
     def list_models(self) -> Dict[str, Any]:
         return self._rpc.call("list_models")
 
+    def load_report(self) -> Dict[str, Any]:
+        """Structured per-model load snapshot (free KV pages, live
+        slots, queue depths, model/version set) — the routing signal;
+        idempotent server-side, so scraping it never occupies
+        dedup-cache slots."""
+        return self._rpc.call("load_report")
+
     def health(self) -> Dict[str, Any]:
         return self._rpc.call("health")
 

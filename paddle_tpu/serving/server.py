@@ -10,6 +10,12 @@ Methods (all fire the `serving.<method>` fault site before running, so
 `PADDLE_TPU_FAULTS='error@serving.infer:0'` chaos plans reach them):
 
     infer(model, feeds, deadline_ms)   -> {model, version, outputs}
+    load_report()                      -> structured per-model load:
+                                          free KV pages / live slots
+                                          (decoders), queue depths,
+                                          model/version set — the
+                                          signal a FleetRouter balances
+                                          on (paddle_tpu/fleet)
     generate(model, prompt, max_new_tokens, deadline_ms)
                                        -> {model, version, tokens,
                                            prompt_len}  (decoders)
@@ -35,8 +41,11 @@ Memory sizing note: the dedup cache holds recent infer RESPONSES (up
 to `dedup_cap`, held >= 900s, 4x-cap safety valve — see
 rpc._DedupCache); budget `dedup_cap x typical response bytes` of
 serving-host RAM, and shrink `dedup_cap` for models with large
-outputs. `health`/`list_models` are declared idempotent: cheap reads
-whose responses must not occupy dedup-cache slots. Overload/deadline/
+outputs. `health`/`list_models`/`load_report` are declared idempotent:
+cheap reads whose responses must not occupy dedup-cache slots —
+`load_report` especially, because a router scrapes it on the ROUTING
+path (once per scrape-TTL window per replica) and a load snapshot
+pinned in the dedup cache would be both stale and wasted memory. Overload/deadline/
 not-found rejections are application errors — RpcClient never retries
 them, so a shedding server is not hammered by its own rejects.
 
@@ -92,12 +101,13 @@ class ServingServer:
             "load_decoder": self._load_decoder,
             "unload_model": self._unload_model,
             "list_models": self._list_models,
+            "load_report": self._load_report,
             "health": self._health,
         }
         self._rpc = RpcServer(
             {m: self._guarded(m, fn) for m, fn in handlers.items()},
             dedup_cap=dedup_cap,
-            idempotent={"health", "list_models"},
+            idempotent={"health", "list_models", "load_report"},
         )
         # serializes load_model end-to-end: auto-versioning is a
         # read-then-deploy sequence, and two concurrent deploys of one
@@ -144,6 +154,16 @@ class ServingServer:
         _debug.remove_status(getattr(self, "_status_name", None))
         self._rpc.shutdown()
         self._registry.unload_all(drain=drain)
+
+    def kill(self):
+        """Chaos seam: die the way a SIGKILLed replica dies — the
+        transport severs every established connection mid-whatever
+        (peers see resets, lost replies, refused dials), and NOTHING is
+        drained or unloaded: engines keep whatever they were doing,
+        answers go nowhere. The fleet chaos tests kill replicas with
+        this; a FleetRouter must fail the traffic over."""
+        _debug.remove_status(getattr(self, "_status_name", None))
+        self._rpc.kill()
 
     def _status(self) -> Dict[str, Any]:
         return {"models": self._registry.stats(),
@@ -295,6 +315,36 @@ class ServingServer:
 
     def _list_models(self) -> Dict[str, Any]:
         return self._registry.stats()
+
+    def _load_report(self) -> Dict[str, Any]:
+        """Cheap structured load snapshot for capacity-aware routing
+        (ISSUE 11 satellite). One dict per loaded model with the signal
+        the FleetRouter balances on: free KV pages + live/max slots for
+        decoders (the *Ragged Paged Attention* page-table view of
+        remaining capacity), queue depth vs bound for both kinds, and
+        the model/version set a rollout driver polls for convergence.
+        A few lock-guarded dict reads per model — no Prometheus text to
+        parse, no histogram walks — and declared idempotent so a
+        router's scrape cadence never pins the dedup cache."""
+        models: Dict[str, Any] = {}
+        for name, st in self._registry.stats().items():
+            entry: Dict[str, Any] = {
+                "version": st["version"],
+                "kind": st["kind"],
+                "queue_depth": st["queue_depth"],
+                "max_queue": st["max_queue"],
+                "stopping": st["stopping"],
+            }
+            if st["kind"] == "decoder":
+                kv = st["kv"]
+                entry["free_pages"] = kv["pages_free"]
+                entry["pages_total"] = kv["pages_total"]
+                entry["page_size"] = kv["page_size"]
+                entry["live_slots"] = st["live"]
+                entry["max_slots"] = max(st["slots"])
+                entry["max_seq_len"] = st["max_seq_len"]
+            models[name] = entry
+        return {"ok": True, "models": models}
 
     def _health(self) -> Dict[str, Any]:
         return {"ok": True, "models": self._registry.names()}

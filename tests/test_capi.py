@@ -56,8 +56,18 @@ def _cc():
     return shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
 
 
+def _mt_threads():
+    """Scale the multithreaded consumer to the machine: 4 embedded
+    interpreters time-slicing ONE core blew the subprocess timeout on a
+    box reporting nproc=1 (reproduced on the unmodified seed) — the
+    test is about per-thread-predictor agreement, not about
+    oversubscription, so 2 threads on a small box proves the same
+    thing in a fraction of the wall."""
+    return max(2, min(4, os.cpu_count() or 1))
+
+
 def _compile_and_run_consumer(tmp_path, src_name, exe_name, model_dir,
-                              extra_flags=()):
+                              extra_flags=(), extra_args=()):
     """Build libpaddle_tpu_capi.so, compile csrc/<src_name> against it, and
     run it on model_dir in a hermetic CPU env (the axon site hook
     re-registers the TPU backend in every process and a wedged tunnel
@@ -81,8 +91,13 @@ def _compile_and_run_consumer(tmp_path, src_name, exe_name, model_dir,
           if p and "axon" not in p]
     env["PYTHONPATH"] = os.pathsep.join([REPO] + pp)
     env["JAX_PLATFORMS"] = "cpu"
-    r = subprocess.run([exe_path, model_dir], capture_output=True, text=True,
-                       env=env, timeout=300)
+    # the timeout scales with contention the same way the workload
+    # does: a 1-core box runs the threads (and the whole tier-1 suite
+    # around them) serially, so give it double the normal budget
+    timeout = 300 if (os.cpu_count() or 1) >= 2 else 600
+    r = subprocess.run([exe_path, model_dir, *map(str, extra_args)],
+                       capture_output=True, text=True,
+                       env=env, timeout=timeout)
     assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr[-2000:]}"
     return r.stdout
 
@@ -110,9 +125,11 @@ def test_c_consumer_multithreaded(tmp_path):
     each with its own predictor over one saved model; outputs must agree
     (and match Python)."""
     model_dir, expect = _save_model(str(tmp_path))
+    n = _mt_threads()
     out = _compile_and_run_consumer(tmp_path, "test_capi_mt_consumer.c",
                                     "mt_consumer", model_dir,
-                                    extra_flags=("-lpthread",))
-    assert "threads=4 agree" in out
+                                    extra_flags=("-lpthread",),
+                                    extra_args=(n,))
+    assert f"threads={n} agree" in out
     np.testing.assert_allclose(_fetch_values(out), expect.ravel(),
                                rtol=1e-4, atol=1e-5)

@@ -725,6 +725,38 @@ def test_serving_registry_families_collected():
         assert k in defined
 
 
+def test_fleet_registry_families_collected():
+    """ISSUE 11 satellite: the fleet subsystem's fault sites, metric/
+    span names, and FLAGS keys are first-class registry members —
+    drift in any of them is an N201/N202/N203 error, not silence."""
+    pkg = invariants._repo_root() + "/paddle_tpu"
+    exact_sites, site_patterns = invariants.collect_declared_sites(pkg)
+    # the controller's f-string family fire(f"fleet.{method}") declares
+    # the wildcard; the rollout's per-deploy site is exact
+    assert "fleet.*" in site_patterns
+    assert "fleet.rollout.deploy" in exact_sites
+    names = invariants.collect_declared_names(pkg)
+    universe = invariants.NameUniverse(names,
+                                       (exact_sites, site_patterns))
+    for n in ("fleet.registrations", "fleet.evictions",
+              "fleet.heartbeats", "fleet.intents", "fleet.replicas",
+              "fleet.sheds", "fleet.failovers", "fleet.scrapes",
+              "fleet.scrape_errors", "fleet.route_ms",
+              "fleet.request_ms", "fleet.route", "fleet.rollout",
+              "fleet.rollouts", "fleet.member.converges",
+              "fleet.member.converge_errors"):
+        assert universe.resolves(n), n
+    # the per-replica dynamic series registered as f-string patterns
+    for prefix in ("fleet.replica_up.", "fleet.routed.",
+                   "fleet.replica_free_pages.",
+                   "fleet.replica_queue_depth."):
+        assert any(p.startswith(prefix) for p in names[1]), prefix
+    defined = invariants.collect_defined_flags(
+        invariants._repo_root() + "/paddle_tpu/fluid/flags.py")
+    for k in ("fleet_lease_ttl", "fleet_scrape_ttl"):
+        assert k in defined
+
+
 def test_flags_keys_all_defined():
     root = invariants._repo_root()
     defined = invariants.collect_defined_flags(
