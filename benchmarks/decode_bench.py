@@ -45,7 +45,17 @@ Env knobs:
     DEC_LP_PROMPT_MAX  long-prompt max length     (default 256; smoke 24)
     DEC_LP_NEW         tokens generated per long request (default 4)
     DEC_LP_CHUNK       prefill chunk for the chunked row  (default 16)
+    DEC_ST_NEW         tokens generated per client-streaming request
+                       (default 32; the streamed-vs-buffered contrast
+                       IS the decode tail the buffered client waits out)
     --smoke            tiny fixed run for CI's slow lane
+
+Client-streaming section (ISSUE 12 -> BENCH_SESSION_r10.json): the
+long prompts again, but served over a REAL ServingServer RPC pair with
+`generate(stream=True)` vs buffered — per request, the number of
+decode steps that had run when the client held its FIRST token
+(streamed ≈ ceil(P/chunk); buffered = the whole sequence), the
+counter-based form of time-to-first-token at the wire.
 """
 import json
 import math
@@ -73,6 +83,10 @@ LP_PROMPT_MAX = int(os.environ.get("DEC_LP_PROMPT_MAX",
                                    "24" if SMOKE else "256"))
 LP_NEW = int(os.environ.get("DEC_LP_NEW", "2" if SMOKE else "4"))
 LP_CHUNK = int(os.environ.get("DEC_LP_CHUNK", "4" if SMOKE else "16"))
+# client-streaming section (ISSUE 12): generate enough tokens that
+# buffered delivery visibly pays the whole sequence before the first
+# token reaches the client
+ST_NEW = int(os.environ.get("DEC_ST_NEW", "8" if SMOKE else "32"))
 if PROMPT_MAX >= MAXSEQ:
     sys.exit(f"DEC_PROMPT_MAX ({PROMPT_MAX}) must be < DEC_MAXSEQ "
              f"({MAXSEQ}): every sequence needs room for >= 1 new token")
@@ -257,6 +271,83 @@ def run_reprefill(spec, workload):
     }
 
 
+def run_client_stream_section(spec, workload, chunk, max_seq_len):
+    """Time-to-first-TOKEN **at the client** (ISSUE 12): the same long
+    prompts served over a real ServingServer/ServingClient RPC pair,
+    once with `generate(stream=True)` (token frames as they decode)
+    and once buffered (the whole sequence at return). Evidence is
+    counter-based per the r07/r08 convention: for each request we
+    record how many DECODE STEPS had run when the client held its
+    first token — streamed ≈ ceil(P/chunk) (plus scheduler racing),
+    buffered = the whole sequence's steps, because the first token
+    only exists client-side when the last one does. Requests run
+    sequentially so the per-request step deltas are exact."""
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving import ServingClient, ServingServer
+
+    pages = 2 + max(-(-(len(p) + n) // PAGE) for p, n in workload)
+    srv = ServingServer()
+    addr = srv.serve()
+    cli = ServingClient(addr)
+    steps_c = metrics.counter("serving.decode.steps")
+    try:
+        cli.load_decoder("bench_stream", spec.to_dict(), slots=[1],
+                         page_size=PAGE, num_pages=pages,
+                         max_seq_len=max_seq_len, prefill_chunk=chunk)
+        rows = {"streamed": [], "buffered": []}
+        for prompt, max_new in workload:
+            base = steps_c.value()
+            t0 = time.perf_counter()
+            s = cli.generate("bench_stream", [int(t) for t in prompt],
+                             max_new_tokens=max_new, stream=True)
+            first = next(s)
+            steps_first = steps_c.value() - base
+            ttft_ms = (time.perf_counter() - t0) * 1e3
+            rest = list(s)
+            rows["streamed"].append({
+                "prompt": len(prompt),
+                "steps_at_first_token": int(steps_first),
+                "sttf_engine": int(s.result["steps_to_first_token"]),
+                "ttft_ms": round(ttft_ms, 2),
+                "total_steps": steps_c.value() - base,
+            })
+            base = steps_c.value()
+            t0 = time.perf_counter()
+            out = cli.generate("bench_stream", [int(t) for t in prompt],
+                               max_new_tokens=max_new)
+            ttft_ms = (time.perf_counter() - t0) * 1e3
+            steps_all = steps_c.value() - base
+            assert out["tokens"] == [first] + rest, \
+                "streamed tokens diverged from buffered (greedy!)"
+            rows["buffered"].append({
+                "prompt": len(prompt),
+                # buffered: the client's first token arrives with the
+                # LAST one — after every step of the sequence
+                "steps_at_first_token": int(steps_all),
+                "ttft_ms": round(ttft_ms, 2),
+                "total_steps": int(steps_all),
+            })
+        sf = [r["steps_at_first_token"] for r in rows["streamed"]]
+        bf = [r["steps_at_first_token"] for r in rows["buffered"]]
+        return {
+            "prefill_chunk": chunk,
+            "requests": rows,
+            "steps_at_first_token_mean": {
+                "streamed": round(float(np.mean(sf)), 2),
+                "buffered": round(float(np.mean(bf)), 2),
+            },
+            "client_sttf_speedup": round(
+                float(np.mean(bf)) / max(float(np.mean(sf)), 1e-9), 2),
+            "stream_chunks": int(metrics.counter(
+                "serving.stream.chunks").value()),
+            "stream_tokens": int(metrics.counter(
+                "serving.stream.tokens").value()),
+        }
+    finally:
+        cli.close()
+        srv.shutdown()
+
+
 def tune_prefill_chunk(spec, candidates, prompt_len):
     """Measure-or-model session for the ``prefill_chunk`` crossover
     (ISSUE 10 / PR 8): time prefilling one ``prompt_len``-token
@@ -341,6 +432,13 @@ def main() -> int:
                     / max(lp_rows["chunked"]["steps_to_first_token_mean"],
                           1e-9))
 
+    # client-side section (ISSUE 12 -> BENCH_SESSION_r10): the same
+    # long prompts over a real RPC server, streamed vs buffered —
+    # when does the CLIENT hold its first token?
+    stream_wl = [(p, ST_NEW) for p, _n in long_wl]
+    stream_section = run_client_stream_section(
+        spec, stream_wl, LP_CHUNK, max_seq_len=LP_PROMPT_MAX + ST_NEW)
+
     # the measured crossover for THIS device kind (persisted when
     # PADDLE_TPU_AUTOTUNE_DIR is set; a warm cache answers with zero
     # timed runs)
@@ -381,6 +479,7 @@ def main() -> int:
             "results": lp_rows,
             "steps_to_first_token_speedup": round(sttf_speedup, 2),
         },
+        "client_streaming": stream_section,
         "prefill_chunk_tuning": chunk_tuning,
         "shape_histogram": shape_hist,
         "derived_ladders": derived,

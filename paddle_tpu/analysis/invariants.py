@@ -250,6 +250,7 @@ class NameUniverse:
         "serving": "paddle_tpu.serving",
         "autotune": "paddle_tpu.autotune",
         "fleet": "paddle_tpu.fleet",
+        "checkpoint": "paddle_tpu.checkpoint",
     }
 
     def __init__(self, names: Tuple[Set[str], Set[str]],
@@ -562,7 +563,7 @@ def check_repo(root: Optional[str] = None) -> List[Diagnostic]:
     docs = [os.path.join(root, "docs", n)
             for n in ("OBSERVABILITY.md", "FAULT_TOLERANCE.md",
                       "STATIC_ANALYSIS.md", "SERVING.md", "AUTOTUNE.md",
-                      "FLEET.md")]
+                      "FLEET.md", "CHECKPOINT.md")]
     diags: List[Diagnostic] = []
 
     sites = collect_declared_sites(pkg)

@@ -62,6 +62,7 @@ _RUNTIME_CLASSES: Tuple[Tuple[str, str], ...] = (
     ("paddle_tpu.fleet.controller", "FleetController"),
     ("paddle_tpu.fleet.router", "FleetRouter"),
     ("paddle_tpu.fleet.member", "FleetMember"),
+    ("paddle_tpu.checkpoint.format", "CheckpointWriter"),
 )
 
 _ARMED_FLAG = "_guard_sanitizer_armed_"

@@ -1,0 +1,32 @@
+"""paddle_tpu.checkpoint — versioned, verifiable model checkpoints
+(ISSUE 12).
+
+A manifest JSON indexes per-tensor raw binary segments (dtype / shape /
+byte offset / crc32) in a nonce-named payload file; commits are atomic
+(tmp + fsync + rename, the ``master.snapshot`` torn-write discipline —
+the ``checkpoint.save`` fault site sits at the commit point for chaos
+plans), loads are chunk-verified zero-copy mmap views, and corruption
+fails with the tensor NAMED. ``save_decoder_checkpoint`` /
+``load_decoder_checkpoint`` target the serving ``DecoderSpec`` /
+``decoder_step`` contract so ``load_decoder(checkpoint_dir=...)`` can
+deploy real weights — locally, over RPC, or fleet-wide through the
+controller's intent log. See docs/CHECKPOINT.md.
+
+    python -m paddle_tpu.checkpoint inspect DIR   # manifest summary
+    python -m paddle_tpu.checkpoint verify DIR    # full checksum pass
+    python -m paddle_tpu.checkpoint --selftest    # in-process proof
+"""
+from .decoder import (expected_decoder_tensors, load_decoder_checkpoint,
+                      save_decoder_checkpoint)
+from .format import (CheckpointCorruptError, CheckpointError,
+                     CheckpointWriter, load_checkpoint_arrays,
+                     load_checkpoint_tree, read_manifest,
+                     save_checkpoint_tree)
+
+__all__ = [
+    "CheckpointError", "CheckpointCorruptError", "CheckpointWriter",
+    "save_checkpoint_tree", "load_checkpoint_tree",
+    "load_checkpoint_arrays", "read_manifest",
+    "save_decoder_checkpoint", "load_decoder_checkpoint",
+    "expected_decoder_tensors",
+]

@@ -1,0 +1,444 @@
+"""Versioned checkpoint format: one JSON manifest + one raw-segment
+payload file (ISSUE 12).
+
+The reference framework's whole persistence story is "programs and
+parameters are artifacts" (`fluid/io.py` save/load discipline); this
+module is the parameter half done properly for serving-scale tensors:
+
+  - the PAYLOAD (``segments-<nonce>.bin``) is every tensor's raw bytes
+    back to back, 64-byte aligned, written once and never modified;
+  - the MANIFEST (``manifest.json``) indexes it: per tensor the dtype,
+    shape, byte offset, byte length, and a crc32 — plus the nested
+    container skeleton (dict/tuple/list) the flat names were flattened
+    from, and a caller ``meta`` dict (a decoder checkpoint stores its
+    ``DecoderSpec`` there);
+  - COMMIT is the manifest rename: payloads carry a fresh nonce per
+    save and the manifest is written tmp + fsync + atomic
+    ``os.replace`` (the ``master.snapshot``/``TuningCache`` torn-write
+    discipline). A crash anywhere before the rename — the
+    ``checkpoint.save`` fault site sits right there — leaves the
+    previous manifest pointing at the previous payload, both intact;
+    orphaned payloads from crashed saves are garbage-collected by the
+    next successful commit;
+  - LOADS are chunked-verified, zero-copy: the payload is mmap'd
+    read-only, each segment's crc32 is folded in bounded chunks (no
+    whole-file read), and the returned arrays are non-writeable views
+    straight over the map — the same receive-side discipline as
+    ``rpc.from_wire(copy=False)``. A truncated or bit-flipped segment
+    fails with a typed error NAMING the tensor, not a shape error
+    three layers into the model.
+"""
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import threading
+import uuid
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..distributed import faults as _faults
+from ..observability import metrics as _metrics, tracing as _tracing
+from ..observability.log import get_logger
+
+__all__ = ["CheckpointError", "CheckpointCorruptError", "CheckpointWriter",
+           "save_checkpoint_tree", "load_checkpoint_tree",
+           "load_checkpoint_arrays", "read_manifest", "MANIFEST_NAME",
+           "FORMAT_VERSION"]
+
+_log = get_logger("checkpoint")
+
+MANIFEST_NAME = "manifest.json"
+FORMAT_VERSION = 1
+# segment alignment inside the payload: mmap-view friendly for every
+# numeric dtype, and matches the allocator granularity most filesystems
+# round to anyway
+_ALIGN = 64
+# crc folding chunk: verification touches the map in bounded pieces so
+# a multi-GiB tensor never needs a contiguous read buffer
+_CRC_CHUNK = 1 << 20
+
+_m_saves = _metrics.counter("checkpoint.saves")
+_m_loads = _metrics.counter("checkpoint.loads")
+_m_bytes_written = _metrics.counter("checkpoint.bytes_written")
+_m_bytes_read = _metrics.counter("checkpoint.bytes_read")
+# verification failures (crc mismatch / truncation) — the counter a
+# fleet operator alerts on: a nonzero value means storage corrupted a
+# deployed artifact
+_m_corrupt = _metrics.counter("checkpoint.corrupt")
+
+# serializes whole commits (payload write -> manifest rename -> orphan
+# GC) within this process, the TuningCache._flush_mu discipline:
+# without it, committer A's GC could delete committer B's fully-written
+# but not-yet-referenced nonce payload (or its tmp manifest), leaving
+# B's manifest pointing at nothing. CROSS-process writers to one
+# directory are the caller's exclusion problem — same contract as every
+# one-writer artifact in this repo (master.snapshot, save_checkpoint).
+_commit_mu = threading.Lock()
+
+
+class CheckpointError(IOError):
+    """A checkpoint artifact is missing, unreadable, or structurally
+    wrong (bad format version, unknown tensor set). Typed so serving
+    deploy paths surface it as-is instead of a deep KeyError."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A specific tensor's bytes failed verification (crc mismatch or
+    truncation). Carries ``tensor`` — the load path names the victim
+    instead of letting a garbled weight surface as a shape error three
+    layers into the model."""
+
+    def __init__(self, msg: str, tensor: Optional[str] = None):
+        super().__init__(msg)
+        self.tensor = tensor
+
+
+# --- tree flatten / unflatten -------------------------------------------
+
+def _flatten(tree, prefix: str = "", out: Optional[OrderedDict] = None):
+    """Flatten a nested dict/tuple/list parameter tree into
+    ``{"a/b/0": ndarray}`` plus a JSON-able skeleton that remembers the
+    container types (tuples restore as tuples — the decoder contract's
+    ``(gamma, beta)`` layer-norm pairs)."""
+    if out is None:
+        out = OrderedDict()
+    if isinstance(tree, dict):
+        skel = {}
+        for k in tree:
+            k = str(k)
+            if "/" in k:
+                raise CheckpointError(
+                    f"tree key {k!r} contains '/', the flatten separator")
+            _, skel[k] = _flatten(tree[k], f"{prefix}{k}/", out)
+        return out, {"d": skel}
+    if isinstance(tree, (tuple, list)):
+        skels = []
+        for i, v in enumerate(tree):
+            _, s = _flatten(v, f"{prefix}{i}/", out)
+            skels.append(s)
+        return out, {("t" if isinstance(tree, tuple) else "l"): skels}
+    # leaf: anything numpy can view as an n-d array of a plain dtype
+    arr = np.asarray(tree)
+    if arr.dtype == object:
+        raise CheckpointError(
+            f"tensor '{prefix[:-1]}' has object dtype — checkpoints "
+            "hold raw numeric segments only")
+    name = prefix[:-1]
+    out[name] = arr
+    return out, name
+
+
+def _unflatten(skel, arrays: Dict[str, Any]):
+    if isinstance(skel, str):
+        return arrays[skel]
+    if "d" in skel:
+        return {k: _unflatten(v, arrays) for k, v in skel["d"].items()}
+    if "t" in skel:
+        return tuple(_unflatten(v, arrays) for v in skel["t"])
+    if "l" in skel:
+        return [_unflatten(v, arrays) for v in skel["l"]]
+    raise CheckpointError(f"malformed manifest tree node {skel!r}")
+
+
+# --- writer -------------------------------------------------------------
+
+class CheckpointWriter:
+    """Staged, atomically-committed checkpoint writer.
+
+    ``add()`` stages tensors (thread-safe — a sharded exporter may
+    stage from several producer threads); ``commit()`` writes the
+    payload + manifest with the torn-write discipline and returns the
+    manifest path. A writer commits SUCCESSFULLY at most once — a
+    commit that failed (ENOSPC, injected crash) leaves the staged
+    tensors intact and may simply be retried.
+    """
+
+    def __init__(self, dirname: str, meta: Optional[Dict[str, Any]] = None):
+        self._dirname = str(dirname)
+        self._meta = dict(meta or {})
+        self._mu = threading.Lock()
+        self._staged: "OrderedDict[str, np.ndarray]" = \
+            OrderedDict()  # guarded-by: _mu
+        self._tree_skel: Any = None  # guarded-by: _mu
+        self._committed = False  # guarded-by: _mu
+        self._committing = False  # guarded-by: _mu
+
+    def add(self, name: str, array) -> None:
+        """Stage one tensor under a flat name."""
+        arr = np.ascontiguousarray(np.asarray(array))
+        if arr.dtype == object:
+            raise CheckpointError(
+                f"tensor '{name}' has object dtype — checkpoints hold "
+                "raw numeric segments only")
+        with self._mu:
+            if self._committed:
+                raise CheckpointError("writer already committed")
+            self._staged[str(name)] = arr
+
+    def add_tree(self, tree) -> None:
+        """Stage a whole nested parameter tree (dict/tuple/list of
+        arrays); the container skeleton is recorded in the manifest so
+        ``load_checkpoint_tree`` restores the exact structure."""
+        flat, skel = _flatten(tree)
+        with self._mu:
+            if self._committed:
+                raise CheckpointError("writer already committed")
+            for k, v in flat.items():
+                self._staged[k] = np.ascontiguousarray(v)
+            self._tree_skel = skel
+
+    def commit(self) -> str:
+        """Write payload + manifest atomically; returns the manifest
+        path. The ``checkpoint.save`` fault site fires between the
+        fsynced tmp manifest and the committing rename — a crash there
+        (chaos-tested) leaves the PREVIOUS checkpoint fully intact."""
+        with self._mu:
+            if self._committed:
+                raise CheckpointError("writer already committed")
+            if self._committing:
+                raise CheckpointError("commit already in progress")
+            self._committing = True
+            staged = list(self._staged.items())
+            skel = self._tree_skel
+        try:
+            if not staged:
+                raise CheckpointError("nothing staged — empty checkpoint")
+            dirname, meta = self._dirname, self._meta
+            os.makedirs(dirname, exist_ok=True)
+            # lint: allow-blocking — commits serialize by design (see
+            # _commit_mu above); file I/O dominates, contention is rare
+            with _commit_mu:
+                path = self._commit_locked(dirname, meta, staged, skel)
+        except BaseException:
+            # a FAILED commit (ENOSPC, crash-site fault, ...) must not
+            # poison the writer: nothing reached the manifest rename,
+            # the staged tensors are intact, and a retry after the
+            # operator clears the condition is the whole point of the
+            # torn-write discipline.
+            # Not a lost-update: only the thread that WON the first
+            # section (set _committing) can reach these writes, so the
+            # released-lock window has no competing writer by
+            # construction.
+            # lint: allow-unguarded(_committing)
+            with self._mu:
+                self._committing = False
+            raise
+        # same single-winner argument as the failure arm above
+        # lint: allow-unguarded(_committed, _committing)
+        with self._mu:
+            self._committed = True
+            self._committing = False
+        return path
+
+    def _commit_locked(self, dirname, meta, staged, skel) -> str:
+        nonce = uuid.uuid4().hex[:12]
+        payload_name = f"segments-{nonce}.bin"
+        payload_path = os.path.join(dirname, payload_name)
+        tensors: List[Dict[str, Any]] = []
+        written = 0
+        with _tracing.span("checkpoint.save", dir=dirname,
+                           tensors=len(staged)):
+            # the payload's name is nonce-fresh and nothing references
+            # it until the manifest rename lands, so it can be written
+            # in place: a crash mid-write leaves an orphan the next
+            # successful commit sweeps
+            with open(payload_path, "wb") as f:
+                off = 0
+                for name, arr in staged:
+                    pad = (-off) % _ALIGN
+                    if pad:
+                        f.write(b"\0" * pad)
+                        off += pad
+                    raw = arr.tobytes()
+                    f.write(raw)
+                    tensors.append({
+                        "name": name,
+                        "dtype": str(arr.dtype),
+                        "shape": list(arr.shape),
+                        "offset": off,
+                        "nbytes": len(raw),
+                        "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+                    })
+                    off += len(raw)
+                    written += len(raw)
+                f.flush()
+                os.fsync(f.fileno())
+            manifest = {
+                "format": FORMAT_VERSION,
+                "payload": payload_name,
+                "meta": meta,
+                "tensors": tensors,
+            }
+            if skel is not None:
+                manifest["tree"] = skel
+            # unique tmp per writer: a crashed commit's abandoned tmp
+            # never collides with a retry's
+            tmp = os.path.join(
+                dirname,
+                f"{MANIFEST_NAME}.tmp.{os.getpid()}.{threading.get_ident()}")
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            _faults.fire("checkpoint.save")
+            os.replace(tmp, os.path.join(dirname, MANIFEST_NAME))
+            self._gc(dirname, payload_name)
+        _m_saves.inc()
+        _m_bytes_written.inc(written)
+        _log.info("checkpoint committed: %s (%d tensors, %d bytes)",
+                  dirname, len(tensors), written)
+        return os.path.join(dirname, MANIFEST_NAME)
+
+    @staticmethod
+    def _gc(dirname: str, keep_payload: str) -> None:
+        """Sweep payloads/tmp manifests that crashed saves abandoned —
+        only after OUR manifest committed, so a concurrent reader of
+        the previous checkpoint never loses its payload mid-load within
+        the same save that replaces it (readers mmap before the GC of
+        the NEXT save can touch their file)."""
+        for n in os.listdir(dirname):
+            stale = ((n.startswith("segments-") and n.endswith(".bin")
+                      and n != keep_payload)
+                     or n.startswith(f"{MANIFEST_NAME}.tmp."))
+            if stale:
+                try:
+                    os.remove(os.path.join(dirname, n))
+                except OSError:  # pragma: no cover - racing GC is fine
+                    pass
+
+
+def save_checkpoint_tree(dirname: str, tree,
+                         meta: Optional[Dict[str, Any]] = None) -> str:
+    """One-shot: flatten + stage + commit a nested parameter tree."""
+    w = CheckpointWriter(dirname, meta=meta)
+    w.add_tree(tree)
+    return w.commit()
+
+
+# --- reader -------------------------------------------------------------
+
+def read_manifest(dirname: str) -> Dict[str, Any]:
+    """Parse + structurally validate the manifest. Typed errors name
+    the offending path; corrupt JSON is a CheckpointError, not a
+    JSONDecodeError from three layers down."""
+    if not os.path.isdir(dirname):
+        raise CheckpointError(
+            f"checkpoint directory '{dirname}' does not exist")
+    path = os.path.join(dirname, MANIFEST_NAME)
+    if not os.path.exists(path):
+        raise CheckpointError(
+            f"no manifest at '{path}' — is '{dirname}' a checkpoint "
+            "directory? (save_checkpoint_tree / save_decoder_checkpoint "
+            "write one)")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (ValueError, OSError) as e:
+        raise CheckpointError(f"manifest '{path}' unreadable: {e}") from e
+    if not isinstance(manifest, dict) or "tensors" not in manifest \
+            or "payload" not in manifest:
+        raise CheckpointError(f"manifest '{path}' is not a checkpoint "
+                              "manifest (missing payload/tensors)")
+    fmt = manifest.get("format")
+    if fmt != FORMAT_VERSION:
+        raise CheckpointError(
+            f"manifest '{path}' has format version {fmt!r}; this "
+            f"reader understands {FORMAT_VERSION}")
+    return manifest
+
+
+def load_checkpoint_arrays(dirname: str, verify: bool = True
+                           ) -> Tuple[Dict[str, np.ndarray],
+                                      Dict[str, Any]]:
+    """Load the flat ``{name: array}`` map. Arrays are NON-WRITEABLE
+    zero-copy views over the mmap'd payload (the map stays alive
+    exactly as long as the arrays). ``verify=True`` folds each
+    segment's crc32 in bounded chunks first; a mismatch or a truncated
+    payload raises ``CheckpointCorruptError`` naming the tensor."""
+    manifest = read_manifest(dirname)
+    payload_path = os.path.join(dirname, manifest["payload"])
+    if not os.path.exists(payload_path):
+        # a CONCURRENT cross-process save may have committed between
+        # our manifest read and here — its GC unlinks the payload our
+        # (now stale) manifest references. Re-read once: a fresh
+        # manifest naming a DIFFERENT payload means the directory is
+        # healthy and simply moved on; the same payload still missing
+        # means it really was deleted out from under the manifest.
+        fresh = read_manifest(dirname)
+        if fresh["payload"] != manifest["payload"]:
+            manifest = fresh
+            payload_path = os.path.join(dirname, manifest["payload"])
+    if not os.path.exists(payload_path):
+        raise CheckpointError(
+            f"manifest references missing payload '{payload_path}' — "
+            "the checkpoint directory was partially deleted")
+    size = os.path.getsize(payload_path)
+    with _tracing.span("checkpoint.load", dir=dirname,
+                       tensors=len(manifest["tensors"])):
+        f = open(payload_path, "rb")
+        try:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) \
+                if size else b""
+        finally:
+            # the map holds its own reference to the file
+            f.close()
+        out: Dict[str, np.ndarray] = {}
+        read = 0
+        for t in manifest["tensors"]:
+            name = str(t["name"])
+            off, nbytes = int(t["offset"]), int(t["nbytes"])
+            if off < 0 or off + nbytes > size:
+                _m_corrupt.inc()
+                raise CheckpointCorruptError(
+                    f"tensor '{name}' is truncated: segment "
+                    f"[{off}, {off + nbytes}) exceeds payload size "
+                    f"{size} ('{payload_path}')", tensor=name)
+            if verify:
+                crc = 0
+                for c0 in range(off, off + nbytes, _CRC_CHUNK):
+                    c1 = min(c0 + _CRC_CHUNK, off + nbytes)
+                    crc = zlib.crc32(mm[c0:c1], crc)
+                if (crc & 0xFFFFFFFF) != int(t["crc32"]):
+                    _m_corrupt.inc()
+                    raise CheckpointCorruptError(
+                        f"tensor '{name}' failed its checksum "
+                        f"(crc {crc & 0xFFFFFFFF:#010x} != manifest "
+                        f"{int(t['crc32']):#010x}) — '{payload_path}' "
+                        "is corrupt", tensor=name)
+            dtype = np.dtype(str(t["dtype"]))
+            count = int(np.prod(t["shape"], dtype=np.int64)) \
+                if t["shape"] else 1
+            if count * dtype.itemsize != nbytes:
+                _m_corrupt.inc()
+                raise CheckpointCorruptError(
+                    f"tensor '{name}' declares shape {t['shape']} "
+                    f"({count} x {dtype}) but {nbytes} payload bytes",
+                    tensor=name)
+            arr = np.frombuffer(mm, dtype=dtype, count=count,
+                                offset=off).reshape(t["shape"])
+            out[name] = arr  # read-only view over the map: zero-copy
+            read += nbytes
+    _m_loads.inc()
+    _m_bytes_read.inc(read)
+    return out, manifest
+
+
+def load_checkpoint_tree(dirname: str, verify: bool = True
+                         ) -> Tuple[Any, Dict[str, Any]]:
+    """Load and restore the nested tree structure (dicts/tuples/lists
+    as saved). Returns ``(tree, manifest)``."""
+    arrays, manifest = load_checkpoint_arrays(dirname, verify=verify)
+    skel = manifest.get("tree")
+    if skel is None:
+        return dict(arrays), manifest
+    try:
+        return _unflatten(skel, arrays), manifest
+    except KeyError as e:
+        raise CheckpointError(
+            f"manifest tree references tensor {e.args[0]!r} that the "
+            "tensor index does not declare") from e

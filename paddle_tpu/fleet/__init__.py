@@ -21,9 +21,10 @@ from .controller import FleetController
 from .member import FleetMember
 from .rollout import (RolloutDriver, RolloutError, decoder_artifact,
                       model_artifact)
-from .router import FleetRouter, NoReplicasError
+from .router import FleetRouter, FleetTokenStream, NoReplicasError
 
 __all__ = [
-    "FleetController", "FleetMember", "FleetRouter", "NoReplicasError",
+    "FleetController", "FleetMember", "FleetRouter", "FleetTokenStream",
+    "NoReplicasError",
     "RolloutDriver", "RolloutError", "decoder_artifact", "model_artifact",
 ]

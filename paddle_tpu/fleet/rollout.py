@@ -63,13 +63,26 @@ class RolloutError(ServingError):
     served — a failed rollout never takes capacity down."""
 
 
-def decoder_artifact(spec: Dict[str, Any], **engine_kwargs
-                     ) -> Dict[str, Any]:
-    """Artifact descriptor for a DecodeEngine deploy (`spec` is a
-    DecoderSpec dict; engine kwargs = slots/page_size/num_pages/
-    max_seq_len/max_queue/prefill_chunk pass through load_decoder)."""
-    return {"action": "load_decoder",
-            "payload": {"spec": dict(spec), **engine_kwargs}}
+def decoder_artifact(spec: Optional[Dict[str, Any]] = None,
+                     checkpoint_dir: Optional[str] = None,
+                     **engine_kwargs) -> Dict[str, Any]:
+    """Artifact descriptor for a DecodeEngine deploy. ``spec`` (a
+    DecoderSpec dict) deploys the deterministic seed decoder;
+    ``checkpoint_dir`` deploys REAL weights from a manifest checkpoint
+    (ISSUE 12 — the path must be readable on every replica host, same
+    shared-storage assumption as model_artifact). Either alone works;
+    both together cross-validate. Engine kwargs = slots/page_size/
+    num_pages/max_seq_len/max_queue/prefill_chunk pass through
+    load_decoder."""
+    if spec is None and checkpoint_dir is None:
+        raise ValueError(
+            "decoder_artifact needs a spec dict or a checkpoint_dir")
+    payload: Dict[str, Any] = dict(engine_kwargs)
+    if spec is not None:
+        payload["spec"] = dict(spec)
+    if checkpoint_dir is not None:
+        payload["checkpoint_dir"] = str(checkpoint_dir)
+    return {"action": "load_decoder", "payload": payload}
 
 
 def model_artifact(dirname: str, **engine_kwargs) -> Dict[str, Any]:

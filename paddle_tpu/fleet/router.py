@@ -58,11 +58,12 @@ import numpy as np
 from ..distributed.rpc import RpcClient
 from ..observability import metrics as _metrics, tracing as _tracing
 from ..observability.log import get_logger
-from ..serving.client import ServingClient
+from ..serving.client import ServingClient, TokenStream
 from ..serving.errors import (EngineRetired, ModelNotFound,
-                              ServerOverloaded, ServingError)
+                              ServerOverloaded, ServingError,
+                              StreamExpired)
 
-__all__ = ["FleetRouter", "NoReplicasError"]
+__all__ = ["FleetRouter", "FleetTokenStream", "NoReplicasError"]
 
 _log = get_logger("fleet")
 
@@ -72,6 +73,9 @@ _m_scrapes = _metrics.counter("fleet.scrapes")
 _m_scrape_errors = _metrics.counter("fleet.scrape_errors")
 _m_route_ms = _metrics.histogram("fleet.route_ms")
 _m_request_ms = _metrics.histogram("fleet.request_ms")
+# mid-stream failovers that re-established a token stream on a
+# survivor and spliced at the delivered offset (ISSUE 12)
+_m_stream_resumes = _metrics.counter("fleet.stream.resumes")
 
 
 class NoReplicasError(ServingError):
@@ -318,8 +322,10 @@ class FleetRouter:
                 serving_model, reachable)
 
     def _route(self, model: str, need_tokens: Optional[int], call):
-        """Pick-and-try loop shared by infer/generate. `call(client)`
-        performs the request on the chosen replica's persistent client."""
+        """Pick-and-try loop shared by infer/generate/stream-start.
+        ``call(client, rid)`` performs the request on the chosen
+        replica's persistent client (rid so a stream can remember which
+        replica it lives on for mid-stream failover)."""
         t0 = time.perf_counter()
         with _tracing.span("fleet.route", model=str(model)):
             tried: set = set()
@@ -358,7 +364,7 @@ class FleetRouter:
                                 f"fleet.routed.{rid}")
                     ctr.inc()
                     try:
-                        out = call(cli)
+                        out = call(cli, rid)
                         _m_request_ms.observe(
                             (time.perf_counter() - t0) * 1e3)
                         return out
@@ -427,22 +433,31 @@ class FleetRouter:
               ) -> Tuple[List[np.ndarray], int]:
         return self._route(
             str(model), None,
-            lambda cli: cli.infer(str(model), feeds,
-                                  deadline_ms=deadline_ms))
+            lambda cli, _rid: cli.infer(str(model), feeds,
+                                        deadline_ms=deadline_ms))
 
     def generate(self, model: str, prompt: Sequence[int],
                  max_new_tokens: int = 16,
                  deadline_ms: Optional[float] = None,
                  temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0) -> Dict[str, Any]:
+                 seed: int = 0, stream: bool = False):
+        """Route one decode request. ``stream=True`` returns a
+        ``FleetTokenStream`` yielding tokens as they decode, with
+        MID-STREAM failover: a replica death resumes the stream on a
+        survivor from the last delivered offset (never duplicating or
+        dropping a token — see FleetTokenStream), or fails typed."""
         prompt = [int(t) for t in prompt]
         need = len(prompt) + int(max_new_tokens)
+        kw = dict(max_new_tokens=int(max_new_tokens),
+                  deadline_ms=deadline_ms, temperature=temperature,
+                  top_k=top_k, seed=seed)
+        if stream:
+            fs = FleetTokenStream(self, str(model), prompt, kw, need)
+            fs._ensure_stream()  # surface routing errors at call time
+            return fs
         return self._route(
             str(model), need,
-            lambda cli: cli.generate(
-                str(model), prompt, max_new_tokens=int(max_new_tokens),
-                deadline_ms=deadline_ms, temperature=temperature,
-                top_k=top_k, seed=seed))
+            lambda cli, _rid: cli.generate(str(model), prompt, **kw))
 
     def replicas(self) -> List[str]:
         """Live replica ids (cached discovery view)."""
@@ -474,3 +489,144 @@ class FleetRouter:
             except OSError:  # pragma: no cover
                 pass
         self._ctl.close()
+
+    def _note_replica_death(self, rid: str, err: BaseException):
+        """Mid-stream transport death (FleetTokenStream's failover
+        path): same bookkeeping as _route's failover arm."""
+        _m_failovers.inc()
+        _log.warning("fleet router: mid-stream failover off replica %s "
+                     "(%s: %s)", rid, type(err).__name__, err)
+        # keyed pop on the failed rid alone; a concurrent wholesale
+        # refresh winning is the desired outcome
+        # lint: allow-unguarded(_replicas)
+        with self._mu:
+            self._drop_replica_locked(rid)
+            self._replicas.pop(rid, None)
+
+
+class FleetTokenStream:
+    """Streaming generate over the fleet (ISSUE 12): iterates tokens
+    from whichever replica currently serves the stream, failing over
+    MID-STREAM.
+
+    When the serving replica dies (transport error on a continuation
+    frame) or the stream expires under it (server restart), the router
+    re-routes the SAME deterministic request — greedy or seeded
+    sampling, so replay is token-identical — to a survivor and splices
+    at the last offset the caller was handed: the already-delivered
+    prefix is pulled from the new stream, VERIFIED token-by-token
+    against what was delivered, and discarded. A divergent prefix (a
+    different model version answering) raises a typed ServingError
+    instead of silently splicing wrong tokens — a resumed stream never
+    duplicates, drops, or rewrites a token. If no survivor can serve
+    the request, iteration raises the routing layer's typed errors
+    (NoReplicasError / ServerOverloaded / ModelNotFound)."""
+
+    def __init__(self, router: FleetRouter, model: str,
+                 prompt: List[int], kw: Dict[str, Any], need: int):
+        self._router = router
+        self._model = model
+        self._prompt = prompt
+        self._kw = kw
+        self._need = need
+        self._stream: Optional[TokenStream] = None
+        self._rid: Optional[str] = None
+        self._skip = 0
+        self._delivered: List[int] = []
+        self.result: Optional[Dict[str, Any]] = None
+
+    @property
+    def delivered(self) -> int:
+        """Tokens handed to the caller so far (the resume offset)."""
+        return len(self._delivered)
+
+    @property
+    def replica(self) -> Optional[str]:
+        """The replica currently serving the stream (None between
+        failovers) — chaos tests kill exactly this one."""
+        return self._rid
+
+    def _ensure_stream(self):
+        if self._stream is not None:
+            return
+        def start(cli, rid):
+            return rid, cli.generate(self._model, self._prompt,
+                                     stream=True, **self._kw)
+        self._rid, self._stream = self._router._route(
+            self._model, self._need, start)
+        self._skip = len(self._delivered)
+        if self._skip:
+            _m_stream_resumes.inc()
+            _log.info("fleet router: resuming stream for '%s' on "
+                      "replica %s from offset %d", self._model,
+                      self._rid, self._skip)
+
+    def __iter__(self) -> "FleetTokenStream":
+        return self
+
+    def __next__(self) -> int:
+        while True:
+            try:
+                self._ensure_stream()
+                while self._skip:
+                    # replaying the delivered prefix on the survivor:
+                    # verify, then discard — exactness per token
+                    t = int(next(self._stream))
+                    want = self._delivered[-self._skip]
+                    if t != want:
+                        raise ServingError(
+                            f"resumed stream for '{self._model}' on "
+                            f"replica {self._rid} diverged at offset "
+                            f"{len(self._delivered) - self._skip} "
+                            f"({t} != delivered {want}) — refusing to "
+                            "splice mismatched tokens")
+                    self._skip -= 1
+                tok = int(next(self._stream))
+            except StopIteration:
+                if self._skip:
+                    # the survivor's sequence ended BEFORE the offset
+                    # the caller already holds: never silently shorten
+                    raise ServingError(
+                        f"resumed stream for '{self._model}' on "
+                        f"replica {self._rid} ended {self._skip} "
+                        "token(s) before the delivered offset")
+                self.result = self._stream.result
+                raise
+            except StreamExpired as e:
+                # the REPLICA is healthy — only the stream is gone
+                # (idle-TTL sweep after a long consumer pause, or a
+                # server restart): re-route and splice at the delivered
+                # offset WITHOUT the replica-death bookkeeping; evicting
+                # a live replica from the table over a swept stream
+                # would shrink routing capacity and pollute the
+                # failover metrics
+                _log.warning(
+                    "fleet router: stream for '%s' expired on replica "
+                    "%s (%s); restarting from offset %d", self._model,
+                    self._rid, e, len(self._delivered))
+                self._stream = None
+                self._rid = None
+                continue
+            except (ConnectionError, OSError) as e:
+                # the serving replica died: drop it, re-route, splice
+                # at the delivered offset. Typed routing errors out of
+                # _ensure_stream (no survivor / no capacity) propagate
+                # to the caller.
+                if self._rid is not None:
+                    self._router._note_replica_death(self._rid, e)
+                self._stream = None
+                self._rid = None
+                continue
+            self._delivered.append(tok)
+            return tok
+
+    def close(self):
+        """Best-effort release of the current replica-side stream."""
+        if self._stream is not None:
+            self._stream.close()
+
+    def __enter__(self) -> "FleetTokenStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

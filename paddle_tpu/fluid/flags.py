@@ -145,6 +145,12 @@ FLAGS: Dict[str, Any] = _Flags({
     # batching timer: the oldest queued request waits at most this long
     # for batch-mates before its (possibly underfull) batch launches
     "serving_max_wait_ms": 5.0,
+    # streaming generate (ISSUE 12): a token stream nobody polls for
+    # this many seconds is presumed abandoned — the server cancels the
+    # sequence (KV pages free immediately) and later continuations get
+    # a typed StreamExpired. Generously past any sane client poll
+    # cadence (frames block at most ~20s each by default)
+    "serving_stream_ttl": 300.0,
     # decode serving (paddle_tpu/serving/decode.py, ISSUE 6). The slot
     # ladder is the decode analogue of serving_buckets: the fixed-slot
     # decode batch pads its slot count up to the next ladder entry, so

@@ -112,9 +112,35 @@ def save_params(executor, dirname, main_program=None, filename=None):
               filename=filename)
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None):
-    save_vars(executor, dirname, main_program, predicate=is_persistable,
-              filename=filename)
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      scope: Optional[Scope] = None, step: int = 0):
+    """Persist every persistable var. With ``filename`` the legacy
+    save_combine (.npz) path runs unchanged; WITHOUT one, the vars are
+    written as a `paddle_tpu.checkpoint` manifest (ISSUE 12) — one
+    writer discipline across the repo: per-tensor raw segments indexed
+    by dtype/shape/offset/crc32, committed tmp+fsync+atomic-rename, so
+    a training checkpoint gets the same torn-write safety and
+    tensor-named corruption errors a serving checkpoint gets.
+    ``load_persistables`` reads either form; `python -m
+    paddle_tpu.checkpoint verify DIR` audits the manifest form."""
+    if filename is not None:
+        save_vars(executor, dirname, main_program, scope=scope,
+                  predicate=is_persistable, filename=filename)
+        return
+    from ..checkpoint.format import save_checkpoint_tree
+
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    arrays = {}
+    for v in _collect(main_program, is_persistable):
+        val = scope.find_var(v.name)
+        if val is None:
+            raise RuntimeError(
+                f"persistable var '{v.name}' not initialized in scope — "
+                "run the startup program before saving")
+        arrays[v.name.replace("/", "__")] = np.asarray(val)
+    save_checkpoint_tree(dirname, arrays,
+                         meta={"kind": "persistables", "step": int(step)})
 
 
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
@@ -133,9 +159,33 @@ def load_params(executor, dirname, main_program=None, filename=None):
               filename=filename)
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None):
-    load_vars(executor, dirname, main_program, predicate=is_persistable,
-              filename=filename)
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      scope: Optional[Scope] = None):
+    """Inverse of save_persistables: reads the manifest form when the
+    directory holds one (checksum-verified, zero-copy), else the
+    legacy per-var/.npz op path."""
+    import jax.numpy as jnp
+
+    from ..checkpoint.format import MANIFEST_NAME, load_checkpoint_arrays
+
+    if filename is None and \
+            os.path.exists(os.path.join(dirname, MANIFEST_NAME)):
+        main_program = main_program or default_main_program()
+        scope = scope or global_scope()
+        arrays, _manifest = load_checkpoint_arrays(dirname, verify=True)
+        missing = sorted(
+            v.name for v in _collect(main_program, is_persistable)
+            if v.name.replace("/", "__") not in arrays)
+        if missing:
+            raise IOError(
+                f"checkpoint manifest in '{dirname}' lacks persistable "
+                f"var(s) {missing} that the program requires")
+        for v in _collect(main_program, is_persistable):
+            scope.set_var(v.name, jnp.asarray(
+                np.asarray(arrays[v.name.replace('/', '__')])))
+        return
+    load_vars(executor, dirname, main_program, scope=scope,
+              predicate=is_persistable, filename=filename)
 
 
 def _prune_for_inference(program: Program, feeded_var_names, target_vars):
@@ -372,14 +422,24 @@ def save_checkpoint(dirname, main_program=None, step: int = 0,
 
 
 def latest_checkpoint_step(dirname) -> Optional[int]:
-    """Step of the checkpoint META points to, or None when the directory
-    holds no (intact) checkpoint — the restart-time probe ElasticTrainer
-    uses to decide between resume and fresh start without risking
-    load_checkpoint's IOError on an empty dir."""
+    """Step of the checkpoint the directory holds, or None when it
+    holds no (intact) one — the restart-time probe ElasticTrainer uses
+    to decide between resume and fresh start without risking
+    load_checkpoint's IOError on an empty dir. Recognizes BOTH forms:
+    the legacy META (save_checkpoint) and a `paddle_tpu.checkpoint`
+    manifest whose meta carries a step (save_persistables,
+    save_decoder_checkpoint(step=))."""
     try:
         with open(os.path.join(dirname, "META")) as f:
             return int(json.load(f)["step"])
     except (OSError, ValueError, KeyError):
+        pass
+    try:
+        from ..checkpoint.format import read_manifest
+
+        step = (read_manifest(dirname).get("meta") or {}).get("step")
+        return None if step is None else int(step)
+    except (IOError, ValueError):
         return None
 
 

@@ -14,21 +14,23 @@ docs/SERVING.md.
 
     python -m paddle_tpu.serving --selftest   # in-process end-to-end
 """
-from .client import ServingClient
+from .client import ServingClient, TokenStream
 from .decode import DecodeEngine, DecoderSpec, sample_token
 from .engine import (InferenceEngine, default_buckets, parse_buckets,
                      resolve_bucket_spec)
 from .errors import (DeadlineExceeded, EngineRetired, ModelNotFound,
-                     RequestTooLarge, ServerOverloaded, ServingError)
+                     RequestTooLarge, ServerOverloaded, ServingError,
+                     StreamExpired)
 from .kv_cache import PageAllocator, PagedKvCache
 from .registry import ModelRegistry
 from .server import ServingServer
 
 __all__ = [
     "InferenceEngine", "DecodeEngine", "DecoderSpec", "ModelRegistry",
-    "ServingServer", "ServingClient", "PageAllocator", "PagedKvCache",
+    "ServingServer", "ServingClient", "TokenStream", "PageAllocator",
+    "PagedKvCache",
     "ServingError", "ServerOverloaded", "DeadlineExceeded",
-    "ModelNotFound", "RequestTooLarge", "EngineRetired",
+    "ModelNotFound", "RequestTooLarge", "EngineRetired", "StreamExpired",
     "default_buckets", "parse_buckets", "resolve_bucket_spec",
     "sample_token",
 ]

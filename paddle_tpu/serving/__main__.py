@@ -273,6 +273,24 @@ def run_selftest(verbose: bool = True) -> int:
             out2 = cli2.generate("dec", [3, 1], max_new_tokens=4)
             check(out2["version"] == 2 and out2["tokens"] == out["tokens"],
                   "decoder hot-swap flipped with identical tokens")
+            # streaming generate (ISSUE 12): same tokens, incrementally
+            s = cli2.generate("dec", [3, 1], max_new_tokens=4,
+                              stream=True)
+            check(list(s) == out["tokens"]
+                  and s.result["prompt_len"] == 2,
+                  "streamed tokens equal buffered (greedy)")
+            # checkpoint deploy (ISSUE 12): save the spec'd decoder,
+            # redeploy from the manifest, tokens bitwise identical
+            from paddle_tpu.checkpoint import save_decoder_checkpoint
+
+            ckdir = os.path.join(tmp, "dec_ck")
+            save_decoder_checkpoint(ckdir, spec)
+            cli2.load_decoder("dec_ck", checkpoint_dir=ckdir,
+                              slots=[1, 2], page_size=4, num_pages=16,
+                              max_seq_len=8)
+            out3 = cli2.generate("dec_ck", [3, 1], max_new_tokens=4)
+            check(out3["tokens"] == out["tokens"],
+                  "checkpoint_dir deploy serves bitwise the same model")
         finally:
             cli2.close()
             srv2.shutdown()

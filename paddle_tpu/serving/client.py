@@ -10,19 +10,26 @@ DeadlineExceeded, ...) so callers catch types, not regexes."""
 from __future__ import annotations
 
 import re
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from ..distributed.rpc import RpcClient
 from .errors import (DeadlineExceeded, EngineRetired, ModelNotFound,
-                     RequestTooLarge, ServerOverloaded, ServingError)
+                     RequestTooLarge, ServerOverloaded, ServingError,
+                     StreamExpired)
 
-__all__ = ["ServingClient"]
+__all__ = ["ServingClient", "TokenStream"]
+
+from ..checkpoint.format import CheckpointCorruptError, CheckpointError
 
 _TYPED = {cls.__name__: cls for cls in
           (ServerOverloaded, DeadlineExceeded, ModelNotFound,
-           RequestTooLarge, EngineRetired, ServingError,
+           RequestTooLarge, EngineRetired, ServingError, StreamExpired,
+           # checkpoint deploy refusals arrive typed (a corrupt segment
+           # keeps its tensor-named message across the wire)
+           CheckpointError, CheckpointCorruptError,
            ValueError)}  # ValueError: spec/feed validation refusals
 
 # rpc.py's client raises RuntimeError("RPC <m> failed: <Type>: <msg>")
@@ -44,6 +51,86 @@ def _raise_typed(e: RuntimeError):
     if m and m.group(1) in _TYPED:
         raise _TYPED[m.group(1)](m.group(2)) from e
     raise
+
+
+class TokenStream:
+    """Iterator over one streaming generate (ISSUE 12): yields tokens
+    as the server decodes them, pulling chunked continuation frames
+    over the framed RPC. The CLIENT owns the cursor (every frame names
+    its offset explicitly), so a retransmitted frame after a lost reply
+    is answered token-exact — and a fleet router can resume the same
+    cursor on a different replica after a failover.
+
+    ``delivered`` counts tokens handed to the caller; after exhaustion
+    ``result`` holds the final dict (tokens / prompt_len / version /
+    steps_to_first_token). ``close()`` (idempotent, best-effort) tells
+    the server to cancel an unfinished sequence; iterating to the end
+    closes automatically. Typed serving errors (DeadlineExceeded, ...)
+    raise out of iteration; transport failures raise ConnectionError —
+    the router's failover signal."""
+
+    def __init__(self, cli: "ServingClient", model: str,
+                 header: Dict[str, Any], wait_ms: float = 20000.0):
+        self._cli = cli
+        self._id = str(header["stream"])
+        self._wait_ms = float(wait_ms)
+        self._pending: deque = deque()
+        self._next_offset = 0
+        self._done = False
+        self._closed = False
+        self.model = str(model)
+        self.version = int(header["version"])
+        self.prompt_len = int(header["prompt_len"])
+        self.delivered = 0
+        self.result: Optional[Dict[str, Any]] = None
+
+    def __iter__(self) -> "TokenStream":
+        return self
+
+    def __next__(self) -> int:
+        while not self._pending and not self._done:
+            try:
+                resp = self._cli._stream_next(
+                    self._id, self._next_offset, self._wait_ms)
+            except StreamExpired:
+                # the server already dropped the stream — nothing left
+                # to close
+                self._closed = True
+                raise
+            except ServingError:
+                # terminal typed failure (DeadlineExceeded, retirement,
+                # ...): release the server-side stream slot NOW instead
+                # of leaving it to the idle-TTL sweep — a burst of
+                # failed streams must not pin the bounded table
+                self.close()
+                raise
+            self._pending.extend(int(t) for t in resp["tokens"])
+            self._next_offset = int(resp["next_offset"])
+            if resp.get("done"):
+                self._done = True
+                self.result = resp.get("result")
+        if self._pending:
+            self.delivered += 1
+            return self._pending.popleft()
+        self.close()
+        raise StopIteration
+
+    def close(self):
+        """Release the server-side stream (cancels an unfinished
+        sequence). Best-effort: a dead server's stream dies with it."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._cli._stream_close(self._id)
+        except (ConnectionError, OSError, ServingError):
+            pass
+
+    def __enter__(self) -> "TokenStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class ServingClient:
@@ -69,41 +156,79 @@ class ServingClient:
                  max_new_tokens: int = 16,
                  deadline_ms: Optional[float] = None,
                  temperature: float = 0.0, top_k: int = 0,
-                 seed: int = 0) -> Dict[str, Any]:
-        """Autoregressive decode on a loaded decoder. Returns
-        ``{"model", "version", "tokens", "prompt_len"}``. Transport
-        retries are dedup-safe: a retransmitted generate is answered
-        from the server's cache without re-decoding the sequence.
-        ``temperature``/``top_k``/``seed`` select the per-request
-        sampling policy (0.0 = greedy argmax; sampled output is
-        deterministic given the seed)."""
+                 seed: int = 0, stream: bool = False,
+                 stream_wait_ms: float = 20000.0
+                 ) -> Union[Dict[str, Any], TokenStream]:
+        """Autoregressive decode on a loaded decoder. Buffered
+        (default) returns ``{"model", "version", "tokens",
+        "prompt_len"}`` when the whole sequence finishes;
+        ``stream=True`` returns a ``TokenStream`` that yields tokens AS
+        THEY DECODE — the first one ~ceil(prompt/prefill_chunk) decode
+        steps after admission instead of after the last token (the
+        chunked-prefill win, finally visible to a client). Transport
+        retries are dedup-safe either way: a retransmitted generate (or
+        stream frame) is answered from the server's cache without
+        re-decoding. ``temperature``/``top_k``/``seed`` select the
+        per-request sampling policy (0.0 = greedy argmax; sampled
+        output is deterministic given the seed — which is also what
+        makes a fleet-level stream resume exact)."""
+        prompt = [int(t) for t in prompt]
         try:
+            if stream:
+                header = self._rpc.call(
+                    "generate_stream_start", model, prompt,
+                    int(max_new_tokens), deadline_ms, float(temperature),
+                    int(top_k), int(seed))
+                return TokenStream(self, model, header,
+                                   wait_ms=stream_wait_ms)
             return self._rpc.call(
-                "generate", model, [int(t) for t in prompt],
+                "generate", model, prompt,
                 int(max_new_tokens), deadline_ms, float(temperature),
                 int(top_k), int(seed))
         except RuntimeError as e:
             _raise_typed(e)
 
-    def load_decoder(self, model: str, spec: Dict[str, Any],
+    def _stream_next(self, stream_id: str, offset: int,
+                     wait_ms: float) -> Dict[str, Any]:
+        try:
+            return self._rpc.call("generate_stream_next", stream_id,
+                                  int(offset), float(wait_ms))
+        except RuntimeError as e:
+            _raise_typed(e)
+
+    def _stream_close(self, stream_id: str) -> Dict[str, Any]:
+        try:
+            return self._rpc.call("generate_stream_close", stream_id)
+        except RuntimeError as e:
+            _raise_typed(e)
+
+    def load_decoder(self, model: str,
+                     spec: Optional[Dict[str, Any]] = None,
                      version: Optional[int] = None,
                      slots: Optional[Sequence[int]] = None,
                      page_size: Optional[int] = None,
                      num_pages: Optional[int] = None,
                      max_seq_len: Optional[int] = None,
                      max_queue: Optional[int] = None,
-                     prefill_chunk: Optional[int] = None
+                     prefill_chunk: Optional[int] = None,
+                     checkpoint_dir: Optional[str] = None
                      ) -> Dict[str, Any]:
-        """Deploy a DecodeEngine from an architecture/seed spec dict
-        (see serving.decode.DecoderSpec); hot-swaps like load_model.
-        ``prefill_chunk`` pins the chunked-prefill token budget (None =
-        the server resolves it through its autotune cache/FLAGS)."""
+        """Deploy a DecodeEngine; hot-swaps like load_model. From a
+        ``spec`` dict (see serving.decode.DecoderSpec) the server
+        builds the deterministic seed decoder; ``checkpoint_dir`` (a
+        path on the SERVER's filesystem) deploys real weights from a
+        manifest checkpoint — spec optional then, and if given it must
+        match the checkpoint's. ``prefill_chunk`` pins the chunked-
+        prefill token budget (None = the server resolves it through its
+        autotune cache/FLAGS)."""
         try:
             return self._rpc.call(
-                "load_decoder", model, dict(spec), version,
+                "load_decoder", model,
+                None if spec is None else dict(spec), version,
                 _ladder_arg(slots),
                 page_size, num_pages, max_seq_len, max_queue,
-                None if prefill_chunk is None else int(prefill_chunk))
+                None if prefill_chunk is None else int(prefill_chunk),
+                None if checkpoint_dir is None else str(checkpoint_dir))
         except RuntimeError as e:
             _raise_typed(e)
 

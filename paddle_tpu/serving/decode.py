@@ -64,6 +64,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..autotune.ladder import observe as _observe_shape
+from ..distributed import faults as _faults
 from ..observability import metrics as _metrics, tracing as _tracing
 from ..observability.log import get_logger
 from .engine import bucket_for as _bucket_for, resolve_bucket_spec
@@ -359,7 +360,7 @@ def width_ladder(max_pages: int) -> List[int]:
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "deadline", "ev", "result", "error",
                  "t_enq", "seq_id", "trace_ctx", "temperature", "top_k",
-                 "seed")
+                 "seed", "produced")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  deadline: Optional[float], seq_id: int,
@@ -376,6 +377,11 @@ class _DecodeRequest:
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.seed = int(seed)
+        # generated tokens, appended by the answer phase UNDER the
+        # engine's _cond. Living on the REQUEST (not the slot) so
+        # streaming readers (stream_tokens, ISSUE 12) can see tokens
+        # the moment they exist, long before the sequence finishes
+        self.produced: List[int] = []
 
     def fail(self, err: BaseException):
         self.error = err
@@ -383,13 +389,11 @@ class _DecodeRequest:
 
 
 class _Slot:
-    __slots__ = ("req", "pos", "produced", "pages_held", "steps",
-                 "first_token_steps")
+    __slots__ = ("req", "pos", "pages_held", "steps", "first_token_steps")
 
     def __init__(self, req: _DecodeRequest, pages_held: int):
         self.req = req
         self.pos = 0                # tokens already written to the cache
-        self.produced: List[int] = []
         self.pages_held = pages_held
         self.steps = 0              # scheduler steps this slot has ridden
         self.first_token_steps: Optional[int] = None
@@ -398,7 +402,8 @@ class _Slot:
         """The sequence's token at absolute position ``idx``: a prompt
         token, or a previously generated one."""
         p = self.req.prompt
-        return int(p[idx]) if idx < len(p) else self.produced[idx - len(p)]
+        return (int(p[idx]) if idx < len(p)
+                else self.req.produced[idx - len(p)])
 
 
 # --- the engine ---------------------------------------------------------
@@ -679,6 +684,48 @@ class DecodeEngine:
             self._cond.notify_all()
             return True
 
+    def stream_tokens(self, req: _DecodeRequest, offset: int,
+                      timeout: float = 30.0) -> Dict[str, Any]:
+        """Incremental token read for streaming generate (ISSUE 12):
+        block until the sequence has tokens past ``offset`` (or it
+        finished / failed / the wait lapses), then return everything
+        past it. A PURE FUNCTION of (request state, offset) — it never
+        advances hidden cursor state — which is what makes a
+        retransmitted stream frame safe to answer from the dedup cache
+        OR by re-execution: either way the client gets exactly the
+        tokens at those offsets, with zero extra decode steps.
+
+        Returns ``{"tokens", "offset", "next_offset", "done"}`` plus
+        ``"result"`` once done; a failed request re-raises its typed
+        error (DeadlineExceeded, EngineRetired, ...). A timeout with no
+        new tokens returns an empty chunk with ``done=False`` — the
+        caller polls again."""
+        offset = int(offset)
+        if offset < 0:
+            raise ValueError(f"stream offset must be >= 0, got {offset}")
+        deadline = time.monotonic() + float(timeout)
+        with self._cond:
+            while len(req.produced) <= offset and not req.ev.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                # lint: allow-blocking — a bounded reader wait on the
+                # engine's own condition; the answer phase notifies on
+                # every step that produced a token
+                self._cond.wait(remaining)
+            toks = [int(t) for t in req.produced[offset:]]
+            done = req.ev.is_set()
+            err = req.error
+            result = req.result
+        if done and err is not None:
+            raise err
+        out: Dict[str, Any] = {"tokens": toks, "offset": offset,
+                               "next_offset": offset + len(toks),
+                               "done": done}
+        if done:
+            out["result"] = result
+        return out
+
     def set_max_queue(self, n: int):
         with self._cond:
             self._max_queue = max(1, int(n))
@@ -895,6 +942,13 @@ class DecodeEngine:
         return grants
 
     def _step(self, live: List[_Slot]):
+        # named chaos seam for the SCHEDULER cadence: a
+        # `delay@serving.decode.step:*=0.004` plan simulates a slow
+        # decoder (long-context model, contended chip) so streaming/
+        # failover tests can pin mid-generation behavior without racing
+        # a fast engine; `error@` fails the step's slots like any other
+        # step failure. Zero cost with no plan installed.
+        _faults.fire("serving.decode.step")
         s_bucket = _bucket_for(self._slot_ladder, len(live))
         w_need = max(s.pages_held for s in live)
         w_bucket = _bucket_for(self._width_ladder, w_need)
@@ -961,6 +1015,7 @@ class DecodeEngine:
         # requests under _cond, so check-ev-then-answer must be atomic
         # with it or the two sides can each answer the same request
         notes: Dict[int, int] = {}
+        produced_any = False
         with self._cond:
             for i, s in enumerate(live):
                 if s.req.ev.is_set():
@@ -987,12 +1042,13 @@ class DecodeEngine:
                            else sample_token(
                                logits_np[i], s.req.temperature,
                                s.req.top_k, s.req.seed, s.pos))
-                    s.produced.append(tok)
+                    s.req.produced.append(tok)
+                    produced_any = True
                     _m_tokens.inc()
                     if s.first_token_steps is None:
                         s.first_token_steps = s.steps
                         _m_first_token_steps.observe(s.steps)
-                finished = (len(s.produced) >= s.req.max_new
+                finished = (len(s.req.produced) >= s.req.max_new
                             or (tok is not None
                                 and self.spec.eos_id is not None
                                 and tok == self.spec.eos_id))
@@ -1006,13 +1062,18 @@ class DecodeEngine:
                     done.append(s)
                     self._fail_locked(s.req, DeadlineExceeded(
                         f"request to decoder '{self.name}' lapsed "
-                        f"mid-decode after {len(s.produced)} tokens"))
+                        f"mid-decode after {len(s.req.produced)} tokens"))
             # one allocator-lock round-trip for the whole step; seqs
             # freed by _complete/_fail above are skipped inside
             self.cache.allocator.note_tokens_many(notes)
             if done:
                 self._slots = [s for s in self._slots if s not in done]
                 self._g_live.set(len(self._slots))
+            if done or produced_any:
+                # wake completion waiters AND streaming readers parked
+                # in stream_tokens — a token exists the moment this
+                # notify lands, ceil(prompt/chunk) steps after
+                # admission, not when the whole sequence finishes
                 self._cond.notify_all()
 
     def _complete(self, s: _Slot):
@@ -1020,7 +1081,7 @@ class DecodeEngine:
         _m_completions.inc()
         _m_total.observe((time.monotonic() - s.req.t_enq) * 1e3)
         s.req.result = {
-            "tokens": list(s.produced),
+            "tokens": list(s.req.produced),
             "prompt_len": int(len(s.req.prompt)),
             "version": self.version,
             # scheduler steps from admission to the first generated

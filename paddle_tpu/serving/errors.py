@@ -14,6 +14,7 @@ from __future__ import annotations
 __all__ = [
     "ServingError", "ServerOverloaded", "DeadlineExceeded",
     "ModelNotFound", "RequestTooLarge", "EngineRetired",
+    "StreamExpired",
 ]
 
 
@@ -40,6 +41,14 @@ class ModelNotFound(ServingError):
 class RequestTooLarge(ServingError):
     """A single request carries more rows than the model's largest
     batch bucket — it can never be scheduled; shard it client-side."""
+
+
+class StreamExpired(ServingError):
+    """A streaming-generate continuation named a stream id the server
+    no longer holds: it was closed, its idle TTL lapsed (the abandoned-
+    stream sweep canceled the sequence), or the server restarted. The
+    caller restarts the stream — against a fleet, the router does this
+    automatically, resuming from the last delivered offset."""
 
 
 class EngineRetired(ServingError):

@@ -19,9 +19,25 @@ Methods (all fire the `serving.<method>` fault site before running, so
     generate(model, prompt, max_new_tokens, deadline_ms)
                                        -> {model, version, tokens,
                                            prompt_len}  (decoders)
+    generate_stream_start(model, prompt, ...)
+                                       -> {stream, version, prompt_len}
+    generate_stream_next(stream, offset, wait_ms)
+                                       -> {tokens, next_offset, done,
+                                           result?}  — the pull half of
+                                          STREAMING generate (ISSUE 12):
+                                          tokens cross the wire as they
+                                          are decoded, the first one
+                                          ~ceil(prompt/chunk) steps
+                                          after admission
+    generate_stream_close(stream)      -> cancels an unfinished stream
     load_model(model, dirname, ...)    -> engine stats (after warmup)
-    load_decoder(model, spec, ...)     -> decode-engine stats (after the
-                                          full slot/width warm)
+    load_decoder(model, spec, ...,
+                 checkpoint_dir=)      -> decode-engine stats (after the
+                                          full slot/width warm);
+                                          checkpoint_dir deploys REAL
+                                          weights from a verified
+                                          manifest checkpoint
+                                          (paddle_tpu/checkpoint)
     unload_model(model)                -> final engine stats
     list_models()                      -> {name: stats}
     health()                           -> {"ok": True, "models": [...]}
@@ -37,6 +53,21 @@ on a retransmit would burn len(prompt)+max_new decode steps AND
 re-reserve KV pages — the chaos test pins that a killed generate reply
 is answered from the cache with zero extra decode steps. Re-execution would
 be CORRECT but wasteful — and under overload, wasteful is wrong.
+The three stream methods ride the dedup cache for the same reasons: a
+retransmitted `generate_stream_start` must not admit (and reserve
+pages for) a SECOND sequence, and a retransmitted continuation frame
+is answered token-exact with zero extra decode steps — each frame is a
+pure read of (stream state, client-owned offset), so exactness is
+pinned PER TOKEN, not per request (the partial-stream chaos test pins
+`rpc.server.dedup_hits` == injected reply drops). Sizing note for
+heavy streaming: every frame response occupies a dedup slot for >=
+900s, so budget `dedup_cap` for the fleet's aggregate frame rate
+(streams x frames/stream) — past the cache's 4x-cap safety valve the
+OLDEST completed entries evict early, and a start/generate whose entry
+was valved out re-executes on retransmit (for a frame that is harmless
+— pure read, token-exact — for a start it admits a duplicate sequence
+that idles until the stream TTL reaps it; raise `dedup_cap` before a
+fleet gets there).
 Memory sizing note: the dedup cache holds recent infer RESPONSES (up
 to `dedup_cap`, held >= 900s, 4x-cap safety valve — see
 rpc._DedupCache); budget `dedup_cap x typical response bytes` of
@@ -63,7 +94,9 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Any, Dict, Optional, Sequence, Tuple
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -73,7 +106,8 @@ from ..observability import debug_server as _debug, metrics as _metrics, \
     tracing as _tracing
 from ..observability.log import get_logger
 from .engine import InferenceEngine
-from .errors import EngineRetired, ModelNotFound, ServingError
+from .errors import (EngineRetired, ModelNotFound, ServerOverloaded,
+                     ServingError, StreamExpired)
 from .registry import ModelRegistry
 
 __all__ = ["ServingServer"]
@@ -81,6 +115,13 @@ __all__ = ["ServingServer"]
 _log = get_logger("serving")
 
 _m_resubmits = _metrics.counter("serving.swap_resubmits")
+# streaming generate (ISSUE 12): starts/chunks/tokens count what
+# actually crossed the wire incrementally; expired counts abandoned
+# streams the idle sweep canceled (their KV pages freed)
+_m_stream_starts = _metrics.counter("serving.stream.starts")
+_m_stream_chunks = _metrics.counter("serving.stream.chunks")
+_m_stream_tokens = _metrics.counter("serving.stream.tokens")
+_m_stream_expired = _metrics.counter("serving.stream.expired")
 
 
 class ServingServer:
@@ -92,11 +133,27 @@ class ServingServer:
     _SWAP_RETRIES = 8
 
     def __init__(self, registry: Optional[ModelRegistry] = None,
-                 dedup_cap: int = 1024):
+                 dedup_cap: int = 1024, max_streams: int = 256,
+                 stream_ttl: Optional[float] = None):
+        from ..fluid.flags import FLAGS
+
         self._registry = registry or ModelRegistry()
+        # open token streams (ISSUE 12): stream id -> {req, engine,
+        # model, touched}. Bounded (max_streams) and idle-swept: a
+        # stream nobody polls for stream_ttl seconds is canceled so an
+        # abandoned client can't pin KV pages forever
+        self._streams_mu = threading.Lock()
+        self._streams: Dict[str, Dict[str, Any]] = {}  # guarded-by: _streams_mu
+        self._max_streams = int(max_streams)
+        self._stream_ttl = float(FLAGS["serving_stream_ttl"]
+                                 if stream_ttl is None else stream_ttl)
+        self._last_sweep = 0.0  # guarded-by: _streams_mu
         handlers = {
             "infer": self._infer,
             "generate": self._generate,
+            "generate_stream_start": self._generate_stream_start,
+            "generate_stream_next": self._generate_stream_next,
+            "generate_stream_close": self._generate_stream_close,
             "load_model": self._load_model,
             "load_decoder": self._load_decoder,
             "unload_model": self._unload_model,
@@ -170,28 +227,39 @@ class ServingServer:
                 "rpc": self._rpc.stats()}
 
     # -- handlers ---------------------------------------------------------
+    def _on_engine(self, model: str, want_decoder: bool, mismatch: str,
+                   fn):
+        """THE swap-resubmit contract, in one place for infer/generate/
+        stream-start: a request that races a hot-swap gets EngineRetired
+        from the old engine — the registry already points at the
+        replacement, so resubmit there, never fail the request."""
+        model = str(model)
+        for _ in range(self._SWAP_RETRIES):
+            engine = self._registry.get(model)
+            if (engine.kind == "decoder") != want_decoder:
+                raise ServingError(mismatch.format(model=model))
+            try:
+                return fn(engine)
+            except EngineRetired:
+                _m_resubmits.inc()
+                continue
+        raise ServingError(
+            f"model '{model}' kept retiring across "
+            f"{self._SWAP_RETRIES} resubmits — deploy storm?")
+
     def _infer(self, model: str, feeds: Dict[str, Any],
                deadline_ms: Optional[float] = None) -> Dict[str, Any]:
         with _tracing.span("serving.request", model=str(model)):
-            for _ in range(self._SWAP_RETRIES):
-                engine = self._registry.get(str(model))
-                if engine.kind == "decoder":
-                    raise ServingError(
-                        f"model '{model}' is a decoder — call generate, "
-                        "not infer")
-                try:
-                    outputs, version = engine.infer(
-                        feeds, deadline_ms=deadline_ms)
-                except EngineRetired:
-                    # raced a hot-swap: the registry already points at
-                    # the replacement — resubmit there, never fail
-                    _m_resubmits.inc()
-                    continue
+            def run(engine):
+                outputs, version = engine.infer(
+                    feeds, deadline_ms=deadline_ms)
                 return {"model": str(model), "version": version,
                         "outputs": [np.asarray(o) for o in outputs]}
-            raise ServingError(
-                f"model '{model}' kept retiring across "
-                f"{self._SWAP_RETRIES} resubmits — deploy storm?")
+
+            return self._on_engine(
+                model, False,
+                "model '{model}' is a decoder — call generate, "
+                "not infer", run)
 
     def _generate(self, model: str, prompt: Sequence[int],
                   max_new_tokens: int = 16,
@@ -205,24 +273,138 @@ class ServingServer:
         deterministic given seed, so the dedup cache's answer to a
         retransmit equals what a re-decode would have produced)."""
         with _tracing.span("serving.decode.request", model=str(model)):
-            for _ in range(self._SWAP_RETRIES):
-                engine = self._registry.get(str(model))
-                if engine.kind != "decoder":
-                    raise ServingError(
-                        f"model '{model}' is not a decoder — call infer, "
-                        "not generate")
-                try:
-                    out = engine.generate(
+            return self._on_engine(
+                model, True,
+                "model '{model}' is not a decoder — call infer, "
+                "not generate",
+                lambda engine: {
+                    "model": str(model),
+                    **engine.generate(
                         prompt, max_new_tokens=max_new_tokens,
-                        deadline_ms=deadline_ms,
-                        temperature=temperature, top_k=top_k, seed=seed)
-                except EngineRetired:
-                    _m_resubmits.inc()
-                    continue
-                return {"model": str(model), **out}
-            raise ServingError(
-                f"decoder '{model}' kept retiring across "
-                f"{self._SWAP_RETRIES} resubmits — deploy storm?")
+                        deadline_ms=deadline_ms, temperature=temperature,
+                        top_k=top_k, seed=seed)})
+
+    # -- streaming generate (ISSUE 12) ------------------------------------
+    def _sweep_streams(self):
+        """Cancel + drop streams nobody polled for stream_ttl seconds.
+        Collect under the lock, cancel outside it (cancel takes the
+        ENGINE's condition — never nest it under _streams_mu). TIME-
+        GATED: every stream method calls this, and under heavy frame
+        traffic a full-table scan per frame would turn _streams_mu
+        into a data-path serialization point — the TTL is a seconds-
+        scale promise, so one scan per ~ttl/10 keeps it at an O(1)
+        check per frame."""
+        now = time.monotonic()
+        expired: List[Tuple[Any, Any]] = []
+        with self._streams_mu:
+            gate = min(30.0, max(0.05, self._stream_ttl / 10.0))
+            if now - self._last_sweep < gate:
+                return
+            self._last_sweep = now
+            for sid in list(self._streams):
+                ent = self._streams[sid]
+                if now - ent["touched"] > self._stream_ttl:
+                    expired.append(self._streams.pop(sid))
+        for ent in expired:
+            _m_stream_expired.inc()
+            _log.warning("stream on '%s' idle past %.0fs — canceling "
+                         "the abandoned sequence", ent["model"],
+                         self._stream_ttl)
+            try:
+                ent["engine"].cancel(ent["req"], msg="stream abandoned")
+            except Exception:  # pragma: no cover - engine mid-retire
+                pass
+
+    def _generate_stream_start(self, model: str, prompt: Sequence[int],
+                               max_new_tokens: int = 16,
+                               deadline_ms: Optional[float] = None,
+                               temperature: float = 0.0, top_k: int = 0,
+                               seed: int = 0) -> Dict[str, Any]:
+        """Admit a decode sequence and hand back a stream id; tokens
+        are pulled incrementally with generate_stream_next. Rides the
+        dedup cache (NOT idempotent-declared): a retransmitted start
+        after a lost reply is answered with the ORIGINAL stream id —
+        one admission, one page reservation, no duplicate sequence."""
+        self._sweep_streams()
+        with _tracing.span("serving.stream.start", model=str(model)):
+            def run(engine):
+                req = engine.submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    deadline_ms=deadline_ms, temperature=temperature,
+                    top_k=top_k, seed=seed)
+                sid = uuid.uuid4().hex
+                # bound checked at INSERT (one locked section, no
+                # check-then-act window for concurrent starts to
+                # overshoot through); the submit is withdrawn on refusal
+                with self._streams_mu:
+                    full = len(self._streams) >= self._max_streams
+                    if not full:
+                        self._streams[sid] = {
+                            "req": req, "engine": engine,
+                            "model": str(model),
+                            "touched": time.monotonic()}
+                if full:
+                    engine.cancel(req, msg="stream table full")
+                    raise ServerOverloaded(
+                        f"too many open token streams "
+                        f"({self._max_streams}) — close or drain some "
+                        "first")
+                _m_stream_starts.inc()
+                return {"stream": sid, "model": str(model),
+                        "version": engine.version,
+                        "prompt_len": len(req.prompt)}
+
+            return self._on_engine(
+                model, True,
+                "model '{model}' is not a decoder — streaming "
+                "generate needs one", run)
+
+    def _generate_stream_next(self, stream: str, offset: int,
+                              wait_ms: float = 20000.0
+                              ) -> Dict[str, Any]:
+        """One continuation frame: every token past ``offset``, blocking
+        (bounded) until at least one exists or the sequence ends. A pure
+        read of the stream's request state — the client owns the cursor
+        — so a retransmitted frame (dedup-answered OR re-executed) is
+        token-exact with zero extra decode steps. A failed sequence
+        re-raises its typed error."""
+        # every stream method sweeps: the TTL promise must not depend
+        # on another START ever arriving (steady frame-only traffic
+        # would otherwise pin abandoned entries — and their retired
+        # engines' KV pools — forever)
+        self._sweep_streams()
+        with self._streams_mu:
+            ent = self._streams.get(str(stream))
+            if ent is not None:
+                ent["touched"] = time.monotonic()
+        if ent is None:
+            raise StreamExpired(
+                f"unknown stream '{stream}' — closed, expired "
+                f"(idle > {self._stream_ttl:.0f}s), or from a previous "
+                "server life")
+        out = ent["engine"].stream_tokens(
+            ent["req"], offset, timeout=max(0.0, float(wait_ms)) / 1e3)
+        _m_stream_chunks.inc()
+        if out["tokens"]:
+            _m_stream_tokens.inc(len(out["tokens"]))
+        return out
+
+    def _generate_stream_close(self, stream: str) -> Dict[str, Any]:
+        """Drop the stream; an unfinished sequence is canceled (pages
+        freed now, the scheduler drops its slot at the next answer
+        phase). Rides the dedup cache like start, so a retransmitted
+        close cannot cancel a stream id a later caller was handed."""
+        self._sweep_streams()
+        with self._streams_mu:
+            ent = self._streams.pop(str(stream), None)
+        canceled = False
+        if ent is not None and not ent["req"].ev.is_set():
+            try:
+                canceled = ent["engine"].cancel(
+                    ent["req"], msg="stream closed by client")
+            except Exception:  # pragma: no cover - engine mid-retire
+                pass
+        return {"closed": ent is not None, "canceled": canceled}
 
     def _resolve_version(self, model: str, version: Optional[int]) -> int:
         """Auto-assign (live+1) or validate a pinned version. A pinned
@@ -246,22 +428,48 @@ class ServingServer:
                 f"auto-assign v{live + 1}")
         return version
 
-    def _load_decoder(self, model: str, spec: Dict[str, Any],
+    def _load_decoder(self, model: str,
+                      spec: Optional[Dict[str, Any]] = None,
                       version: Optional[int] = None,
                       slots: Optional[Sequence[int]] = None,
                       page_size: Optional[int] = None,
                       num_pages: Optional[int] = None,
                       max_seq_len: Optional[int] = None,
                       max_queue: Optional[int] = None,
-                      prefill_chunk: Optional[int] = None
+                      prefill_chunk: Optional[int] = None,
+                      checkpoint_dir: Optional[str] = None
                       ) -> Dict[str, Any]:
         """Build + warm (every slot/width shape) + atomically install a
-        DecodeEngine from an architecture/seed spec dict. Hot-swapping
-        a decoder drains the old engine — every in-flight SEQUENCE
-        finishes on its own KV cache before the old pool releases."""
+        DecodeEngine. ``checkpoint_dir`` loads REAL weights (and the
+        spec) from a manifest checkpoint (ISSUE 12 — checksum-verified,
+        typed tensor-named failure on corruption); ``spec`` alone
+        deploys the deterministic seed-built decoder as before. Giving
+        both cross-validates: a spec that contradicts the checkpoint's
+        is a wrong-model deploy, refused before any compile. Hot-
+        swapping a decoder drains the old engine — every in-flight
+        SEQUENCE finishes on its own KV cache before the old pool
+        releases."""
         from .decode import DecodeEngine, DecoderSpec
 
         model = str(model)
+        params = None
+        if checkpoint_dir is not None:
+            from ..checkpoint import load_decoder_checkpoint
+
+            use_spec, params = load_decoder_checkpoint(
+                str(checkpoint_dir))
+            if spec is not None:
+                want = DecoderSpec.from_dict(dict(spec))
+                if want.to_dict() != use_spec.to_dict():
+                    raise ValueError(
+                        f"spec given to load_decoder contradicts "
+                        f"checkpoint '{checkpoint_dir}': "
+                        f"{want.to_dict()} != {use_spec.to_dict()}")
+        elif spec is None:
+            raise ValueError(
+                "load_decoder needs a spec dict or a checkpoint_dir")
+        else:
+            use_spec = DecoderSpec.from_dict(dict(spec))
         # lint: allow-blocking — deploys serialize end-to-end; see
         # _load_mu above. generate/infer traffic never takes this lock.
         with self._load_mu:
@@ -269,10 +477,11 @@ class ServingServer:
 
             def build():
                 return DecodeEngine(
-                    DecoderSpec.from_dict(spec), name=model,
+                    use_spec, name=model,
                     version=version, slots=slots, page_size=page_size,
                     num_pages=num_pages, max_seq_len=max_seq_len,
-                    max_queue=max_queue, prefill_chunk=prefill_chunk)
+                    max_queue=max_queue, prefill_chunk=prefill_chunk,
+                    params=params)
 
             engine = self._registry.deploy(model, build)
             return engine.stats()

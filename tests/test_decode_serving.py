@@ -502,7 +502,7 @@ def test_mixed_step_decode_never_stalls_behind_prefill():
         for _ in range(2000):
             with eng._cond:
                 sa = next((s for s in eng._slots if s.req is a), None)
-                if sa is not None and sa.produced:
+                if sa is not None and a.produced:
                     break
             time.sleep(0.002)
         b = eng.submit(list(range(16)), max_new_tokens=2)
@@ -591,7 +591,7 @@ def test_finished_result_delivered_even_if_deadline_lapsed():
         for _ in range(2000):
             with eng._cond:
                 slot = next((s for s in eng._slots if s.req is req), None)
-                if slot is not None and len(slot.produced) >= 1:
+                if slot is not None and len(req.produced) >= 1:
                     req.deadline = deadline  # already in the past
                     break
             if req.ev.is_set():

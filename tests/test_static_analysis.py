@@ -757,6 +757,30 @@ def test_fleet_registry_families_collected():
         assert k in defined
 
 
+def test_checkpoint_stream_registry_families_collected():
+    """ISSUE 12 satellite: the checkpoint subsystem's fault site and
+    metric/span names, the streaming-generate counters, and the new
+    FLAGS key are first-class registry members — renaming any of them
+    is an N201/N202/N203 error, not silently-green tests."""
+    pkg = invariants._repo_root() + "/paddle_tpu"
+    sites = invariants.collect_declared_sites(pkg)
+    # the torn-write chaos seam and the decode-scheduler throttle seam
+    assert "checkpoint.save" in sites[0]
+    assert "serving.decode.step" in sites[0]
+    universe = invariants.NameUniverse(
+        invariants.collect_declared_names(pkg), sites)
+    for n in ("checkpoint.saves", "checkpoint.loads",
+              "checkpoint.bytes_written", "checkpoint.bytes_read",
+              "checkpoint.corrupt", "checkpoint.save", "checkpoint.load",
+              "serving.stream.starts", "serving.stream.chunks",
+              "serving.stream.tokens", "serving.stream.expired",
+              "serving.stream.start", "fleet.stream.resumes"):
+        assert universe.resolves(n), n
+    defined = invariants.collect_defined_flags(
+        invariants._repo_root() + "/paddle_tpu/fluid/flags.py")
+    assert "serving_stream_ttl" in defined
+
+
 def test_flags_keys_all_defined():
     root = invariants._repo_root()
     defined = invariants.collect_defined_flags(

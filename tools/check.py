@@ -27,11 +27,16 @@ locally" and "works in CI" are the same claim:
                                                    derivation, measure-
                                                    or-model, routing
                                                    read-through)
-  5. `python -m paddle_tpu.fleet --selftest`      (multi-replica smoke:
+  5. `python -m paddle_tpu.checkpoint --selftest` (manifest roundtrip,
+                                                   named corruption,
+                                                   torn-write crash
+                                                   discipline, decoder
+                                                   contract)
+  6. `python -m paddle_tpu.fleet --selftest`      (multi-replica smoke:
                                                    rollout, decode-aware
                                                    routing, cluster-wide
                                                    shed, failover)
-  6. `python -m pytest tests/ --collect-only -q`  (imports every test
+  7. `python -m pytest tests/ --collect-only -q`  (imports every test
                                                    module under
                                                    --strict-markers: a
                                                    bad import or an
@@ -90,6 +95,8 @@ def main(argv=None) -> int:
                [py, "-m", "paddle_tpu.serving", "--selftest"])
     rc |= _run("autotune selftest",
                [py, "-m", "paddle_tpu.autotune", "--selftest"])
+    rc |= _run("checkpoint selftest",
+               [py, "-m", "paddle_tpu.checkpoint", "--selftest"])
     rc |= _run("fleet selftest",
                [py, "-m", "paddle_tpu.fleet", "--selftest"])
     rc |= _run("pytest collect smoke",
