@@ -81,6 +81,7 @@ _CONTAINER_CTORS = {"dict", "list", "set", "deque", "OrderedDict",
 
 _DECL_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow-unguarded(?:\(([^)]*)\))?")
+_ALIAS_RE = re.compile(r"#\s*lint:\s*lock-alias\b")
 
 
 def _d(code, msg, where, hint=""):
@@ -95,8 +96,13 @@ class _Directives:
         self.decl_by_line: Dict[int, str] = {}
         # line -> set of vetted attr names ('*' = any attr on the line)
         self.allow_by_line: Dict[int, Set[str]] = {}
+        # lines carrying '# lint: lock-alias' — the assigned attribute
+        # IS a lock, injected by the owner (see locks.py's catalog)
+        self.lock_alias_lines: Set[int] = set()
         lines = src.splitlines()
         for i, line in enumerate(lines, start=1):
+            if _ALIAS_RE.search(line):
+                self.lock_alias_lines.add(i)
             m = _DECL_RE.search(line)
             if m:
                 self.decl_by_line[i] = m.group(1)
@@ -181,9 +187,12 @@ class _Scope:
         self.multi: Set[str] = set()         # multi-thread-reachable fns
 
 
-def _collect_locks(scope: _Scope, body, self_name: str):
+def _collect_locks(scope: _Scope, body, self_name: str,
+                   directives=None):
     """Lock-attribute discovery, mirroring locks.py (Condition(self._mu)
-    aliases the wrapped lock; dict-of-locks families get an '[]' id)."""
+    aliases the wrapped lock; dict-of-locks families get an '[]' id;
+    `# lint: lock-alias` marks an injected shared lock — see
+    locks.py's directive catalog)."""
     for node in ast.walk(ast.Module(body=list(body), type_ignores=[])):
         if not isinstance(node, ast.Assign) or len(node.targets) != 1:
             continue
@@ -193,6 +202,12 @@ def _collect_locks(scope: _Scope, body, self_name: str):
         own = tgt.startswith(self_name + ".") if self_name != "<module>" \
             else "." not in tgt
         val = node.value
+        if own and directives is not None and \
+                node.lineno in directives.lock_alias_lines:
+            short = tgt.split(".")[-1]
+            scope.locks[tgt] = f"{scope.qual}.{short}"
+            scope.lock_attrs.add(short)
+            continue
         if isinstance(val, ast.Call):
             fn = val.func
             ctor = fn.attr if isinstance(fn, ast.Attribute) else (
@@ -242,7 +257,8 @@ class _Lint:
         scope = _Scope(f"{self.short}.{cls.name}", is_class=True)
         scope.locks.update(mod.locks)     # module locks visible
         scope.lock_attrs |= mod.lock_attrs
-        _collect_locks(scope, cls.body, self_name="self")
+        _collect_locks(scope, cls.body, self_name="self",
+                       directives=self.directives)
         for n in cls.body:
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 scope.fns[n.name] = _Fn(n.name, n)
@@ -252,7 +268,8 @@ class _Lint:
 
     def _module_scope(self, tree: ast.Module) -> _Scope:
         scope = _Scope(self.short, is_class=False)
-        _collect_locks(scope, tree.body, self_name="<module>")
+        _collect_locks(scope, tree.body, self_name="<module>",
+                       directives=self.directives)
         for n in tree.body:
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 scope.fns[n.name] = _Fn(n.name, n)

@@ -35,6 +35,13 @@ Vetted sites are annotated in source:
     # lint: lock-order(a<b)       declares the intended order of two
                                   locks (short attr names); an observed
                                   b-then-a path becomes an L101 violation
+    # lint: lock-alias            on a `self._mu = mu` assignment:
+                                  the attribute IS a lock, injected by
+                                  the owner (shared-lock composition —
+                                  PrefixIndex runs under its
+                                  allocator's mutex); registered as a
+                                  lock attribute of the scope so
+                                  guarded-by declarations may name it
 """
 from __future__ import annotations
 
@@ -191,6 +198,14 @@ class _Lint:
             if tgt is None:
                 continue
             val = node.value
+            # `self._mu = mu  # lint: lock-alias` — an injected shared
+            # lock (the owner passes its own mutex in); same identity
+            # rules as a constructed lock
+            if self.directives.allows("lock-alias", node.lineno) and \
+                    (tgt.startswith(self_name + ".") or "." not in tgt):
+                cid = f"{scope.qual}.{tgt.split('.')[-1]}"
+                scope.locks[tgt] = cid
+                continue
             if isinstance(val, ast.Call):
                 fn = val.func
                 ctor = fn.attr if isinstance(fn, ast.Attribute) else (

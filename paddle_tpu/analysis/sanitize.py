@@ -53,6 +53,8 @@ _RUNTIME_CLASSES: Tuple[Tuple[str, str], ...] = (
     ("paddle_tpu.serving.engine", "InferenceEngine"),
     ("paddle_tpu.serving.registry", "ModelRegistry"),
     ("paddle_tpu.serving.kv_cache", "PageAllocator"),
+    ("paddle_tpu.serving.kv_cache", "PrefixIndex"),
+    ("paddle_tpu.serving.kv_cache", "HostSpillStore"),
     ("paddle_tpu.distributed.rpc", "_DedupCache"),
     ("paddle_tpu.distributed.rpc", "RpcClient"),
     ("paddle_tpu.distributed.param_server", "ParameterServer"),

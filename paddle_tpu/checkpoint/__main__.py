@@ -41,9 +41,13 @@ def cmd_inspect(dirname: str) -> int:
     meta = m.get("meta") or {}
     if meta:
         print(f"  meta:    {json.dumps(meta, sort_keys=True)}")
+    if m.get("base"):
+        print(f"  base:    {m['base']}")
     for t in m["tensors"]:
+        # delta checkpoints: a base-resident tensor has no offset here
+        loc = "base" if t.get("base") else f"@{t['offset']}"
         print(f"  {t['name']:<24} {t['dtype']:<10} "
-              f"{str(tuple(t['shape'])):<18} @{t['offset']} "
+              f"{str(tuple(t['shape'])):<18} {loc} "
               f"({t['nbytes']} B)")
     return 0
 

@@ -51,12 +51,18 @@ def expected_decoder_tensors(spec) -> Dict[str, Tuple[int, ...]]:
 
 def save_decoder_checkpoint(dirname: str, spec,
                             params: Optional[Dict[str, Any]] = None,
-                            step: Optional[int] = None) -> str:
+                            step: Optional[int] = None,
+                            base_manifest: Optional[str] = None) -> str:
     """Persist a decoder (spec + parameter tree) as a manifest
     checkpoint. ``params=None`` saves the spec's deterministic
     seed-built tree (the test/bench vehicle); a live engine passes its
     own tree. ``step`` (optional) rides the meta so
-    ``fluid.io.latest_checkpoint_step`` recognizes the directory."""
+    ``fluid.io.latest_checkpoint_step`` recognizes the directory.
+    ``base_manifest`` (ISSUE 13, the rollout loop's incremental save)
+    names a prior decoder checkpoint DIRECTORY: only tensors whose
+    crc32 differs from the base are written — the rest become base
+    references the loader follows — so a fine-tune that touched two
+    layers costs two layers of payload, not the whole model."""
     from ..serving.decode import build_decoder_params
 
     if params is None:
@@ -64,7 +70,8 @@ def save_decoder_checkpoint(dirname: str, spec,
     meta: Dict[str, Any] = {"kind": "decoder", "spec": spec.to_dict()}
     if step is not None:
         meta["step"] = int(step)
-    return save_checkpoint_tree(dirname, params, meta=meta)
+    return save_checkpoint_tree(dirname, params, meta=meta,
+                                base=base_manifest)
 
 
 def load_decoder_checkpoint(dirname: str, verify: bool = True):

@@ -70,6 +70,10 @@ _m_bytes_read = _metrics.counter("checkpoint.bytes_read")
 # fleet operator alerts on: a nonzero value means storage corrupted a
 # deployed artifact
 _m_corrupt = _metrics.counter("checkpoint.corrupt")
+# incremental/delta checkpoints (ISSUE 13): tensors a delta save
+# REFERENCED from its base (identical crc32) instead of rewriting —
+# the rollout loop's save cost becomes proportional to what changed
+_m_delta_skipped = _metrics.counter("checkpoint.delta_skipped")
 
 # serializes whole commits (payload write -> manifest rename -> orphan
 # GC) within this process, the TuningCache._flush_mu discipline:
@@ -156,11 +160,31 @@ class CheckpointWriter:
     manifest path. A writer commits SUCCESSFULLY at most once — a
     commit that failed (ENOSPC, injected crash) leaves the staged
     tensors intact and may simply be retried.
+
+    ``base`` (ISSUE 13, incremental checkpoints) points at an existing
+    checkpoint DIRECTORY: tensors whose crc32 (and dtype/shape) equal
+    the base's are not rewritten — their manifest entries carry
+    ``"base": true`` and loads follow the recorded base chain. The
+    crc32 index the format already keeps is exactly the change
+    detector. A delta must live in its own directory (committing into
+    the base's would garbage-collect the payload it references).
     """
 
-    def __init__(self, dirname: str, meta: Optional[Dict[str, Any]] = None):
+    def __init__(self, dirname: str, meta: Optional[Dict[str, Any]] = None,
+                 base: Optional[str] = None):
         self._dirname = str(dirname)
         self._meta = dict(meta or {})
+        self._base = None if base is None else str(base)
+        if self._base is not None:
+            if os.path.realpath(self._base) == \
+                    os.path.realpath(self._dirname):
+                raise CheckpointError(
+                    "a delta checkpoint cannot use its own directory "
+                    "as its base — the commit's orphan sweep would "
+                    "delete the payload it references")
+            # fail early, typed: a bad base is a caller error at SAVE
+            # time, not a mystery at some future load
+            read_manifest(self._base)
         self._mu = threading.Lock()
         self._staged: "OrderedDict[str, np.ndarray]" = \
             OrderedDict()  # guarded-by: _mu
@@ -235,12 +259,21 @@ class CheckpointWriter:
             self._committing = False
         return path
 
+    def _base_index(self) -> Dict[str, Dict[str, Any]]:
+        """name -> resolved (dtype/shape/crc32) entries of the base
+        manifest. Base-ref entries in a delta base carry the resolved
+        crc too, so delta-of-delta chains index without I/O."""
+        manifest = read_manifest(self._base)
+        return {str(t["name"]): t for t in manifest["tensors"]}
+
     def _commit_locked(self, dirname, meta, staged, skel) -> str:
         nonce = uuid.uuid4().hex[:12]
         payload_name = f"segments-{nonce}.bin"
         payload_path = os.path.join(dirname, payload_name)
         tensors: List[Dict[str, Any]] = []
         written = 0
+        skipped = 0
+        base_idx = self._base_index() if self._base is not None else {}
         with _tracing.span("checkpoint.save", dir=dirname,
                            tensors=len(staged)):
             # the payload's name is nonce-fresh and nothing references
@@ -250,11 +283,31 @@ class CheckpointWriter:
             with open(payload_path, "wb") as f:
                 off = 0
                 for name, arr in staged:
+                    raw = arr.tobytes()
+                    crc = zlib.crc32(raw) & 0xFFFFFFFF
+                    base_t = base_idx.get(name)
+                    if base_t is not None and \
+                            int(base_t["crc32"]) == crc and \
+                            str(base_t["dtype"]) == str(arr.dtype) and \
+                            list(base_t["shape"]) == list(arr.shape):
+                        # unchanged since the base: reference, don't
+                        # rewrite (the entry keeps the resolved crc/
+                        # dtype/shape so chained deltas and loads can
+                        # verify without touching the base first)
+                        tensors.append({
+                            "name": name,
+                            "dtype": str(arr.dtype),
+                            "shape": list(arr.shape),
+                            "nbytes": len(raw),
+                            "crc32": crc,
+                            "base": True,
+                        })
+                        skipped += 1
+                        continue
                     pad = (-off) % _ALIGN
                     if pad:
                         f.write(b"\0" * pad)
                         off += pad
-                    raw = arr.tobytes()
                     f.write(raw)
                     tensors.append({
                         "name": name,
@@ -262,7 +315,7 @@ class CheckpointWriter:
                         "shape": list(arr.shape),
                         "offset": off,
                         "nbytes": len(raw),
-                        "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+                        "crc32": crc,
                     })
                     off += len(raw)
                     written += len(raw)
@@ -274,6 +327,16 @@ class CheckpointWriter:
                 "meta": meta,
                 "tensors": tensors,
             }
+            if self._base is not None:
+                # relative when possible: a checkpoint tree that moves
+                # as a unit keeps working
+                base_abs = os.path.abspath(self._base)
+                try:
+                    rel = os.path.relpath(base_abs,
+                                          os.path.abspath(dirname))
+                except ValueError:  # pragma: no cover - drive split
+                    rel = base_abs
+                manifest["base"] = rel
             if skel is not None:
                 manifest["tree"] = skel
             # unique tmp per writer: a crashed commit's abandoned tmp
@@ -290,8 +353,11 @@ class CheckpointWriter:
             self._gc(dirname, payload_name)
         _m_saves.inc()
         _m_bytes_written.inc(written)
-        _log.info("checkpoint committed: %s (%d tensors, %d bytes)",
-                  dirname, len(tensors), written)
+        if skipped:
+            _m_delta_skipped.inc(skipped)
+        _log.info("checkpoint committed: %s (%d tensors, %d bytes"
+                  "%s)", dirname, len(tensors), written,
+                  f", {skipped} unchanged via base" if skipped else "")
         return os.path.join(dirname, MANIFEST_NAME)
 
     @staticmethod
@@ -313,9 +379,11 @@ class CheckpointWriter:
 
 
 def save_checkpoint_tree(dirname: str, tree,
-                         meta: Optional[Dict[str, Any]] = None) -> str:
-    """One-shot: flatten + stage + commit a nested parameter tree."""
-    w = CheckpointWriter(dirname, meta=meta)
+                         meta: Optional[Dict[str, Any]] = None,
+                         base: Optional[str] = None) -> str:
+    """One-shot: flatten + stage + commit a nested parameter tree.
+    ``base`` makes it a delta save (only changed tensors written)."""
+    w = CheckpointWriter(dirname, meta=meta, base=base)
     w.add_tree(tree)
     return w.commit()
 
@@ -352,14 +420,23 @@ def read_manifest(dirname: str) -> Dict[str, Any]:
     return manifest
 
 
-def load_checkpoint_arrays(dirname: str, verify: bool = True
+def load_checkpoint_arrays(dirname: str, verify: bool = True,
+                           _depth: int = 0
                            ) -> Tuple[Dict[str, np.ndarray],
                                       Dict[str, Any]]:
     """Load the flat ``{name: array}`` map. Arrays are NON-WRITEABLE
     zero-copy views over the mmap'd payload (the map stays alive
     exactly as long as the arrays). ``verify=True`` folds each
     segment's crc32 in bounded chunks first; a mismatch or a truncated
-    payload raises ``CheckpointCorruptError`` naming the tensor."""
+    payload raises ``CheckpointCorruptError`` naming the tensor.
+    Delta checkpoints (entries marked ``"base": true``) resolve
+    through the recorded base chain; a base tensor whose bytes no
+    longer match the delta's recorded crc32 is named corruption, not a
+    silent weight swap."""
+    if _depth > 64:
+        raise CheckpointError(
+            f"checkpoint base chain at '{dirname}' exceeds 64 links — "
+            "circular base references?")
     manifest = read_manifest(dirname)
     payload_path = os.path.join(dirname, manifest["payload"])
     if not os.path.exists(payload_path):
@@ -389,8 +466,12 @@ def load_checkpoint_arrays(dirname: str, verify: bool = True
             f.close()
         out: Dict[str, np.ndarray] = {}
         read = 0
+        base_refs: List[Dict[str, Any]] = []
         for t in manifest["tensors"]:
             name = str(t["name"])
+            if t.get("base"):
+                base_refs.append(t)
+                continue
             off, nbytes = int(t["offset"]), int(t["nbytes"])
             if off < 0 or off + nbytes > size:
                 _m_corrupt.inc()
@@ -423,6 +504,47 @@ def load_checkpoint_arrays(dirname: str, verify: bool = True
                                 offset=off).reshape(t["shape"])
             out[name] = arr  # read-only view over the map: zero-copy
             read += nbytes
+        if base_refs:
+            base_rec = manifest.get("base")
+            if not base_rec:
+                raise CheckpointError(
+                    f"manifest at '{dirname}' marks "
+                    f"{len(base_refs)} tensor(s) as base-resident but "
+                    "records no base checkpoint")
+            base_dir = base_rec if os.path.isabs(base_rec) else \
+                os.path.normpath(os.path.join(dirname, base_rec))
+            base_arrays, base_manifest = load_checkpoint_arrays(
+                base_dir, verify=verify, _depth=_depth + 1)
+            base_idx = {str(bt["name"]): bt
+                        for bt in base_manifest["tensors"]}
+            for t in base_refs:
+                name = str(t["name"])
+                arr = base_arrays.get(name)
+                bt = base_idx.get(name)
+                if arr is None or bt is None:
+                    _m_corrupt.inc()
+                    raise CheckpointCorruptError(
+                        f"tensor '{name}' is recorded as unchanged "
+                        f"since base '{base_dir}', which no longer "
+                        "holds it", tensor=name)
+                # the delta pinned the exact crc/dtype/shape it
+                # skipped: compare against the BASE MANIFEST's entry —
+                # the recursive load above already byte-verified the
+                # base's tensors against that manifest when
+                # verify=True, so an O(1) metadata comparison catches
+                # a drifted/replaced base without re-hashing (and
+                # without copying) the mmap'd bytes a second time
+                same = (str(bt["dtype"]) == str(t["dtype"])
+                        and list(bt["shape"]) == list(t["shape"])
+                        and int(bt["crc32"]) == int(t["crc32"]))
+                if not same:
+                    _m_corrupt.inc()
+                    raise CheckpointCorruptError(
+                        f"tensor '{name}' in base '{base_dir}' no "
+                        f"longer matches the delta's recorded "
+                        f"dtype/shape/crc — the base checkpoint "
+                        "drifted under its delta", tensor=name)
+                out[name] = arr
     _m_loads.inc()
     _m_bytes_read.inc(read)
     return out, manifest

@@ -59,6 +59,7 @@ from ..distributed.rpc import RpcClient
 from ..observability import metrics as _metrics, tracing as _tracing
 from ..observability.log import get_logger
 from ..serving.client import ServingClient, TokenStream
+from ..serving.kv_cache import PREFIX_ROOT, chain_digest
 from ..serving.errors import (EngineRetired, ModelNotFound,
                               ServerOverloaded, ServingError,
                               StreamExpired)
@@ -73,6 +74,10 @@ _m_scrapes = _metrics.counter("fleet.scrapes")
 _m_scrape_errors = _metrics.counter("fleet.scrape_errors")
 _m_route_ms = _metrics.histogram("fleet.route_ms")
 _m_request_ms = _metrics.histogram("fleet.request_ms")
+# dispatches that landed on a replica advertising a prefix-cache hit
+# for the request's prompt (ISSUE 13): warm routing means the replica
+# prefills only the suffix
+_m_routed_warm = _metrics.counter("fleet.routed_warm")
 # mid-stream failovers that re-established a token stream on a
 # survivor and spliced at the delivered offset (ISSUE 12)
 _m_stream_resumes = _metrics.counter("fleet.stream.resumes")
@@ -284,14 +289,38 @@ class FleetRouter:
             self._loads.pop(rid, None)
 
     # -- routing core -----------------------------------------------------
-    def _candidates(self, model: str, need_tokens: Optional[int]
-                    ) -> Tuple[List[Tuple[str, Tuple[str, int]]], int, int]:
+    @staticmethod
+    def _prefix_warm(m: Dict[str, Any],
+                     prompt: Optional[Sequence[int]]) -> bool:
+        """Does this replica's advertised prefix cache cover (at least
+        the first full page of) the request's prompt? The router
+        computes the SAME chained content digest the replica's index
+        keys on — page_size comes from the replica's report, so
+        heterogeneous fleets hash apples to apples."""
+        pc = m.get("prefix_cache")
+        if not prompt or not pc or not pc.get("roots"):
+            return False
+        ps = int(pc.get("page_size") or m.get("page_size") or 0)
+        # a cached full page is only usable when the prompt extends
+        # past it (the last prompt token always recomputes)
+        if ps < 1 or len(prompt) <= ps:
+            return False
+        return chain_digest(PREFIX_ROOT, prompt[:ps]) in pc["roots"]
+
+    def _candidates(self, model: str, need_tokens: Optional[int],
+                    prompt: Optional[Sequence[int]] = None
+                    ) -> Tuple[List[Tuple[str, Tuple[str, int], bool]],
+                               int, int]:
         """Rank replicas for one request. Returns (ranked admissible
-        candidates best-first, #replicas serving the model, #replicas
-        reachable). Admissibility mirrors the replica's own admission
-        checks so the router sheds exactly when the fleet would refuse."""
+        candidates best-first as (rid, ep, warm), #replicas serving the
+        model, #replicas reachable). Admissibility mirrors the
+        replica's own admission checks so the router sheds exactly when
+        the fleet would refuse. Among admissible decoders a replica
+        whose prefix cache covers the request's prompt wins outright
+        (ISSUE 13 — it prefills only the suffix); free KV pages break
+        warmth ties, queue headroom breaks those."""
         table = self.refresh()
-        scored: List[Tuple[float, str, Tuple[str, int]]] = []
+        scored: List[Tuple[float, str, Tuple[str, int], bool]] = []
         serving_model = 0
         reachable = 0
         reports = self._loads_for(sorted(table.items()))
@@ -306,22 +335,26 @@ class FleetRouter:
             serving_model += 1
             if m["queue_depth"] >= m["max_queue"]:
                 continue  # admission queue full: would be refused
+            warm = False
             if m["kind"] == "decoder":
                 if need_tokens is not None:
                     need = _pages_for(need_tokens, m["page_size"])
                     if m["free_pages"] < need:
                         continue  # page pool short: would be refused
-                # most free KV pages first; queue headroom breaks ties
-                score = (m["free_pages"] * 1e6
+                warm = self._prefix_warm(m, prompt)
+                # cache warmth first, then most free KV pages, then
+                # queue headroom
+                score = ((1e12 if warm else 0.0) + m["free_pages"] * 1e6
                          + (m["max_queue"] - m["queue_depth"]))
             else:
                 score = float(m["max_queue"] - m["queue_depth"])
-            scored.append((score, rid, ep))
+            scored.append((score, rid, ep, warm))
         scored.sort(key=lambda s: (-s[0], s[1]))
-        return ([(rid, ep) for _s, rid, ep in scored],
+        return ([(rid, ep, warm) for _s, rid, ep, warm in scored],
                 serving_model, reachable)
 
-    def _route(self, model: str, need_tokens: Optional[int], call):
+    def _route(self, model: str, need_tokens: Optional[int], call,
+               prompt: Optional[Sequence[int]] = None):
         """Pick-and-try loop shared by infer/generate/stream-start.
         ``call(client, rid)`` performs the request on the chosen
         replica's persistent client (rid so a stream can remember which
@@ -342,7 +375,7 @@ class FleetRouter:
                 # attempts (full RPC timeouts) into pass-2's sample
                 t_pass = time.perf_counter()
                 cands, serving_model, reachable = self._candidates(
-                    model, need_tokens)
+                    model, need_tokens, prompt)
                 _m_route_ms.observe(
                     (time.perf_counter() - t_pass) * 1e3)
                 if reachable == 0:
@@ -352,9 +385,9 @@ class FleetRouter:
                         "no live replica reachable (controller table "
                         f"size {table_size})")
                 saw_model = saw_model or serving_model > 0
-                cands = [(rid, ep) for rid, ep in cands
+                cands = [(rid, ep, warm) for rid, ep, warm in cands
                          if rid not in tried]
-                for rid, ep in cands:
+                for rid, ep, warm in cands:
                     tried.add(rid)
                     cli = self._client(rid, ep)
                     with self._mu:
@@ -363,6 +396,8 @@ class FleetRouter:
                             ctr = self._routed[rid] = _metrics.counter(
                                 f"fleet.routed.{rid}")
                     ctr.inc()
+                    if warm:
+                        _m_routed_warm.inc()
                     try:
                         out = call(cli, rid)
                         _m_request_ms.observe(
@@ -457,7 +492,8 @@ class FleetRouter:
             return fs
         return self._route(
             str(model), need,
-            lambda cli, _rid: cli.generate(str(model), prompt, **kw))
+            lambda cli, _rid: cli.generate(str(model), prompt, **kw),
+            prompt=prompt)
 
     def replicas(self) -> List[str]:
         """Live replica ids (cached discovery view)."""
@@ -553,7 +589,7 @@ class FleetTokenStream:
             return rid, cli.generate(self._model, self._prompt,
                                      stream=True, **self._kw)
         self._rid, self._stream = self._router._route(
-            self._model, self._need, start)
+            self._model, self._need, start, prompt=self._prompt)
         self._skip = len(self._delivered)
         if self._skip:
             _m_stream_resumes.inc()

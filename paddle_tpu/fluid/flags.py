@@ -171,6 +171,30 @@ FLAGS: Dict[str, Any] = _Flags({
     # page-table width ladder (ceil(max_seq_len / kv_page_size) is the
     # widest compiled table)
     "decode_max_seq_len": 128,
+    # prefix caching (ISSUE 13): completed prompts publish their full
+    # KV pages into a refcounted radix index; a request sharing a
+    # cached prefix maps those pages read-only and prefills only its
+    # suffix (steps-to-first-token drops to ceil(suffix/prefill_chunk))
+    # with copy-on-write for the partial tail page. False = the PR 6
+    # per-request-scratchpad pool, bit-identical
+    "prefix_cache": True,
+    # KV reservation policy (ISSUE 13): 'demand' reserves the prompt's
+    # pages plus kv_decode_headroom pages at admission and grows
+    # mid-decode — on exhaustion a victim spills to host and resumes
+    # later (preempt-never-corrupts), so admitted concurrency is set by
+    # ACTUAL token demand under long-tailed max_new_tokens;
+    # 'worst_case' is the PR 6 ceil((prompt+max_new)/page_size)
+    # reserve-at-admission policy (reserve-never-dies), kept as the
+    # admitted-concurrency baseline
+    "kv_reservation": "demand",
+    # decode headroom (in pages) a demand-mode reservation adds past
+    # the prompt, so the first generated tokens never immediately
+    # trigger growth
+    "kv_decode_headroom": 1,
+    # where preempted sequences' KV pages spill ('' = host RAM; a
+    # directory path = one .npz per preempted sequence, so heavy
+    # preemption doesn't balloon the serving host's memory)
+    "kv_spill_dir": "",
     # chunked prefill (ISSUE 10): per-step prompt-token budget AND the
     # compiled chunk width of the mixed decode step — a P-token prompt
     # completes prefill in ceil(P/prefill_chunk) steps instead of P.

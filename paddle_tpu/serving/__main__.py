@@ -5,9 +5,12 @@
         builds two versions of a tiny model, then exercises the bucketed
         batcher (jit-compile bound + batch-invariance), the RPC
         server/client path, an atomic hot-swap, the overload rejection
-        path, and the DECODE path (ISSUE 6: paged-KV continuous
+        path, the DECODE path (ISSUE 6: paged-KV continuous
         batching — warmed slot/width ladder, zero churn compiles, page
-        exhaustion refusal, RPC generate + decoder hot-swap).
+        exhaustion refusal, RPC generate + decoder hot-swap), and the
+        ISSUE 13 layer (prefix-cache hits prefill only the suffix;
+        demand reservation + preempt/restore completes an over-
+        committed pool with reference-equal tokens).
         Exit-nonzero on any failure — wired into tools/check.py as the
         serving smoke.
 
@@ -257,6 +260,74 @@ def run_selftest(verbose: bool = True) -> int:
                 ueng.stop()
         finally:
             ceng.stop()
+
+        # -- 5. prefix caching + preemption (ISSUE 13) -------------------
+        peng = DecodeEngine(spec, name="prefix", slots=[2], page_size=4,
+                            num_pages=24, max_seq_len=20,
+                            prefill_chunk=4, prefix_cache=True)
+        try:
+            prompt12 = list(range(12))
+            cold = peng.generate(prompt12, max_new_tokens=3)
+            check(cold["cached_tokens"] == 0
+                  and cold["steps_to_first_token"] == 3,
+                  "cold prompt prefilled in ceil(12/4) steps")
+            # shared 8-token prefix, fresh suffix: prefill = the suffix
+            warm = peng.generate(prompt12[:8] + [20, 21, 22, 23],
+                                 max_new_tokens=3)
+            check(warm["cached_tokens"] >= 8
+                  and warm["steps_to_first_token"] == 1,
+                  f"shared-prefix request mapped "
+                  f"{warm['cached_tokens']} cached tokens, "
+                  "first token in ceil(suffix/4) = 1 step")
+            st = peng.cache.allocator.stats()
+            check(st["pages_used"] == 0 and st["prefix_pages"] > 0,
+                  "freed shared pages retained reclaimable "
+                  f"({st['prefix_pages']} cached, 0 live)")
+            cold2 = DecodeEngine(spec, name="prefix_cold", slots=[2],
+                                 page_size=4, num_pages=24,
+                                 max_seq_len=20, prefill_chunk=4,
+                                 prefix_cache=False)
+            try:
+                ref = cold2.generate(prompt12[:8] + [20, 21, 22, 23],
+                                     max_new_tokens=3)
+                check(ref["tokens"] == warm["tokens"],
+                      "cache-hit tokens identical to a cold engine's")
+            finally:
+                cold2.stop()
+        finally:
+            peng.stop()
+        # demand reservation + preempt/restore: a pool far too small
+        # for the worst case still completes everything, tokens equal
+        # the unpreempted reference
+        preempts = _metrics.counter("serving.kv.preemptions")
+        base_pre = preempts.value()
+        deng2 = DecodeEngine(spec, name="demand", slots=[4], page_size=4,
+                             num_pages=13, max_seq_len=44,
+                             prefill_chunk=4, prefix_cache=False,
+                             reservation="demand")
+        try:
+            reqs = [deng2.submit([1 + i], max_new_tokens=30)
+                    for i in range(4)]
+            ok = all(r.ev.wait(240) and r.error is None for r in reqs)
+            check(ok and preempts.value() > base_pre,
+                  f"undersized pool completed via preempt+restore "
+                  f"({preempts.value() - base_pre} preemptions)")
+            check(deng2.cache.allocator.stats()["pages_used"] == 0,
+                  "every page (spilled included) returned to the pool")
+            wide = DecodeEngine(spec, name="demand_ref", slots=[4],
+                                page_size=4, num_pages=64,
+                                max_seq_len=44, prefill_chunk=4,
+                                prefix_cache=False,
+                                reservation="worst_case")
+            try:
+                sample = wide.generate([1], max_new_tokens=30)
+                check(sample["tokens"] == reqs[0].result["tokens"],
+                      "preempted tokens bitwise equal unpreempted "
+                      "reference")
+            finally:
+                wide.stop()
+        finally:
+            deng2.stop()
 
         # decode over RPC with a hot-swap
         srv2 = ServingServer()

@@ -211,7 +211,9 @@ class ServingClient:
                      max_seq_len: Optional[int] = None,
                      max_queue: Optional[int] = None,
                      prefill_chunk: Optional[int] = None,
-                     checkpoint_dir: Optional[str] = None
+                     checkpoint_dir: Optional[str] = None,
+                     prefix_cache: Optional[bool] = None,
+                     reservation: Optional[str] = None
                      ) -> Dict[str, Any]:
         """Deploy a DecodeEngine; hot-swaps like load_model. From a
         ``spec`` dict (see serving.decode.DecoderSpec) the server
@@ -220,7 +222,10 @@ class ServingClient:
         manifest checkpoint — spec optional then, and if given it must
         match the checkpoint's. ``prefill_chunk`` pins the chunked-
         prefill token budget (None = the server resolves it through its
-        autotune cache/FLAGS)."""
+        autotune cache/FLAGS). ``prefix_cache``/``reservation`` pin the
+        ISSUE 13 policies (prompt-prefix KV reuse; 'demand' vs
+        'worst_case' page reservation) — None defers to the server's
+        FLAGS."""
         try:
             return self._rpc.call(
                 "load_decoder", model,
@@ -228,7 +233,9 @@ class ServingClient:
                 _ladder_arg(slots),
                 page_size, num_pages, max_seq_len, max_queue,
                 None if prefill_chunk is None else int(prefill_chunk),
-                None if checkpoint_dir is None else str(checkpoint_dir))
+                None if checkpoint_dir is None else str(checkpoint_dir),
+                None if prefix_cache is None else bool(prefix_cache),
+                None if reservation is None else str(reservation))
         except RuntimeError as e:
             _raise_typed(e)
 

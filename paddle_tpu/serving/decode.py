@@ -70,7 +70,7 @@ from ..observability.log import get_logger
 from .engine import bucket_for as _bucket_for, resolve_bucket_spec
 from .errors import (DeadlineExceeded, EngineRetired, RequestTooLarge,
                      ServerOverloaded, ServingError)
-from .kv_cache import GARBAGE_PAGE, PagedKvCache
+from .kv_cache import GARBAGE_PAGE, HostSpillStore, PagedKvCache
 
 __all__ = ["DecoderSpec", "DecodeEngine", "build_decoder_params",
            "decoder_step", "decoder_step_chunked", "width_ladder",
@@ -106,6 +106,13 @@ _m_prefill_per_step = _metrics.histogram(
     "serving.decode.prefill_tokens_per_step")
 _m_first_token_steps = _metrics.histogram(
     "serving.decode.steps_to_first_token")
+# preempt+restore (ISSUE 13, demand-mode reservation): preemptions
+# spill a victim's pages to host and requeue it at the front; restores
+# scatter them back bitwise; demotions release a QUEUED reservation
+# (no computed work lost) so a live grower can proceed
+_m_preemptions = _metrics.counter("serving.kv.preemptions")
+_m_restores = _metrics.counter("serving.kv.restores")
+_m_demotions = _metrics.counter("serving.kv.demotions")
 
 
 # --- the pluggable decoder model ----------------------------------------
@@ -360,7 +367,8 @@ def width_ladder(max_pages: int) -> List[int]:
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "deadline", "ev", "result", "error",
                  "t_enq", "seq_id", "trace_ctx", "temperature", "top_k",
-                 "seed", "produced")
+                 "seed", "produced", "cached_tokens", "cow", "resume_pos",
+                 "published", "carry_steps", "carry_fts", "needs_alloc")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  deadline: Optional[float], seq_id: int,
@@ -382,6 +390,22 @@ class _DecodeRequest:
         # streaming readers (stream_tokens, ISSUE 12) can see tokens
         # the moment they exist, long before the sequence finishes
         self.produced: List[int] = []
+        # prefix caching + preemption state (ISSUE 13) — on the REQUEST
+        # because preemption round-trips a sequence through the queue:
+        # cached_tokens = prompt tokens answered from the prefix index
+        # (prefill starts past them); cow = the pending private-copy of
+        # a shared partial page (executed by the scheduler before the
+        # first step, then None); resume_pos/carry_* = the exact point
+        # a preempted sequence continues from; needs_alloc = the
+        # reservation was surrendered (preempt/demote) and admission
+        # must re-reserve before taking a slot
+        self.cached_tokens = 0
+        self.cow: Optional[Dict[str, int]] = None
+        self.resume_pos: Optional[int] = None
+        self.published = False
+        self.carry_steps = 0
+        self.carry_fts: Optional[int] = None
+        self.needs_alloc = False
 
     def fail(self, err: BaseException):
         self.error = err
@@ -389,7 +413,8 @@ class _DecodeRequest:
 
 
 class _Slot:
-    __slots__ = ("req", "pos", "pages_held", "steps", "first_token_steps")
+    __slots__ = ("req", "pos", "pages_held", "steps", "first_token_steps",
+                 "pending_restore")
 
     def __init__(self, req: _DecodeRequest, pages_held: int):
         self.req = req
@@ -397,6 +422,10 @@ class _Slot:
         self.pages_held = pages_held
         self.steps = 0              # scheduler steps this slot has ridden
         self.first_token_steps: Optional[int] = None
+        # a preempted sequence's spilled pages must scatter back into
+        # its fresh reservation BEFORE its next step (restore-before-
+        # step): set at re-admission, executed by _prepare
+        self.pending_restore = False
 
     def token_at(self, idx: int) -> int:
         """The sequence's token at absolute position ``idx``: a prompt
@@ -426,6 +455,9 @@ class DecodeEngine:
                  prefill_chunk: Optional[int] = None,
                  continuous: bool = True,
                  params: Optional[Dict[str, Any]] = None,
+                 prefix_cache: Optional[bool] = None,
+                 reservation: Optional[str] = None,
+                 spill_dir: Optional[str] = None,
                  warm: bool = True):
         from ..fluid.flags import FLAGS, effective_flag
 
@@ -456,10 +488,30 @@ class DecodeEngine:
         # honest A/B baseline for decode_bench — same engine, same
         # compiled shapes, admission gated on an empty batch
         self._continuous = bool(continuous)
+        # prefix caching + reservation policy (ISSUE 13). demand mode
+        # reserves the prompt's pages plus kv_decode_headroom pages at
+        # admission and grows mid-decode (preempting when the pool runs
+        # dry); worst_case is the PR 6 reserve-everything policy, kept
+        # as the bench's admitted-concurrency baseline
+        self._prefix_on = bool(FLAGS["prefix_cache"]
+                               if prefix_cache is None else prefix_cache)
+        reservation = str(FLAGS["kv_reservation"]
+                          if reservation is None else reservation)
+        if reservation not in ("demand", "worst_case"):
+            raise ValueError(
+                f"reservation must be 'demand' or 'worst_case', "
+                f"got {reservation!r}")
+        self._reservation = reservation
+        self._headroom_pages = max(0, int(FLAGS["kv_decode_headroom"]))
         self.cache = PagedKvCache(
             spec.n_layers, spec.n_kv_heads, spec.head_dim,
             page_size=ps, num_pages=npages,
-            label=f"{self.name}.v{self.version}")
+            label=f"{self.name}.v{self.version}",
+            prefix_cache=self._prefix_on)
+        # host refuge for preempted sequences' pages (kv_spill_dir
+        # moves it to disk); cleared at retirement — leaks nothing
+        self._spill = HostSpillStore(
+            spill_dir=spill_dir, label=f"{self.name}.v{self.version}")
         w_max = self.cache.allocator.pages_for_tokens(self.max_seq_len)
         self._width_ladder = width_ladder(w_max)
         # chunked prefill (ISSUE 10): the per-step prompt-token budget
@@ -591,6 +643,18 @@ class DecodeEngine:
             raise RequestTooLarge(
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new}) = "
                 f"{total} exceeds max_seq_len {self.max_seq_len}")
+        if self._reservation == "demand" and \
+                self.cache.allocator.pages_for_tokens(total) > \
+                self.cache.num_pages - 1:
+            # demand mode admits beyond the worst case, so the ONLY
+            # hard bound is "could this sequence fit even alone, with
+            # everyone else preempted" — refuse up front if not (the
+            # growth path's progress guarantee depends on it)
+            raise RequestTooLarge(
+                f"worst case {total} tokens = "
+                f"{self.cache.allocator.pages_for_tokens(total)} pages "
+                f"exceeds the whole pool "
+                f"({self.cache.num_pages - 1} usable pages)")
         temperature = float(temperature)
         top_k = int(top_k)
         if temperature < 0.0 or not math.isfinite(temperature):
@@ -611,16 +675,22 @@ class DecodeEngine:
             self._seq_counter += 1
             seq_id = self._seq_counter
             try:
-                # reserve the worst case NOW: an admitted sequence can
-                # never die of page exhaustion mid-decode; the pool is
-                # the admission bound (kv_cache.py)
-                self.cache.allocator.alloc(seq_id, total)
+                # reserve NOW: worst_case mode takes the whole
+                # prompt+max_new bound (an admitted sequence can then
+                # never die of exhaustion); demand mode takes only the
+                # prompt plus a small decode headroom — growth and
+                # preemption own the tail (ISSUE 13). Either way the
+                # pool is the admission bound (kv_cache.py) and the
+                # refusal is typed and side-effect-free.
+                res = self._reserve_locked(seq_id, prompt, total)
             except ServerOverloaded:
                 _m_overloads.inc()
                 raise
             req = _DecodeRequest(prompt, max_new, deadline, seq_id,
                                  temperature=temperature, top_k=top_k,
                                  seed=seed)
+            req.cached_tokens = res["cached_tokens"]
+            req.cow = res["cow"]
             self._queue.append(req)
             self._n_requests += 1
             self._g_depth.set(len(self._queue))
@@ -739,19 +809,19 @@ class DecodeEngine:
             self._stopping = True
             if not drain:
                 for r in self._queue:
-                    self.cache.allocator.free(r.seq_id)
-                    r.fail(EngineRetired(
+                    self._fail_locked(r, EngineRetired(
                         f"decoder '{self.name}' v{self.version} unloaded"))
                 self._queue.clear()
                 for s in self._slots:
-                    self.cache.allocator.free(s.req.seq_id)
                     # a slot _complete()d mid-step may still be in
                     # _slots (removal happens under _cond after the
                     # step) — never overwrite a delivered result
                     if not s.req.ev.is_set():
-                        s.req.fail(EngineRetired(
+                        self._fail_locked(s.req, EngineRetired(
                             f"decoder '{self.name}' v{self.version} "
                             "unloaded"))
+                    else:
+                        self.cache.allocator.free(s.req.seq_id)
                 self._slots = []
                 self._g_depth.set(0)
             self._cond.notify_all()
@@ -768,6 +838,9 @@ class DecodeEngine:
             self._params = None
             self._step_fn = None
             self.cache.release()
+        # any spills that survived the drain (preempted sequences the
+        # retirement failed) die with the engine — files included
+        self._spill.clear()
         with self._cond:
             self._released = True
             self._g_depth.set(0)
@@ -797,6 +870,10 @@ class DecodeEngine:
                 "page_size": self.cache.page_size,
                 "max_seq_len": self.max_seq_len,
                 "continuous": self._continuous,
+                "reservation": self._reservation,
+                "prefix_cache": self._prefix_on,
+                "prefix": self.cache.allocator.prefix_stats(),
+                "spilled_sequences": self._spill.count(),
                 "kv": self.cache.allocator.stats(),
                 "queue_depth": len(self._queue),
                 "live": len(self._slots),
@@ -808,8 +885,33 @@ class DecodeEngine:
             }
 
     # -- scheduler --------------------------------------------------------
+    def _reserve_locked(self, seq_id: int, prompt, total: int
+                        ) -> Dict[str, Any]:
+        """One reservation under the engine's policy: demand = prompt
+        pages + decode headroom (capped at the worst case), worst_case
+        = everything. Prefix caching maps the cached chain read-only
+        either way. Raises ``ServerOverloaded`` side-effect-free."""
+        if self._reservation == "demand":
+            reserve = min(total, len(prompt)
+                          + self._headroom_pages * self.cache.page_size)
+        else:
+            reserve = total
+        if self._prefix_on:
+            return self.cache.allocator.alloc_prefix(seq_id, prompt,
+                                                     reserve)
+        self.cache.allocator.alloc(seq_id, reserve)
+        return {"cached_tokens": 0, "cow": None}
+
     def _fail_locked(self, req: _DecodeRequest, err: BaseException):
         self.cache.allocator.free(req.seq_id)
+        if req.cow is not None:
+            # the COW source pin must not outlive the request (a pinned
+            # entry is un-evictable)
+            self.cache.allocator.release_cow(req.cow["key"])
+            req.cow = None
+        # a preempted request's host spill dies with it — cancel/
+        # deadline/retirement mid-preemption leaks nothing
+        self._spill.drop(req.seq_id)
         req.fail(err)
 
     def _drop_expired_locked(self, now: float):
@@ -829,14 +931,53 @@ class DecodeEngine:
     def _admit_locked(self):
         """Move queued requests into free slots. Continuous mode admits
         whenever a slot is free — INTO the in-flight batch; drain mode
-        (the bench baseline) only refills an empty batch."""
+        (the bench baseline) only refills an empty batch. A request
+        whose reservation was surrendered (preempted victims sit at the
+        queue FRONT, demoted reservations wherever they were) must
+        re-reserve first; a refusal leaves it queued — completions and
+        cache evictions free the pages it is waiting for."""
         if not self._continuous and self._slots:
             return
         while self._queue and len(self._slots) < self._max_slots:
-            req = self._queue.pop(0)
-            pages = self.cache.allocator.pages_for_tokens(
-                len(req.prompt) + req.max_new)
-            self._slots.append(_Slot(req, pages))
+            req = self._queue[0]
+            if req.ev.is_set():
+                # canceled / expired while queued — already failed
+                self._queue.pop(0)
+                continue
+            if req.needs_alloc:
+                total = len(req.prompt) + req.max_new
+                try:
+                    if req.resume_pos is not None:
+                        # restore-before-step: cover what was spilled
+                        # plus the decode headroom; prefix matching is
+                        # deliberately NOT consulted — the spill is the
+                        # bitwise truth (preempt-never-corrupts)
+                        reserve = min(total, max(req.resume_pos, 1)
+                                      + self._headroom_pages
+                                      * self.cache.page_size)
+                        self.cache.allocator.alloc(req.seq_id, reserve)
+                    else:
+                        res = self._reserve_locked(req.seq_id,
+                                                   req.prompt, total)
+                        req.cached_tokens = res["cached_tokens"]
+                        req.cow = res["cow"]
+                except ServerOverloaded:
+                    break
+                req.needs_alloc = False
+            self._queue.pop(0)
+            slot = _Slot(req,
+                         self.cache.allocator.held_pages(req.seq_id))
+            if req.resume_pos is not None:
+                slot.pos = req.resume_pos
+                slot.pending_restore = True
+                req.resume_pos = None
+            else:
+                # cached prompt pages are already written (and mapped):
+                # prefill starts at the first uncached token
+                slot.pos = req.cached_tokens
+            slot.steps = req.carry_steps
+            slot.first_token_steps = req.carry_fts
+            self._slots.append(slot)
             _m_admitted.inc()
             _m_queue_wait.observe((time.monotonic() - req.t_enq) * 1e3)
         self._g_depth.set(len(self._queue))
@@ -918,6 +1059,157 @@ class DecodeEngine:
             self.cache.rebind(k, v)
             return logits
 
+    def _prepare(self, live: List[_Slot]
+                 ) -> Tuple[List[_Slot], List[int]]:
+        """Pre-step phase (scheduler thread, ISSUE 13): execute pending
+        COW copies and preemption restores (device writes, batched,
+        under ``_step_mu`` — the same serialization every pool touch
+        gets), then grow demand-mode reservations to cover this step's
+        grants, preempting/demoting when the pool runs dry. Returns the
+        (possibly shrunk) live list and its grants."""
+        cows: List[Tuple[int, int]] = []
+        restores = []
+        spills: Dict[int, Any] = {}
+        for s in live:
+            if s.pending_restore:
+                s.pending_restore = False
+                # pop (disk-backed spills np.load) stays outside _cond
+                spills[s.req.seq_id] = self._spill.pop(s.req.seq_id)
+        with self._cond:
+            # request state (cow, pages, spill ownership) is mutated by
+            # cancel()/_fail_locked under _cond — read it under _cond
+            # too, or a mid-window cancel hands us freed pages / a
+            # half-released COW
+            for s in live:
+                if s.req.ev.is_set():
+                    # canceled: pages already freed and any spill
+                    # dropped; the popped arrays (if any) die here and
+                    # the slot rides one last garbage-table step
+                    continue
+                spill = spills.get(s.req.seq_id)
+                if spill is not None:
+                    pages = self.cache.allocator.pages_of(s.req.seq_id)
+                    restores.append((pages[:spill[0].shape[1]], spill))
+                    _m_restores.inc()
+                if s.req.cow is not None:
+                    cows.append((s.req.cow["src"], s.req.cow["dst"]))
+                    # released before the device copy runs: safe, the
+                    # scheduler thread issues every device write, so an
+                    # evicted-and-reused src page cannot be rewritten
+                    # before copy_pages below reads it
+                    self.cache.allocator.release_cow(s.req.cow["key"])
+                    s.req.cow = None
+        if cows or restores:
+            with self._step_mu:
+                self.cache.copy_pages(cows)
+                for pages, (k, v) in restores:
+                    self.cache.scatter_pages(pages, k, v)
+        while True:
+            grants = self._grants(live)
+            grower = None
+            for s, g in zip(live, grants):
+                if s.req.ev.is_set():
+                    continue  # canceled: pages gone, rides one last
+                    # step through the garbage table, answered nowhere
+                need = self.cache.allocator.pages_for_tokens(s.pos + g)
+                if need > s.pages_held:
+                    grower = (s, need - s.pages_held)
+                    break
+            if grower is None:
+                return live, grants
+            s, n = grower
+            try:
+                self.cache.allocator.grow(s.req.seq_id, n)
+                s.pages_held += n
+                continue
+            except ServerOverloaded:
+                pass
+            if self._reclaim_for_growth(s, live):
+                continue
+            # nothing reclaimable: the submit-time worst-case-fits-pool
+            # check makes this unreachable unless an external allocator
+            # user pins pages — fail typed rather than corrupt
+            with self._cond:
+                if not s.req.ev.is_set():
+                    _m_overloads.inc()
+                    self._fail_locked(s.req, ServerOverloaded(
+                        f"KV pool exhausted mid-decode for seq "
+                        f"{s.req.seq_id} with nothing left to preempt "
+                        "— external pages pinned?"))
+                self._slots = [x for x in self._slots if x is not s]
+                self._g_live.set(len(self._slots))
+            live = [x for x in live if x is not s]
+            if not live:
+                return live, []
+
+    def _reclaim_for_growth(self, grower: _Slot,
+                            live: List[_Slot]) -> bool:
+        """Make pages available for a live slot's growth: demote the
+        newest QUEUED reservation first (it has no computed work to
+        lose — admission re-reserves it later), else preempt the
+        newest live slot other than the grower (spill + requeue at the
+        front). Mutates ``live`` in place when it preempts. False =
+        nothing left to take."""
+        with self._cond:
+            for req in reversed(self._queue):
+                if req.ev.is_set() or req.needs_alloc:
+                    continue
+                self.cache.allocator.free(req.seq_id)
+                if req.cow is not None:
+                    self.cache.allocator.release_cow(req.cow["key"])
+                    req.cow = None
+                req.cached_tokens = 0
+                req.needs_alloc = True
+                _m_demotions.inc()
+                return True
+        victim = None
+        for s in reversed(live):
+            if s is grower or s.req.ev.is_set():
+                continue
+            victim = s
+            break
+        if victim is None:
+            return False
+        self._preempt(victim)
+        live.remove(victim)
+        return True
+
+    def _preempt(self, victim: _Slot):
+        """Spill the victim's written pages to host (bitwise), free its
+        reservation, and requeue it at the FRONT so preemption cannot
+        become starvation. Restore scatters the spill into a fresh
+        reservation and the page table rebinds — the sequence's K/V
+        round-trips exactly (preempt-never-corrupts; reserve-never-dies
+        was the PR 6 policy this replaces)."""
+        _faults.fire("serving.decode.preempt")
+        req = victim.req
+        with _tracing.span("serving.decode.preempt", model=self.name,
+                           version=self.version, seq=req.seq_id,
+                           tokens=victim.pos):
+            pages = self.cache.allocator.pages_of(req.seq_id)
+            n_keep = (self.cache.allocator.pages_for_tokens(victim.pos)
+                      if victim.pos else 0)
+            if n_keep:
+                with self._step_mu:
+                    k, v = self.cache.gather_pages(pages[:n_keep])
+                self._spill.put(req.seq_id, k, v)
+            self.cache.allocator.free(req.seq_id)
+            _m_preemptions.inc()
+            with self._cond:
+                self._slots = [x for x in self._slots if x is not victim]
+                if req.ev.is_set():
+                    # canceled/stopped while we spilled: nothing will
+                    # resume — drop the spill, leak nothing
+                    self._spill.drop(req.seq_id)
+                else:
+                    req.resume_pos = victim.pos
+                    req.carry_steps = victim.steps
+                    req.carry_fts = victim.first_token_steps
+                    req.needs_alloc = True
+                    self._queue.insert(0, req)
+                    self._g_depth.set(len(self._queue))
+                self._g_live.set(len(self._slots))
+
     def _grants(self, live: List[_Slot]) -> List[int]:
         """Token-budget scheduling (Sarathi-style, ISSUE 10): every
         slot past its prompt gets its one decode token unconditionally
@@ -949,10 +1241,14 @@ class DecodeEngine:
         # a fast engine; `error@` fails the step's slots like any other
         # step failure. Zero cost with no plan installed.
         _faults.fire("serving.decode.step")
+        # restore-before-step, COW copies, demand-mode growth (may
+        # preempt/demote — the returned live list is authoritative)
+        live, grants = self._prepare(live)
+        if not live:
+            return
         s_bucket = _bucket_for(self._slot_ladder, len(live))
         w_need = max(s.pages_held for s in live)
         w_bucket = _bucket_for(self._width_ladder, w_need)
-        grants = self._grants(live)
         # pure-decode steps (and 1-token prefill tails) ride the C=1
         # shapes — exactly the PR 6 step; only steps carrying a real
         # chunk pay the chunk-wide compute
@@ -971,13 +1267,15 @@ class DecodeEngine:
             # keys INCLUDING this chunk; within it, query j attends
             # only keys up to its own position (chunk-causal)
             lens[i] = s.pos + g
-            # reserve-at-admission must hold under chunking: a grant
-            # can never write past the pages reserved at submit (the
-            # prompt is part of the worst case the admission priced).
-            # A real raise, not an assert: writing through a page index
-            # past the reservation would corrupt another sequence's
-            # pages, and `python -O` strips asserts
-            if lens[i] > s.pages_held * self.cache.page_size:
+            # the reservation (grown by _prepare in demand mode) must
+            # cover every write this step performs. A real raise, not
+            # an assert: writing through a page index past the
+            # reservation would corrupt another sequence's pages, and
+            # `python -O` strips asserts. Canceled slots are exempt —
+            # their pages are gone and their table row is all-garbage,
+            # so their writes land on the garbage page by construction
+            if not s.req.ev.is_set() and \
+                    lens[i] > s.pages_held * self.cache.page_size:
                 raise ServingError(
                     f"chunk grant escaped seq {s.req.seq_id}'s page "
                     f"reservation ({lens[i]} tokens > "
@@ -1028,6 +1326,16 @@ class DecodeEngine:
                 s.steps += 1
                 s.pos += g
                 notes[s.req.seq_id] = s.pos
+                if self._prefix_on and not s.req.published and \
+                        s.pos >= len(s.req.prompt):
+                    # prompt K/V fully on-device as of THIS step:
+                    # publish the prompt pages into the prefix index
+                    # (metadata only; from here they are immutable —
+                    # this sequence only ever writes PAST them, and
+                    # they outlive its free() as the shared cache)
+                    self.cache.allocator.publish(s.req.seq_id,
+                                                 s.req.prompt)
+                    s.req.published = True
                 tok = None
                 if s.pos >= len(s.req.prompt):
                     # logits_np[i] is the slot's newest lane (the step
@@ -1086,7 +1394,12 @@ class DecodeEngine:
             "version": self.version,
             # scheduler steps from admission to the first generated
             # token — the load-independent chunked-prefill evidence
-            # (ceil(P/chunk) + co-riding, vs P unchunked)
+            # (ceil(P/chunk) + co-riding, vs P unchunked; for a
+            # prefix-cache hit, suffix takes the prompt's place:
+            # ceil((P - cached)/chunk))
             "steps_to_first_token": int(s.first_token_steps or s.steps),
+            # prompt tokens answered from the prefix index instead of
+            # prefilled (0 = cold)
+            "cached_tokens": int(s.req.cached_tokens),
         }
         s.req.ev.set()

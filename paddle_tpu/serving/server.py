@@ -437,7 +437,9 @@ class ServingServer:
                       max_seq_len: Optional[int] = None,
                       max_queue: Optional[int] = None,
                       prefill_chunk: Optional[int] = None,
-                      checkpoint_dir: Optional[str] = None
+                      checkpoint_dir: Optional[str] = None,
+                      prefix_cache: Optional[bool] = None,
+                      reservation: Optional[str] = None
                       ) -> Dict[str, Any]:
         """Build + warm (every slot/width shape) + atomically install a
         DecodeEngine. ``checkpoint_dir`` loads REAL weights (and the
@@ -481,7 +483,11 @@ class ServingServer:
                     version=version, slots=slots, page_size=page_size,
                     num_pages=num_pages, max_seq_len=max_seq_len,
                     max_queue=max_queue, prefill_chunk=prefill_chunk,
-                    params=params)
+                    params=params,
+                    prefix_cache=(None if prefix_cache is None
+                                  else bool(prefix_cache)),
+                    reservation=(None if reservation is None
+                                 else str(reservation)))
 
             engine = self._registry.deploy(model, build)
             return engine.stats()
@@ -552,6 +558,13 @@ class ServingServer:
                 entry["live_slots"] = st["live"]
                 entry["max_slots"] = max(st["slots"])
                 entry["max_seq_len"] = st["max_seq_len"]
+                # prefix-cache warmth (ISSUE 13): the MRU depth-1
+                # chain digests let a FleetRouter recognize a replica
+                # whose cache already covers a request's prefix —
+                # steps-to-first-token there is ceil(suffix/chunk),
+                # not ceil(prompt/chunk)
+                if st.get("prefix") is not None:
+                    entry["prefix_cache"] = st["prefix"]
             models[name] = entry
         return {"ok": True, "models": models}
 

@@ -1,0 +1,182 @@
+"""Incremental/delta checkpoints (ISSUE 13, ROADMAP checkpoint
+residual #3): ``save_decoder_checkpoint(base_manifest=)`` writes only
+tensors whose crc32 differs from the base; loads follow the base
+chain; a drifted base is NAMED corruption, never a silent weight swap.
+"""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.checkpoint import (CheckpointCorruptError, CheckpointError,
+                                   load_decoder_checkpoint,
+                                   save_decoder_checkpoint)
+from paddle_tpu.checkpoint.format import (load_checkpoint_tree,
+                                          read_manifest,
+                                          save_checkpoint_tree)
+from paddle_tpu.observability import metrics
+from paddle_tpu.serving.decode import DecoderSpec, build_decoder_params
+
+
+def _spec():
+    return DecoderSpec(vocab=32, d_model=16, n_layers=2, n_heads=2,
+                       n_kv_heads=1, seed=7)
+
+
+def _payload_bytes(dirname):
+    (p,) = glob.glob(os.path.join(dirname, "segments-*.bin"))
+    return os.path.getsize(p)
+
+
+def test_delta_writes_only_changed_tensors(tmp_path):
+    """A one-tensor fine-tune costs one tensor of payload: every other
+    manifest entry is a base reference (crc32 recorded, no offset),
+    and the loaded tree is bitwise the new model."""
+    spec = _spec()
+    params = build_decoder_params(spec)
+    base = str(tmp_path / "base")
+    delta = str(tmp_path / "delta")
+    save_decoder_checkpoint(base, spec, params, step=1)
+    changed = dict(params)
+    changed["tok_emb"] = np.asarray(params["tok_emb"]) + 1.0
+    base_skip = metrics.counter("checkpoint.delta_skipped").value()
+    save_decoder_checkpoint(delta, spec, changed, step=2,
+                            base_manifest=base)
+    man = read_manifest(delta)
+    refs = [t for t in man["tensors"] if t.get("base")]
+    written = [t for t in man["tensors"] if not t.get("base")]
+    assert len(written) == 1 and written[0]["name"] == "tok_emb"
+    assert len(refs) == len(man["tensors"]) - 1
+    assert all("crc32" in t and "shape" in t for t in refs)
+    assert metrics.counter("checkpoint.delta_skipped").value() \
+        == base_skip + len(refs)
+    # the delta payload holds ONE tensor, the base holds them all
+    assert _payload_bytes(delta) < _payload_bytes(base) / 4
+    spec2, tree = load_decoder_checkpoint(delta)
+    assert spec2.to_dict() == spec.to_dict()
+    assert np.array_equal(np.asarray(tree["tok_emb"]),
+                          np.asarray(changed["tok_emb"]))
+    assert np.array_equal(np.asarray(tree["layer0"]["wq"]),
+                          np.asarray(params["layer0"]["wq"]))
+
+
+def test_delta_chain_loads_through_every_link(tmp_path):
+    """delta-of-delta: each link contributes its changed tensors; the
+    resolved tree equals the latest logical state bitwise."""
+    spec = _spec()
+    p0 = build_decoder_params(spec)
+    d0, d1, d2 = (str(tmp_path / n) for n in ("c0", "c1", "c2"))
+    save_decoder_checkpoint(d0, spec, p0)
+    p1 = dict(p0)
+    p1["tok_emb"] = np.asarray(p0["tok_emb"]) * 2.0
+    save_decoder_checkpoint(d1, spec, p1, base_manifest=d0)
+    p2 = dict(p1)
+    p2["lnf"] = (np.asarray(p1["lnf"][0]) + 3.0, np.asarray(p1["lnf"][1]))
+    save_decoder_checkpoint(d2, spec, p2, base_manifest=d1)
+    man2 = read_manifest(d2)
+    written = sorted(t["name"] for t in man2["tensors"]
+                     if not t.get("base"))
+    assert written == ["lnf/0"]
+    _spec2, tree = load_decoder_checkpoint(d2)
+    assert np.array_equal(np.asarray(tree["lnf"][0]),
+                          np.asarray(p2["lnf"][0]))
+    assert np.array_equal(np.asarray(tree["tok_emb"]),
+                          np.asarray(p1["tok_emb"]))
+    assert np.array_equal(np.asarray(tree["layer1"]["w2"]),
+                          np.asarray(p0["layer1"]["w2"]))
+
+
+def test_delta_base_drift_is_named_corruption(tmp_path):
+    """A bit flip in the BASE is caught at delta load with the tensor
+    named — the delta pinned the exact crc32 it skipped."""
+    spec = _spec()
+    params = build_decoder_params(spec)
+    base = str(tmp_path / "base")
+    delta = str(tmp_path / "delta")
+    save_decoder_checkpoint(base, spec, params)
+    changed = dict(params)
+    changed["tok_emb"] = np.asarray(params["tok_emb"]) + 1.0
+    save_decoder_checkpoint(delta, spec, changed, base_manifest=base)
+    (payload,) = glob.glob(os.path.join(base, "segments-*.bin"))
+    with open(payload, "r+b") as f:
+        f.seek(200)
+        b = f.read(1)
+        f.seek(200)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(CheckpointCorruptError) as ei:
+        load_decoder_checkpoint(delta)
+    assert ei.value.tensor is not None
+
+
+def test_delta_missing_base_tensor_and_gone_base(tmp_path):
+    """A base whose manifest was REPLACED (tensor gone / crc changed)
+    fails typed; a vanished base directory fails typed too."""
+    d_base = str(tmp_path / "b")
+    d_delta = str(tmp_path / "d")
+    tree = {"a": np.arange(6, dtype=np.float32),
+            "b": np.ones((3,), np.float32)}
+    save_checkpoint_tree(d_base, tree, meta={"kind": "generic"})
+    tree2 = {"a": np.arange(6, dtype=np.float32),
+             "b": np.zeros((3,), np.float32)}
+    save_checkpoint_tree(d_delta, tree2, meta={"kind": "generic"},
+                         base=d_base)
+    # re-save the base WITHOUT tensor 'a': the delta's reference dangles
+    save_checkpoint_tree(d_base, {"b": np.ones((3,), np.float32)},
+                         meta={"kind": "generic"})
+    with pytest.raises(CheckpointCorruptError, match="'a'"):
+        load_checkpoint_tree(d_delta)
+    # and a fully vanished base is a typed CheckpointError
+    import shutil
+
+    shutil.rmtree(d_base)
+    with pytest.raises(CheckpointError):
+        load_checkpoint_tree(d_delta)
+
+
+def test_delta_refuses_same_dir_and_bad_base(tmp_path):
+    """Foot-gun guards: a delta into its own base directory would GC
+    the payload it references (refused at construction); a nonexistent
+    base fails at SAVE time, not at some future load."""
+    spec = _spec()
+    base = str(tmp_path / "base")
+    save_decoder_checkpoint(base, spec)
+    with pytest.raises(CheckpointError, match="own directory"):
+        save_decoder_checkpoint(base, spec, base_manifest=base)
+    with pytest.raises(CheckpointError):
+        save_decoder_checkpoint(str(tmp_path / "d"), spec,
+                                base_manifest=str(tmp_path / "missing"))
+
+
+def test_delta_checkpoint_serves_identical_tokens(tmp_path):
+    """End to end: a delta checkpoint deploys through load_decoder and
+    serves bitwise the same tokens as a full save of the same params
+    (the rollout loop's save-cheap path changes nothing served)."""
+    from paddle_tpu.serving import ServingClient, ServingServer
+
+    spec = DecoderSpec(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                       n_kv_heads=1, seed=3)
+    params = build_decoder_params(spec)
+    full = str(tmp_path / "full")
+    base = str(tmp_path / "base")
+    delta = str(tmp_path / "delta")
+    changed = dict(params)
+    changed["tok_emb"] = np.asarray(params["tok_emb"]) * 1.5
+    save_decoder_checkpoint(base, spec, params)
+    save_decoder_checkpoint(delta, spec, changed, base_manifest=base)
+    save_decoder_checkpoint(full, spec, changed)
+    srv = ServingServer()
+    addr = srv.serve()
+    cli = ServingClient(addr)
+    try:
+        kw = dict(slots=[1], page_size=4, num_pages=16, max_seq_len=8,
+                  prefill_chunk=1)
+        cli.load_decoder("full", checkpoint_dir=full, **kw)
+        cli.load_decoder("delta", checkpoint_dir=delta, **kw)
+        a = cli.generate("full", [3, 1], max_new_tokens=4)
+        b = cli.generate("delta", [3, 1], max_new_tokens=4)
+        assert a["tokens"] == b["tokens"]
+    finally:
+        cli.close()
+        srv.shutdown()

@@ -566,8 +566,14 @@ def test_chaos_kill_replica_mid_rollout():
             base_sheds = metrics.counter("fleet.sheds").value()
             with pytest.raises(NoReplicasError):
                 router2.generate("only_r2", [9], max_new_tokens=1)
-            assert metrics.counter("fleet.failovers").value() == \
-                base_fo + 1
+            # router2's own failover is exactly 1; the WORKER threads
+            # (still routing "m" on the other router) may land their
+            # single r2-drop failover inside this window too — the
+            # counter is process-global, so tolerate that one extra
+            # (observed on a loaded 1-vCPU box); never more: after the
+            # drop r2 is out of their table, and r0/r1 stay alive
+            delta_fo = metrics.counter("fleet.failovers").value() - base_fo
+            assert delta_fo in (1, 2), delta_fo
             assert metrics.counter("fleet.sheds").value() == base_sheds
             router2.close()
             rt.join(300)

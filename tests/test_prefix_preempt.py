@@ -1,0 +1,475 @@
+"""Prefix caching + page-table preemption (ISSUE 13).
+
+Coverage map:
+  - PrefixIndex: publish/match/refcount lifecycle through the
+    allocator (a freed shared page is retained reclaimable, a second
+    request maps it read-only, LRU leaf-first eviction under pressure);
+  - COW: a request extending a cached prefix mid-page gets a PRIVATE
+    copy; the shared page's device bytes are bitwise untouched from
+    publication to the end of the test (the immutability invariant),
+    and tokens equal a cold engine's (shared-vs-alone bitwise pin);
+  - cached steps-to-first-token == ceil(suffix/prefill_chunk),
+    counter-pinned (the load-independent ISSUE 13 acceptance form);
+  - preempt+restore: a demand-mode engine over an undersized pool
+    completes a long-tailed workload with greedy tokens equal to an
+    unpreempted worst-case reference (spill/restore round-trips
+    bitwise), preemption/restore counters move, and EVERY page —
+    spilled ones included — returns to the pool;
+  - kv_spill_dir: spills land as files, restores consume them, nothing
+    survives the run (cancel mid-preemption included — leak-proof);
+  - demand reservation admits STRICTLY more concurrent sequences than
+    worst-case reservation on the same pool (deterministic page
+    arithmetic, no clocks);
+  - load_report advertises prefix-cache warmth and the FleetRouter
+    prefers a warm replica (counter-tested like the free-pages
+    policy).
+
+All timing-sensitive claims are COUNTER asserts (see
+memory/tier1-timing-margin). The whole file must stay green under
+PADDLE_TPU_SANITIZE=guards — PrefixIndex/HostSpillStore joined the
+sanitizer registry.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import metrics
+from paddle_tpu.serving import (DecodeEngine, DecoderSpec, PageAllocator,
+                                RequestTooLarge, ServerOverloaded,
+                                ServingClient, ServingServer)
+from paddle_tpu.serving.kv_cache import (PREFIX_ROOT, PagedKvCache,
+                                         chain_digest)
+
+
+def _spec():
+    return DecoderSpec(vocab=32, d_model=16, n_layers=2, n_heads=2,
+                       n_kv_heads=1, seed=7)
+
+
+def _engine(**kw):
+    kw.setdefault("slots", [1, 2])
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 24)
+    kw.setdefault("max_seq_len", 20)
+    kw.setdefault("max_queue", 16)
+    kw.setdefault("prefill_chunk", 4)
+    return DecodeEngine(_spec(), name=kw.pop("name", "px"), **kw)
+
+
+# --- the prefix index through the allocator ------------------------------
+
+def test_prefix_publish_match_refcount_and_retention():
+    """A completed prompt's full pages publish; a second reservation
+    maps them shared (fewer fresh pages taken), frees drop refcounts
+    but RETAIN the pages (reclaimable counts as free), and a cold
+    allocator path is untouched."""
+    a = PageAllocator(num_pages=16, page_size=4, prefix_cache=True)
+    prompt = list(range(10))                  # 2 full pages + tail 2
+    res = a.alloc_prefix(1, prompt, 12)
+    assert res["cached_tokens"] == 0 and res["cow"] is None
+    assert len(res["pages"]) == 3
+    assert a.publish(1, prompt) == 3          # 2 full + 1 partial tail
+    # same prefix, longer prompt: the two full pages map shared, the
+    # tail page arrives as a COW copy of the partial entry
+    res2 = a.alloc_prefix(2, list(range(10)) + [30, 31], 14)
+    assert res2["pages"][:2] == res["pages"][:2]      # shared pages
+    assert res2["cow"] is not None
+    assert res2["cow"]["src"] == res["pages"][2]
+    assert res2["cow"]["tokens"] == 2                  # the tail
+    assert res2["cached_tokens"] == 2 * 4 + 2
+    a.release_cow(res2["cow"]["key"])
+    # seq 1 frees: its shared pages stay in the index (still reffed by
+    # seq 2), its COW-source partial page becomes reclaimable
+    a.free(1)
+    st = a.stats()
+    assert st["prefix_pages"] == 3
+    # seq 2 still pins the 2 full pages; partial is reclaimable
+    assert st["prefix_reclaimable"] == 1
+    a.free(2)
+    st = a.stats()
+    assert st["prefix_reclaimable"] == 3
+    # retained-but-reclaimable pages count as free capacity
+    assert st["pages_used"] == 0 and st["pages_free"] == 15
+
+
+def test_prefix_match_always_leaves_a_token_to_recompute():
+    """Logits for the last prompt token come from RUNNING it, never
+    from cached K/V: a fully-cached prompt drops its last full page
+    from the match (cached <= len(prompt) - 1)."""
+    a = PageAllocator(num_pages=16, page_size=4, prefix_cache=True)
+    prompt = list(range(8))                    # exactly 2 full pages
+    a.alloc_prefix(1, prompt, 10)
+    a.publish(1, prompt)
+    res = a.alloc_prefix(2, prompt, 10)        # identical prompt
+    # page 2 would cover tokens [4, 8) == the whole remainder: it is
+    # cap-limited to a COW of 3 tokens; cached = 4 + 3 = 7 = len - 1
+    assert res["cached_tokens"] == 7
+    assert res["cow"] is not None and res["cow"]["tokens"] == 3
+    a.release_cow(res["cow"]["key"])
+    a.free(1)
+    a.free(2)
+
+
+def test_prefix_lru_eviction_under_pressure_leaf_first():
+    """When the free list runs short, refcount-0 entries evict LRU and
+    LEAF-first — an ancestor of a live mapping is never reclaimed (the
+    chain walk needs it), and eviction is exactly what turns
+    'reclaimable' into allocatable pages."""
+    a = PageAllocator(num_pages=8, page_size=4, prefix_cache=True)
+    p1 = list(range(9))                        # 2 full pages + tail
+    a.alloc_prefix(1, p1, 9)
+    a.publish(1, p1)
+    a.free(1)                                  # 3 retained, all refs-0
+    assert a.stats()["prefix_reclaimable"] == 3
+    base_ev = metrics.counter("serving.prefix.evictions").value()
+    # 7 usable pages, 3 retained, 4 on the free list: a 6-page alloc
+    # must reclaim 2 cached pages (leaves first)
+    pages = a.alloc(2, 24)
+    assert len(pages) == 6
+    assert metrics.counter("serving.prefix.evictions").value() \
+        == base_ev + 2
+    assert a.stats()["prefix_pages"] == 1      # the depth-1 page
+    a.free(2)
+    # the surviving depth-1 entry still matches (chain intact)
+    res = a.alloc_prefix(3, p1, 9)
+    assert res["cached_tokens"] >= 4
+    a.free(3)
+
+
+def test_alloc_prefix_never_evicts_its_own_match():
+    """Review finding (fixed): ``_take_locked`` may evict refcount-0
+    entries, and an UNPINNED matched chain could have one of its own
+    pages reclaimed and handed straight back as a fresh page in the
+    SAME allocation — one physical page aliased into two table slots
+    (silent cross-region KV corruption, double-free at release). The
+    match is now ref-pinned before fresh pages are taken: the
+    allocation either returns duplicate-free pages or refuses typed,
+    side-effect-free (the pins drop, the chain stays reclaimable)."""
+    a = PageAllocator(num_pages=6, page_size=4, prefix_cache=True)
+    p = list(range(8))                       # 2 full pages
+    a.alloc_prefix(1, p, 8)
+    a.publish(1, p)
+    a.free(1)                                # both entries refcount-0
+    assert a.stats()["prefix_reclaimable"] == 2
+    # same prefix, but a reservation needing more fresh pages (4) than
+    # the free list holds (3): the only evictable entries are the
+    # matched chain itself — refusal, never self-cannibalization
+    with pytest.raises(ServerOverloaded):
+        a.alloc_prefix(2, p + list(range(8, 18)), 24)
+    st = a.stats()
+    assert st["sequences"] == 0 and st["prefix_reclaimable"] == 2
+    # a fitting request still maps the chain with zero duplicate pages
+    res = a.alloc_prefix(3, p + [30, 31, 32], 12)
+    assert res["cached_tokens"] == 8
+    assert len(set(res["pages"])) == len(res["pages"])
+    a.free(3)
+
+
+# --- COW + immutability + bitwise tokens ---------------------------------
+
+def test_shared_page_immutable_and_tokens_bitwise_vs_alone():
+    """THE COW/refcount invariant: once published, a shared page's
+    device bytes never change — a second request sharing the prefix
+    maps full pages read-only and COW-copies the tail — and both
+    requests' greedy tokens are IDENTICAL to running each alone on a
+    cold engine."""
+    prompt_a = list(range(12))                     # 3 full pages
+    prompt_b = list(range(10)) + [30, 31, 29]      # shares 2 pages + COW
+    eng = _engine(name="immut")
+    try:
+        base_cow = metrics.counter("serving.prefix.cow_copies").value()
+        out_a = eng.generate(prompt_a, max_new_tokens=4)
+        assert out_a["cached_tokens"] == 0
+        # snapshot the published pages' device bytes
+        alloc = eng.cache.allocator
+        with alloc._mu:
+            entries = {k: e.page
+                       for k, e in alloc.prefix._entries.items()}
+        pages = sorted(entries.values())
+        before_k = np.asarray(eng.cache.k[:, pages])
+        before_v = np.asarray(eng.cache.v[:, pages])
+
+        out_b = eng.generate(prompt_b, max_new_tokens=4)
+        assert out_b["cached_tokens"] == 2 * 4 + 2     # 2 pages + COW
+        assert metrics.counter("serving.prefix.cow_copies").value() \
+            == base_cow + 1
+        after_k = np.asarray(eng.cache.k[:, pages])
+        after_v = np.asarray(eng.cache.v[:, pages])
+        assert np.array_equal(before_k, after_k), \
+            "a shared page was written after publication"
+        assert np.array_equal(before_v, after_v)
+    finally:
+        eng.stop()
+    # alone, cold: bitwise the same tokens
+    cold = _engine(name="immut_cold", prefix_cache=False)
+    try:
+        assert cold.generate(prompt_a, max_new_tokens=4)["tokens"] \
+            == out_a["tokens"]
+        assert cold.generate(prompt_b, max_new_tokens=4)["tokens"] \
+            == out_b["tokens"]
+    finally:
+        cold.stop()
+
+
+def test_cached_sttf_is_ceil_suffix_over_chunk():
+    """The ISSUE 13 acceptance form: a cache-hit request's
+    steps-to-first-token is ceil(suffix/prefill_chunk) — counter-
+    pinned, load-independent — vs ceil(prompt/chunk) cold."""
+    prompt = list(range(16))
+    eng = _engine(name="sttf", max_seq_len=24, num_pages=32)
+    try:
+        base_h = metrics.counter("serving.prefix.hits").value()
+        base_t = metrics.counter("serving.prefix.cached_tokens").value()
+        cold = eng.generate(prompt, max_new_tokens=2)
+        assert cold["steps_to_first_token"] == 4       # ceil(16/4)
+        # same 12-token prefix (3 full pages), fresh 4-token suffix
+        warm = eng.generate(prompt[:12] + [30, 31, 29, 28],
+                            max_new_tokens=2)
+        assert warm["cached_tokens"] == 12
+        assert warm["steps_to_first_token"] == 1       # ceil(4/4)
+        assert metrics.counter("serving.prefix.hits").value() \
+            == base_h + 1
+        assert metrics.counter(
+            "serving.prefix.cached_tokens").value() == base_t + 12
+    finally:
+        eng.stop()
+
+
+# --- preemption / spill / restore ---------------------------------------
+
+def test_spill_restore_roundtrip_is_bitwise():
+    """gather_pages -> scatter_pages into DIFFERENT physical pages is a
+    bitwise round-trip — the page table rebinds, the content doesn't
+    drift."""
+    cache = PagedKvCache(2, 1, 8, page_size=4, num_pages=10)
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    full = rng.randn(*cache.k.shape).astype(np.float32)
+    cache.rebind(jnp.asarray(full), jnp.asarray(full * 2.0))
+    k, v = cache.gather_pages([3, 5, 7])
+    cache.scatter_pages([2, 4, 6], k, v)
+    assert np.array_equal(np.asarray(cache.k[:, [2, 4, 6]]),
+                          full[:, [3, 5, 7]])
+    assert np.array_equal(np.asarray(cache.v[:, [2, 4, 6]]),
+                          full[:, [3, 5, 7]] * 2.0)
+    with pytest.raises(Exception, match="mismatch"):
+        cache.scatter_pages([1, 2], k, v)
+
+
+def test_preempt_restore_tokens_bitwise_and_every_page_returned():
+    """THE preemption acceptance: a demand-mode engine whose pool is
+    far too small for the workload's worst case completes everything
+    via preempt+restore with greedy tokens EQUAL to an unpreempted
+    worst-case reference (zero corrupted outputs), zero post-warm
+    compiles, and every page — spilled ones included — back in the
+    pool."""
+    spec = _spec()
+    prompts = [[1 + i] for i in range(4)]
+    max_new = 30                               # worst case 8 pages each
+    eng = DecodeEngine(spec, name="pre", slots=[4], page_size=4,
+                       num_pages=13, max_seq_len=44, prefill_chunk=4,
+                       prefix_cache=False, reservation="demand")
+    try:
+        base_c = metrics.counter("serving.decode.compiles").value()
+        base_p = metrics.counter("serving.kv.preemptions").value()
+        base_r = metrics.counter("serving.kv.restores").value()
+        reqs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        for r in reqs:
+            assert r.ev.wait(240), "preempting decode wedged"
+            assert r.error is None, r.error
+        assert metrics.counter("serving.kv.preemptions").value() \
+            > base_p, "undersized pool never preempted"
+        assert metrics.counter("serving.kv.restores").value() > base_r
+        assert metrics.counter("serving.decode.compiles").value() \
+            == base_c, "preemption escaped the warmed ladder"
+        st = eng.cache.allocator.stats()
+        assert st["pages_used"] == 0 and st["sequences"] == 0
+        assert eng.stats()["spilled_sequences"] == 0
+        outs = [r.result["tokens"] for r in reqs]
+    finally:
+        eng.stop()
+    ref = DecodeEngine(spec, name="pre_ref", slots=[4], page_size=4,
+                       num_pages=60, max_seq_len=44, prefill_chunk=4,
+                       prefix_cache=False, reservation="worst_case")
+    try:
+        for p, toks in zip(prompts, outs):
+            assert ref.generate(p, max_new_tokens=max_new)["tokens"] \
+                == toks, "preemption corrupted a sequence"
+    finally:
+        ref.stop()
+
+
+def test_spill_dir_files_created_and_cleaned(tmp_path):
+    """kv_spill_dir moves spills to disk: files exist only while their
+    sequence is preempted; a clean finish leaves the directory empty."""
+    sp = str(tmp_path / "spill")
+    eng = DecodeEngine(_spec(), name="spd", slots=[4], page_size=4,
+                       num_pages=13, max_seq_len=44, prefill_chunk=4,
+                       prefix_cache=False, reservation="demand",
+                       spill_dir=sp)
+    try:
+        base = metrics.counter("serving.kv.spilled_pages").value()
+        reqs = [eng.submit([1 + i], max_new_tokens=30) for i in range(4)]
+        for r in reqs:
+            assert r.ev.wait(240) and r.error is None, r.error
+        assert metrics.counter("serving.kv.spilled_pages").value() > base
+    finally:
+        eng.stop()
+    assert not os.path.isdir(sp) or os.listdir(sp) == []
+
+
+def test_cancel_mid_preemption_leaks_nothing():
+    """A preempted (re-queued, spill-holding) request that gets
+    canceled leaves nothing behind: no spill entry, no pages, and the
+    survivors finish normally."""
+    eng = DecodeEngine(_spec(), name="cxl", slots=[2], page_size=4,
+                       num_pages=9, max_seq_len=40, prefill_chunk=4,
+                       prefix_cache=False, reservation="demand")
+    try:
+        # three sequences on two slots + a pool that can't hold two
+        # worst cases: growth preempts/demotes the youngest
+        long = [eng.submit([1 + i], max_new_tokens=28) for i in range(3)]
+        # wait until SOMETHING was preempted or demoted back to queue
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if metrics.counter("serving.kv.preemptions").value() > 0 \
+                    or metrics.counter("serving.kv.demotions").value() > 0:
+                break
+            time.sleep(0.005)
+        # cancel a victim that is currently waiting in the queue (its
+        # reservation is surrendered; a preempted one also holds spill)
+        with eng._cond:
+            queued = list(eng._queue)
+        canceled = 0
+        for req in queued:
+            if eng.cancel(req):
+                canceled += 1
+        for r in long:
+            r.ev.wait(240)
+        assert eng.stats()["spilled_sequences"] == 0, \
+            "a canceled preempted sequence leaked its spill"
+        st = eng.cache.allocator.stats()
+        assert st["pages_used"] == 0 and st["sequences"] == 0
+        done = [r for r in long if r.error is None]
+        assert len(done) == len(long) - canceled
+        for r in done:
+            assert len(r.result["tokens"]) == 28
+    finally:
+        eng.stop()
+
+
+def test_demand_admits_strictly_more_than_worst_case():
+    """The occupancy claim, as pure page arithmetic: on the SAME pool,
+    worst-case reservation refuses a long-tailed burst early; demand
+    reservation (prompt + headroom) admits every request — admission
+    is priced by actual token demand, not by max_new_tokens."""
+    spec = _spec()
+    counts = {}
+    for mode in ("worst_case", "demand"):
+        eng = DecodeEngine(spec, name=f"adm_{mode}", slots=[1],
+                           page_size=4, num_pages=13, max_seq_len=44,
+                           prefill_chunk=4, prefix_cache=False,
+                           reservation=mode, max_queue=64)
+        try:
+            admitted = 0
+            refused = 0
+            reqs = []
+            for i in range(6):
+                try:
+                    # prompt 2 + max_new 30: worst case 8 pages, actual
+                    # demand at admission 1 page + 1 headroom
+                    reqs.append(eng.submit([1, 2 + i],
+                                           max_new_tokens=30))
+                    admitted += 1
+                except ServerOverloaded:
+                    refused += 1
+            counts[mode] = admitted
+            for r in reqs:
+                assert r.ev.wait(300) and r.error is None, r.error
+        finally:
+            eng.stop()
+    assert counts["worst_case"] == 1      # floor(12 usable / 8) = 1
+    assert counts["demand"] == 6
+    assert counts["demand"] > counts["worst_case"]
+
+
+def test_demand_refuses_what_could_never_fit():
+    """The progress guarantee's precondition: a sequence whose WORST
+    case exceeds the whole pool is refused typed at submit — demand
+    mode must never admit something preemption cannot save."""
+    eng = DecodeEngine(_spec(), name="toolarge", slots=[1], page_size=4,
+                       num_pages=6, max_seq_len=44, prefill_chunk=4,
+                       prefix_cache=False, reservation="demand")
+    try:
+        with pytest.raises(RequestTooLarge, match="whole pool"):
+            eng.submit([1], max_new_tokens=40)   # 41 tokens > 5 pages
+        out = eng.generate([1], max_new_tokens=4)
+        assert len(out["tokens"]) == 4
+    finally:
+        eng.stop()
+
+
+# --- fleet: prefix-aware load_report + routing ---------------------------
+
+def test_load_report_and_router_prefer_warm_replica():
+    """ISSUE 13 satellite: load_report advertises the prefix cache's
+    depth-1 chain digests; the router computes the SAME digest for a
+    request's first prompt page and routes to the warm replica even
+    when a cold one has MORE free pages (warmth outranks free pages;
+    counter-tested like the free-pages policy)."""
+    from paddle_tpu.fleet import FleetController, FleetRouter
+
+    spec = _spec()
+    kw = dict(slots=[1], page_size=4, max_seq_len=24, prefill_chunk=4)
+    ctl = FleetController(lease_ttl=30.0, sweep_interval=0)
+    ctl_addr = ctl.serve()
+    srv_cold, srv_warm = ServingServer(), ServingServer()
+    router = None
+    try:
+        addr_cold = srv_cold.serve()
+        addr_warm = srv_warm.serve()
+        cli_cold = ServingClient(addr_cold)
+        cli_warm = ServingClient(addr_warm)
+        # the COLD replica gets the BIGGER pool: without warmth in the
+        # score it would win every decode route
+        cli_cold.load_decoder("m", spec.to_dict(), num_pages=64, **kw)
+        cli_warm.load_decoder("m", spec.to_dict(), num_pages=32, **kw)
+        ctl._register("cold", list(addr_cold))
+        ctl._register("warm", list(addr_warm))
+
+        prompt = list(range(12))
+        # warm up the warm replica directly (not through the router)
+        out = cli_warm.generate("m", prompt, max_new_tokens=2)
+        rep = cli_warm.load_report()
+        pc = rep["models"]["m"]["prefix_cache"]
+        assert pc["pages"] >= 3 and pc["page_size"] == 4
+        assert chain_digest(PREFIX_ROOT, prompt[:4]) in pc["roots"]
+        assert "prefix_cache" not in rep["models"].get("none", {})
+
+        router = FleetRouter(ctl_addr, scrape_ttl=0.0, replica_ttl=0.0)
+        base_w = metrics.counter("fleet.routed_warm").value()
+        base_warm = metrics.counter("fleet.routed.warm").value()
+        # shared 8-token prefix, fresh suffix: must land on `warm`
+        out2 = router.generate("m", prompt[:8] + [30, 31],
+                               max_new_tokens=2)
+        assert out2["cached_tokens"] >= 8
+        assert metrics.counter("fleet.routed.warm").value() \
+            == base_warm + 1
+        assert metrics.counter("fleet.routed_warm").value() == base_w + 1
+        # a prompt sharing nothing routes on free pages: `cold` wins
+        base_cold = metrics.counter("fleet.routed.cold").value()
+        router.generate("m", [9, 8, 7, 6, 5], max_new_tokens=2)
+        assert metrics.counter("fleet.routed.cold").value() \
+            == base_cold + 1
+        assert metrics.counter("fleet.routed_warm").value() == base_w + 1
+        cli_cold.close()
+        cli_warm.close()
+    finally:
+        if router is not None:
+            router.close()
+        srv_cold.shutdown(drain=False)
+        srv_warm.shutdown(drain=False)
+        ctl.shutdown()
