@@ -204,6 +204,17 @@ FLAGS: Dict[str, Any] = _Flags({
     # measure-or-model session seeds measured values). 1 = chunking
     # off (bitwise the PR 6 one-token-per-step behavior)
     "prefill_chunk": 16,
+    # speculative decoding (ISSUE 14): how many tokens the DRAFT
+    # decoder proposes per live slot per scheduler round; the target
+    # model then verifies all k+1 positions in ONE chunked step
+    # (decoder_step_chunked rides the existing multi-token kernel), so
+    # high draft/target agreement commits up to k+1 tokens per target
+    # step. 0 = off (bit-identical non-speculative decode; engines
+    # without a draft are always off regardless of this value). A PR 8
+    # tunable: DecodeEngine reads it through effective_flag, so the
+    # autotune cache overrides per device kind (decode_bench's
+    # measure-or-model session persists the measured winner)
+    "spec_k": 0,
     # serving fleet (paddle_tpu/fleet, ISSUE 11). Replica lease TTL in
     # seconds: a replica that misses heartbeats for this long is
     # evicted from the routing table (the pserver heartbeat/eviction

@@ -15,7 +15,8 @@ docs/SERVING.md.
     python -m paddle_tpu.serving --selftest   # in-process end-to-end
 """
 from .client import ServingClient, TokenStream
-from .decode import DecodeEngine, DecoderSpec, sample_token
+from .decode import (DecodeEngine, DecoderSpec, sample_token,
+                     validate_draft_spec)
 from .engine import (InferenceEngine, default_buckets, parse_buckets,
                      resolve_bucket_spec)
 from .errors import (DeadlineExceeded, EngineRetired, ModelNotFound,
@@ -32,5 +33,5 @@ __all__ = [
     "ServingError", "ServerOverloaded", "DeadlineExceeded",
     "ModelNotFound", "RequestTooLarge", "EngineRetired", "StreamExpired",
     "default_buckets", "parse_buckets", "resolve_bucket_spec",
-    "sample_token",
+    "sample_token", "validate_draft_spec",
 ]

@@ -7,10 +7,14 @@
         server/client path, an atomic hot-swap, the overload rejection
         path, the DECODE path (ISSUE 6: paged-KV continuous
         batching — warmed slot/width ladder, zero churn compiles, page
-        exhaustion refusal, RPC generate + decoder hot-swap), and the
+        exhaustion refusal, RPC generate + decoder hot-swap), the
         ISSUE 13 layer (prefix-cache hits prefill only the suffix;
         demand reservation + preempt/restore completes an over-
-        committed pool with reference-equal tokens).
+        committed pool with reference-equal tokens), and the ISSUE 14
+        layer (speculative decoding: draft-propose + chunked-verify
+        emits bitwise the non-speculative tokens — greedy AND seeded
+        sampling — in fewer target steps, zero post-warm compiles,
+        every rollback page returned).
         Exit-nonzero on any failure — wired into tools/check.py as the
         serving smoke.
 
@@ -328,6 +332,64 @@ def run_selftest(verbose: bool = True) -> int:
                 wide.stop()
         finally:
             deng2.stop()
+
+        # -- 6. speculative decoding (ISSUE 14) --------------------------
+        sspec = DecoderSpec(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                            n_kv_heads=1, seed=3)
+        sdraft = DecoderSpec(vocab=32, d_model=8, n_layers=1, n_heads=1,
+                             n_kv_heads=1, seed=3)
+        ts = _metrics.counter("serving.decode.target_steps")
+        s_off = DecodeEngine(sspec, name="spec_off", slots=[1],
+                             page_size=4, num_pages=16, max_seq_len=20,
+                             prefill_chunk=4)
+        try:
+            base = ts.value()
+            ref = s_off.generate([4, 9, 1], max_new_tokens=12)
+            off_steps = ts.value() - base
+        finally:
+            s_off.stop()
+        dc = _metrics.counter("serving.decode.compiles")
+        s_on = DecodeEngine(sspec, name="spec_on", slots=[1],
+                            page_size=4, num_pages=16, max_seq_len=20,
+                            prefill_chunk=4, draft_spec=sdraft,
+                            spec_k=3)
+        try:
+            base_c = dc.value()
+            base = ts.value()
+            out = s_on.generate([4, 9, 1], max_new_tokens=12)
+            on_steps = ts.value() - base
+            check(out["tokens"] == ref["tokens"],
+                  "speculative tokens bitwise equal non-speculative "
+                  "(greedy)")
+            check(on_steps < off_steps,
+                  f"speculation used fewer target steps "
+                  f"({on_steps} < {off_steps})")
+            check(out["spec_proposed"] > 0
+                  and out["accept_rate"] is not None,
+                  f"accept_rate reported "
+                  f"({out['accept_rate']}, {out['spec_proposed']} "
+                  "proposed)")
+            # before the fresh off-engine below warms ITS ladder into
+            # the same process-global counter
+            check(dc.value() == base_c,
+                  "speculative rounds performed 0 post-warm compiles")
+            s_off2 = DecodeEngine(sspec, name="spec_off2", slots=[1],
+                                  page_size=4, num_pages=16,
+                                  max_seq_len=20, prefill_chunk=4)
+            try:
+                a = s_off2.generate([7, 2], max_new_tokens=10,
+                                    temperature=0.9, top_k=8, seed=11)
+                b = s_on.generate([7, 2], max_new_tokens=10,
+                                  temperature=0.9, top_k=8, seed=11)
+                check(a["tokens"] == b["tokens"],
+                      "seeded-sampled tokens identical with "
+                      "speculation on vs off")
+            finally:
+                s_off2.stop()
+            check(s_on.cache.allocator.stats()["pages_used"] == 0,
+                  "rejected-suffix rollback returned every page")
+        finally:
+            s_on.stop()
 
         # decode over RPC with a hot-swap
         srv2 = ServingServer()

@@ -213,7 +213,10 @@ class ServingClient:
                      prefill_chunk: Optional[int] = None,
                      checkpoint_dir: Optional[str] = None,
                      prefix_cache: Optional[bool] = None,
-                     reservation: Optional[str] = None
+                     reservation: Optional[str] = None,
+                     draft_spec: Optional[Dict[str, Any]] = None,
+                     draft_checkpoint_dir: Optional[str] = None,
+                     spec_k: Optional[int] = None
                      ) -> Dict[str, Any]:
         """Deploy a DecodeEngine; hot-swaps like load_model. From a
         ``spec`` dict (see serving.decode.DecoderSpec) the server
@@ -225,7 +228,13 @@ class ServingClient:
         autotune cache/FLAGS). ``prefix_cache``/``reservation`` pin the
         ISSUE 13 policies (prompt-prefix KV reuse; 'demand' vs
         'worst_case' page reservation) — None defers to the server's
-        FLAGS."""
+        FLAGS. ``draft_spec``/``draft_checkpoint_dir``/``spec_k``
+        attach a speculative draft decoder (ISSUE 14: the draft
+        proposes spec_k tokens per slot per round, the target verifies
+        them in one chunked step; output stays bitwise-equal to
+        non-speculative decode). spec_k=None defers to the server's
+        autotune cache/FLAGS; a vocab/eos-mismatched draft is refused
+        typed at load."""
         try:
             return self._rpc.call(
                 "load_decoder", model,
@@ -235,7 +244,11 @@ class ServingClient:
                 None if prefill_chunk is None else int(prefill_chunk),
                 None if checkpoint_dir is None else str(checkpoint_dir),
                 None if prefix_cache is None else bool(prefix_cache),
-                None if reservation is None else str(reservation))
+                None if reservation is None else str(reservation),
+                None if draft_spec is None else dict(draft_spec),
+                (None if draft_checkpoint_dir is None
+                 else str(draft_checkpoint_dir)),
+                None if spec_k is None else int(spec_k))
         except RuntimeError as e:
             _raise_typed(e)
 

@@ -41,6 +41,26 @@ This engine does CONTINUOUS batching over FIXED compiled shapes:
     completion, and the paged-attention kernel reads through the page
     tables so ragged histories share one compiled shape.
 
+SPECULATIVE DECODING (ISSUE 14): with a small DRAFT decoder attached
+(``draft_spec``/``draft_params`` + ``spec_k > 0``), every decoding slot
+advances up to ``spec_k + 1`` tokens per scheduler round for ONE
+target-model step: the draft proposes ``spec_k`` tokens (cheap batched
+steps on its own compiled ladder), the target verifies all ``k+1``
+positions in one ``decoder_step_chunked(all_lanes=True)`` call, and the
+committed tokens are the target's own deterministic per-(seed,
+position) choices along the longest agreeing prefix — so output is
+BITWISE what the non-speculative engine emits, for greedy and seeded
+sampling alike (the classic draft/verify trade from *Fast Inference
+from Transformers via Speculative Decoding*, with the realization
+pinned by the seeded sampler instead of stochastic rejection). The
+draft's KV pool MIRRORS the target's page geometry — same allocator,
+same page ids, same tables — so reservation growth, rejected-suffix
+rollback (``PageAllocator.shrink``), COW, preemption spill and restore
+stay one mechanism; a rejected suffix un-notes its tokens and frees
+any page that held only rejected positions. ``spec_k`` is a PR 8
+tunable (``effective_flag('spec_k')``, 0 = off and bit-identical old
+behavior).
+
 The model behind the step is pluggable via the ``DecoderSpec`` /
 ``build_decoder_params`` / ``decoder_step`` contract below; the
 built-in spec'd decoder (embedding + N pre-norm transformer layers
@@ -74,7 +94,7 @@ from .kv_cache import GARBAGE_PAGE, HostSpillStore, PagedKvCache
 
 __all__ = ["DecoderSpec", "DecodeEngine", "build_decoder_params",
            "decoder_step", "decoder_step_chunked", "width_ladder",
-           "sample_token"]
+           "sample_token", "validate_draft_spec"]
 
 _log = get_logger("serving")
 
@@ -113,6 +133,21 @@ _m_first_token_steps = _metrics.histogram(
 _m_preemptions = _metrics.counter("serving.kv.preemptions")
 _m_restores = _metrics.counter("serving.kv.restores")
 _m_demotions = _metrics.counter("serving.kv.demotions")
+# speculative decoding (ISSUE 14): TARGET-model invocations — one per
+# plain/prefill step AND one per verify chunk (warm included; benches
+# delta it). The headline ratio is target_steps per generated token:
+# spec off it is 1 per token, spec on a verify commits up to k+1
+_m_target_steps = _metrics.counter("serving.decode.target_steps")
+# DRAFT-model invocations (propose + prefill shadowing) — the cheap
+# steps speculation trades for target steps
+_m_draft_steps = _metrics.counter("serving.decode.spec.draft_steps")
+# proposed == accepted + rejected, always (counter-pinned in tier-1);
+# accept_rate histogram observes each finished request's ratio
+_m_spec_proposed = _metrics.counter("serving.decode.spec.proposed")
+_m_spec_accepted = _metrics.counter("serving.decode.spec.accepted")
+_m_spec_rejected = _metrics.counter("serving.decode.spec.rejected")
+_m_spec_accept_rate = _metrics.histogram(
+    "serving.decode.spec.accept_rate")
 
 
 # --- the pluggable decoder model ----------------------------------------
@@ -176,6 +211,29 @@ class DecoderSpec:
         return spec
 
 
+def validate_draft_spec(target: DecoderSpec, draft: DecoderSpec):
+    """Cross-validate a speculative DRAFT decoder against its target
+    (ISSUE 14 satellite): a mismatched draft must fail at LOAD, typed
+    and naming the field, not mid-verify with garbage acceptance. The
+    draft proposes token ids the target scores, so the vocabularies
+    must be identical; page geometry (page_size / num_pages) is shared
+    BY CONSTRUCTION — the draft's pool mirrors the target's allocator
+    and page tables, so it cannot diverge. Everything architectural
+    (layers, heads, d_model) is free: that asymmetry is the whole
+    speedup."""
+    if draft.vocab != target.vocab:
+        raise ValueError(
+            f"draft/target DecoderSpec mismatch on field 'vocab': "
+            f"draft {draft.vocab} != target {target.vocab} — the draft "
+            f"proposes token ids the target must score")
+    if draft.eos_id != target.eos_id:
+        raise ValueError(
+            f"draft/target DecoderSpec mismatch on field 'eos_id': "
+            f"draft {draft.eos_id} != target {target.eos_id} — "
+            f"termination is decided on committed (target-verified) "
+            f"tokens, so the specs must agree on it")
+
+
 def build_decoder_params(spec: DecoderSpec) -> Dict[str, Any]:
     """Deterministic parameter tree (seeded numpy draws, scaled-normal
     init) — the test/bench stand-in for loading a checkpoint."""
@@ -229,7 +287,8 @@ def _pos_encoding(positions, d_model):
 
 
 def decoder_step_chunked(params, spec: DecoderSpec, tokens, positions,
-                         q_lens, k_pool, v_pool, page_tables, kv_lens):
+                         q_lens, k_pool, v_pool, page_tables, kv_lens,
+                         all_lanes: bool = False):
     """ONE mixed decode/prefill step for a fixed-slot batch
     (ISSUE 10). Each slot carries up to C tokens of ITS sequence — a
     prefill chunk, a single decode token at C lane 0, or nothing —
@@ -251,6 +310,14 @@ def decoder_step_chunked(params, spec: DecoderSpec, tokens, positions,
     the widest matmul of the step: unembedding all C lanes would waste
     ~(C-1)/C of it plus a C-times-larger device->host transfer on
     every prefill step.
+
+    ``all_lanes=True`` is the SPECULATIVE-VERIFY form (ISSUE 14):
+    logits come back for EVERY lane (``[B, C, vocab]``) — lane ``j`` is
+    the target's distribution for position ``positions[:, j] + 1``, so
+    one call scores a draft's ``k`` proposals plus the bonus position.
+    The full-lane unembed is exactly the price of verification (C =
+    spec_k + 1 lanes, not the prefill chunk width); acceptance happens
+    host-side in the engine.
     """
     import jax
     import jax.numpy as jnp
@@ -289,6 +356,12 @@ def decoder_step_chunked(params, spec: DecoderSpec, tokens, positions,
         x = x + attn.reshape(b, c, spec.n_heads * dh) @ lp["wo"]
         h2 = _ln(x, lp["ln2"])
         x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+    if all_lanes:
+        # verify form: every lane's logits ([B, C, vocab]) — the
+        # acceptance walk needs the target's distribution at each
+        # proposed position, not just the newest
+        logits = _ln(x, params["lnf"]) @ params["tok_emb"].T
+        return k_pool, v_pool, logits
     # unembed only each slot's newest lane (dead slots gather lane 0 —
     # garbage the scheduler never samples)
     last = jnp.maximum(q_lens - 1, 0)[:, None, None]       # [B, 1, 1]
@@ -368,7 +441,8 @@ class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "deadline", "ev", "result", "error",
                  "t_enq", "seq_id", "trace_ctx", "temperature", "top_k",
                  "seed", "produced", "cached_tokens", "cow", "resume_pos",
-                 "published", "carry_steps", "carry_fts", "needs_alloc")
+                 "published", "carry_steps", "carry_fts", "needs_alloc",
+                 "resume_dpos", "spec_proposed", "spec_accepted")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  deadline: Optional[float], seq_id: int,
@@ -406,6 +480,13 @@ class _DecodeRequest:
         self.carry_steps = 0
         self.carry_fts: Optional[int] = None
         self.needs_alloc = False
+        # speculative decoding (ISSUE 14): the draft pool's valid-write
+        # watermark carried through preemption (mirrors resume_pos),
+        # and the request's propose/accept tallies (accept_rate in the
+        # result dict)
+        self.resume_dpos: Optional[int] = None
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
     def fail(self, err: BaseException):
         self.error = err
@@ -414,7 +495,7 @@ class _DecodeRequest:
 
 class _Slot:
     __slots__ = ("req", "pos", "pages_held", "steps", "first_token_steps",
-                 "pending_restore")
+                 "pending_restore", "dpos")
 
     def __init__(self, req: _DecodeRequest, pages_held: int):
         self.req = req
@@ -426,6 +507,13 @@ class _Slot:
         # its fresh reservation BEFORE its next step (restore-before-
         # step): set at re-admission, executed by _prepare
         self.pending_restore = False
+        # speculative decoding (ISSUE 14): positions validly written to
+        # the DRAFT pool. Invariant: pos - 1 <= dpos <= pos — the draft
+        # lags by at most one committed token (exactly one after a
+        # fully-accepted round, whose last proposal it never fed
+        # itself), so the next propose round catches up with a <= 2-
+        # lane chunk before proposing
+        self.dpos = 0
 
     def token_at(self, idx: int) -> int:
         """The sequence's token at absolute position ``idx``: a prompt
@@ -458,6 +546,9 @@ class DecodeEngine:
                  prefix_cache: Optional[bool] = None,
                  reservation: Optional[str] = None,
                  spill_dir: Optional[str] = None,
+                 draft_spec: Optional[Any] = None,
+                 draft_params: Optional[Dict[str, Any]] = None,
+                 spec_k: Optional[int] = None,
                  warm: bool = True):
         from ..fluid.flags import FLAGS, effective_flag
 
@@ -531,6 +622,58 @@ class DecodeEngine:
         # costs nothing when no prompt is in flight), steps carrying a
         # prefill grant ride the C=chunk shapes
         self._chunk_ladder = sorted({1, self._prefill_chunk})
+        # speculative decoding (ISSUE 14): a small DRAFT decoder
+        # proposes spec_k tokens per decoding slot per round; the
+        # target verifies all k+1 positions in ONE chunked call. The
+        # draft's KV pool MIRRORS the target's page geometry — same
+        # allocator, same page ids, same tables — so reservation,
+        # rollback, COW, spill and restore stay ONE mechanism. spec_k
+        # resolves like every ladder knob: explicit arg, else the
+        # autotune cache through effective_flag ('spec_k'), else the
+        # FLAGS cold default (0 = off, bit-identical old behavior).
+        if isinstance(draft_spec, dict):
+            draft_spec = DecoderSpec.from_dict(draft_spec)
+        k_spec = int(effective_flag("spec_k")
+                     if spec_k is None else spec_k)
+        if k_spec < 0:
+            raise ValueError(f"spec_k must be >= 0, got {k_spec}")
+        if k_spec > 0 and draft_spec is None and spec_k is not None:
+            # only an EXPLICIT spec_k without a draft is a caller error;
+            # a flag/autotune-sourced value must not refuse plain
+            # deploys fleet-wide once a nonzero winner is persisted —
+            # engines without a draft are always off (flags.py)
+            raise ValueError(
+                f"spec_k {k_spec} needs a draft decoder — pass "
+                "draft_spec (or draft_checkpoint_dir through the "
+                "server)")
+        if draft_spec is not None:
+            validate_draft_spec(spec, draft_spec)
+        if draft_spec is None:
+            k_spec = 0
+        # the verify chunk writes through pos + k: never past the
+        # sequence cap (k_eff clamps per slot; this bounds the ladder)
+        self._spec_k = max(0, min(k_spec, self.max_seq_len - 2))
+        self._draft_spec = draft_spec if self._spec_k else None
+        if self._spec_k:
+            self._verify_lanes = self._spec_k + 1
+            # draft calls: C=1 singles, a <= 2-lane catch-up chunk
+            # after a fully-accepted round, and the prefill chunks it
+            # shadows
+            self._draft_chunk_ladder = sorted(
+                {1, 2, self._prefill_chunk})
+            self._draft_params = (
+                build_decoder_params(draft_spec)
+                if draft_params is None
+                else draft_params)  # guarded-by: _step_mu
+            self._draft_cache = PagedKvCache(
+                draft_spec.n_layers, draft_spec.n_kv_heads,
+                draft_spec.head_dim, page_size=ps, num_pages=npages,
+                allocator=self.cache.allocator)  # guarded-by: _step_mu
+        else:
+            self._verify_lanes = 0
+            self._draft_chunk_ladder = []
+            self._draft_params = None  # guarded-by: _step_mu
+            self._draft_cache = None  # guarded-by: _step_mu
         self._cond = threading.Condition()
         self._queue: List[_DecodeRequest] = []  # guarded-by: _cond
         self._slots: List[_Slot] = []  # guarded-by: _cond
@@ -566,6 +709,33 @@ class DecodeEngine:
         self._step_fn = jax.jit(
             _step,
             donate_argnums=(4, 5) if donate else ())  # guarded-by: _step_mu
+        if self._spec_k:
+            draft_ref = self._draft_spec
+
+            def _verify(params, tokens, positions, q_lens, k_pool,
+                        v_pool, tables, lens):
+                return decoder_step_chunked(params, spec_ref, tokens,
+                                            positions, q_lens, k_pool,
+                                            v_pool, tables, lens,
+                                            all_lanes=True)
+
+            def _draft(params, tokens, positions, q_lens, k_pool,
+                       v_pool, tables, lens):
+                return decoder_step_chunked(params, draft_ref, tokens,
+                                            positions, q_lens, k_pool,
+                                            v_pool, tables, lens)
+
+            self._verify_fn = jax.jit(
+                _verify,
+                donate_argnums=(4, 5) if donate
+                else ())  # guarded-by: _step_mu
+            self._draft_fn = jax.jit(
+                _draft,
+                donate_argnums=(4, 5) if donate
+                else ())  # guarded-by: _step_mu
+        else:
+            self._verify_fn = None  # guarded-by: _step_mu
+            self._draft_fn = None  # guarded-by: _step_mu
         # serializes warm() (caller thread) against live steps (the
         # scheduler thread): read-pools -> step -> rebind must be
         # atomic or concurrent rebinds silently drop KV writes
@@ -601,23 +771,44 @@ class DecodeEngine:
     def chunk_ladder(self) -> List[int]:
         return list(self._chunk_ladder)
 
+    @property
+    def spec_k(self) -> int:
+        """Draft proposals per decoding slot per round (0 = speculation
+        off — no draft loaded, bit-identical non-speculative decode)."""
+        return self._spec_k
+
+    @property
+    def draft_spec(self) -> Optional[DecoderSpec]:
+        return self._draft_spec
+
     def warm(self):
         """Pre-compile EVERY (slot-count, table-width, chunk) triple on
         an all-dead synthetic batch (writes land on the garbage page).
         After this, sequence churn at ragged lengths — prefill chunks
         included — compiles nothing: all three padded dimensions only
-        ever take ladder values."""
+        ever take ladder values. With a speculative draft attached
+        (ISSUE 14) the chunk ladder grows its ``spec_k + 1`` VERIFY
+        entry (the all-lane-logits form) and the draft's own compiled
+        ladder ({1, 2, chunk} — singles, the post-full-accept catch-up
+        chunk, and the prefill chunks it shadows) warms alongside, so a
+        speculative churn still performs zero post-warm compiles."""
         with _tracing.span("serving.decode.warmup", model=self.name,
                            version=self.version):
             for s in self._slot_ladder:
                 for w in self._width_ladder:
+                    def dead(c):
+                        return (np.zeros((s, c), np.int32),
+                                np.zeros((s, c), np.int32),
+                                np.zeros(s, np.int32),
+                                np.full((s, w), GARBAGE_PAGE, np.int32),
+                                np.zeros(s, np.int32))
+
                     for c in self._chunk_ladder:
-                        self._run_step_arrays(
-                            np.zeros((s, c), np.int32),
-                            np.zeros((s, c), np.int32),
-                            np.zeros(s, np.int32),
-                            np.full((s, w), GARBAGE_PAGE, np.int32),
-                            np.zeros(s, np.int32))
+                        self._run_step_arrays(*dead(c))
+                    if self._spec_k:
+                        self._run_verify_arrays(*dead(self._verify_lanes))
+                        for c in self._draft_chunk_ladder:
+                            self._run_draft_arrays(*dead(c))
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
                deadline_ms: Optional[float] = None,
@@ -837,6 +1028,14 @@ class DecodeEngine:
         with self._step_mu:
             self._params = None
             self._step_fn = None
+            self._draft_params = None
+            self._verify_fn = None
+            self._draft_fn = None
+            if self._draft_cache is not None:
+                # shared allocator: retire() is idempotent, the draft
+                # pool's HBM frees with its own k/v drop
+                self._draft_cache.release()
+                self._draft_cache = None
             self.cache.release()
         # any spills that survived the drain (preempted sequences the
         # retirement failed) die with the engine — files included
@@ -871,6 +1070,9 @@ class DecodeEngine:
                 "max_seq_len": self.max_seq_len,
                 "continuous": self._continuous,
                 "reservation": self._reservation,
+                "spec_k": self._spec_k,
+                "draft": (self._draft_spec.to_dict()
+                          if self._draft_spec is not None else None),
                 "prefix_cache": self._prefix_on,
                 "prefix": self.cache.allocator.prefix_stats(),
                 "spilled_sequences": self._spill.count(),
@@ -969,12 +1171,21 @@ class DecodeEngine:
                          self.cache.allocator.held_pages(req.seq_id))
             if req.resume_pos is not None:
                 slot.pos = req.resume_pos
+                # the draft pool restores from the same spill; its
+                # watermark resumes where preemption froze it
+                slot.dpos = (req.resume_dpos
+                             if req.resume_dpos is not None
+                             else req.resume_pos)
                 slot.pending_restore = True
                 req.resume_pos = None
+                req.resume_dpos = None
             else:
                 # cached prompt pages are already written (and mapped):
-                # prefill starts at the first uncached token
+                # prefill starts at the first uncached token — in BOTH
+                # pools (the publisher's draft prefilled the same
+                # pages; the COW copy below covers the tail likewise)
                 slot.pos = req.cached_tokens
+                slot.dpos = req.cached_tokens
             slot.steps = req.carry_steps
             slot.first_token_steps = req.carry_fts
             self._slots.append(slot)
@@ -1047,16 +1258,59 @@ class DecodeEngine:
 
     def _run_step_arrays(self, tokens, positions, q_lens, tables, lens):
         """Shared by warm() and live steps: count a DISTINCT-shape
-        compile, run the jitted step, rebind the pools."""
+        compile, run the jitted step, rebind the pools. With a draft
+        attached the shape keys carry a model tag ('target'/'verify'/
+        'draft') so the three compiled families stay distinct in the
+        same churn-pinned set; without one they stay the bare PR 6/9
+        triples."""
         with self._step_mu:
             key = (len(tokens), tables.shape[1], tokens.shape[1])
+            if self._spec_k:
+                key = ("target",) + key
             if key not in self._compiled_shapes:
                 self._compiled_shapes.add(key)
                 _m_compiles.inc()
+            _m_target_steps.inc()
             k, v, logits = self._step_fn(
                 self._params, tokens, positions, q_lens, self.cache.k,
                 self.cache.v, tables, lens)
             self.cache.rebind(k, v)
+            return logits
+
+    def _run_verify_arrays(self, tokens, positions, q_lens, tables,
+                           lens):
+        """The speculative-verify target call: same pools, all-lane
+        logits ``[B, C, vocab]`` (C = spec_k + 1). One target step
+        scores every proposal plus the bonus position."""
+        with self._step_mu:
+            key = ("verify", len(tokens), tables.shape[1],
+                   tokens.shape[1])
+            if key not in self._compiled_shapes:
+                self._compiled_shapes.add(key)
+                _m_compiles.inc()
+            _m_target_steps.inc()
+            k, v, logits = self._verify_fn(
+                self._params, tokens, positions, q_lens, self.cache.k,
+                self.cache.v, tables, lens)
+            self.cache.rebind(k, v)
+            return logits
+
+    def _run_draft_arrays(self, tokens, positions, q_lens, tables,
+                          lens):
+        """One DRAFT step (propose singles, catch-up chunks, prefill
+        shadowing) against the mirrored draft pool — same page tables
+        as the target, newest-lane logits."""
+        with self._step_mu:
+            key = ("draft", len(tokens), tables.shape[1],
+                   tokens.shape[1])
+            if key not in self._compiled_shapes:
+                self._compiled_shapes.add(key)
+                _m_compiles.inc()
+            _m_draft_steps.inc()
+            k, v, logits = self._draft_fn(
+                self._draft_params, tokens, positions, q_lens,
+                self._draft_cache.k, self._draft_cache.v, tables, lens)
+            self._draft_cache.rebind(k, v)
             return logits
 
     def _prepare(self, live: List[_Slot]
@@ -1102,8 +1356,16 @@ class DecodeEngine:
         if cows or restores:
             with self._step_mu:
                 self.cache.copy_pages(cows)
-                for pages, (k, v) in restores:
-                    self.cache.scatter_pages(pages, k, v)
+                if self._draft_cache is not None:
+                    # the draft pool mirrors every page move: a COW
+                    # tail or restored spill must be valid in BOTH
+                    # pools before the slot's next step reads them
+                    self._draft_cache.copy_pages(cows)
+                for pages, spill in restores:
+                    self.cache.scatter_pages(pages, spill[0], spill[1])
+                    if self._draft_cache is not None and len(spill) == 4:
+                        self._draft_cache.scatter_pages(
+                            pages, spill[2], spill[3])
         while True:
             grants = self._grants(live)
             grower = None
@@ -1187,12 +1449,20 @@ class DecodeEngine:
                            version=self.version, seq=req.seq_id,
                            tokens=victim.pos):
             pages = self.cache.allocator.pages_of(req.seq_id)
+            # only ACCEPTED (committed) tokens spill: victim.pos is the
+            # post-rollback watermark, so a speculative round's
+            # rejected writes are never carried to host
             n_keep = (self.cache.allocator.pages_for_tokens(victim.pos)
                       if victim.pos else 0)
             if n_keep:
                 with self._step_mu:
-                    k, v = self.cache.gather_pages(pages[:n_keep])
-                self._spill.put(req.seq_id, k, v)
+                    arrays = self.cache.gather_pages(pages[:n_keep])
+                    if self._draft_cache is not None:
+                        arrays = arrays + self._draft_cache.gather_pages(
+                            pages[:n_keep])
+                # put (disk-backed spills savez) stays outside the
+                # step mutex, same as the pop side in _prepare
+                self._spill.put(req.seq_id, *arrays)
             self.cache.allocator.free(req.seq_id)
             _m_preemptions.inc()
             with self._cond:
@@ -1203,12 +1473,25 @@ class DecodeEngine:
                     self._spill.drop(req.seq_id)
                 else:
                     req.resume_pos = victim.pos
+                    req.resume_dpos = victim.dpos
                     req.carry_steps = victim.steps
                     req.carry_fts = victim.first_token_steps
                     req.needs_alloc = True
                     self._queue.insert(0, req)
                     self._g_depth.set(len(self._queue))
                 self._g_live.set(len(self._slots))
+
+    def _k_eff(self, s: _Slot) -> int:
+        """Draft proposals this slot can use THIS round: capped by
+        spec_k and by how many tokens the sequence may still commit
+        (a verify round commits up to k_eff + 1, which must not
+        overshoot max_new — so the reservation-bound write at
+        ``pos + k_eff`` also never passes the sequence cap)."""
+        if not self._spec_k or s.req.ev.is_set() or \
+                s.pos < len(s.req.prompt):
+            return 0
+        total = len(s.req.prompt) + s.req.max_new
+        return max(0, min(self._spec_k, total - s.pos - 2))
 
     def _grants(self, live: List[_Slot]) -> List[int]:
         """Token-budget scheduling (Sarathi-style, ISSUE 10): every
@@ -1220,7 +1503,13 @@ class DecodeEngine:
         this is bitwise the PR 6 one-token-per-slot schedule; no slot
         ever starves), so the budget caps the CHUNKS, not progress. A
         solo prompt takes the whole budget every step: P prompt tokens
-        cost ceil(P / prefill_chunk) steps instead of P."""
+        cost ceil(P / prefill_chunk) steps instead of P.
+
+        With speculation on (ISSUE 14) a decoding slot's grant is the
+        positions its VERIFY chunk writes — ``1 + k_eff`` — so demand-
+        mode growth in ``_prepare`` covers the whole speculative write
+        range before the round runs; like decode tokens, speculative
+        lanes are never budgeted against prefill."""
         budget = self._prefill_chunk
         grants = []
         for s in live:
@@ -1229,9 +1518,144 @@ class DecodeEngine:
                 g = max(1, min(remaining_prompt, budget))
                 budget = max(0, budget - g)
             else:
-                g = 1
+                g = 1 + self._k_eff(s)
             grants.append(g)
         return grants
+
+    def _choose(self, row, req: _DecodeRequest, position: int) -> int:
+        """THE deterministic per-(seed, position) token choice on one
+        logits row: greedy argmax at temperature 0, else the seeded
+        ``sample_token`` draw. Draft proposals AND the verify
+        acceptance walk both use it, so a committed token is always
+        exactly what the non-speculative engine would have emitted at
+        that position from those logits — spec on/off bitwise equality
+        is structural, not statistical (the rejection-sampling
+        realization is pinned by (seed, position), ISSUE 14)."""
+        if req.temperature <= 0.0:
+            return int(np.argmax(row))
+        return sample_token(row, req.temperature, req.top_k, req.seed,
+                            position)
+
+    def _check_reservation(self, s: _Slot, end_tokens: int):
+        """The reservation (grown by _prepare in demand mode) must
+        cover every write a step performs. A real raise, not an
+        assert: writing through a page index past the reservation
+        would corrupt another sequence's pages, and ``python -O``
+        strips asserts. Canceled slots are exempt — their pages are
+        gone and their table row is all-garbage, so their writes land
+        on the garbage page by construction."""
+        if not s.req.ev.is_set() and \
+                end_tokens > s.pages_held * self.cache.page_size:
+            raise ServingError(
+                f"chunk grant escaped seq {s.req.seq_id}'s page "
+                f"reservation ({end_tokens} tokens > "
+                f"{s.pages_held} pages x {self.cache.page_size})")
+
+    def _spec_substep(self, slots: List[_Slot], w_bucket: int
+                      ) -> Dict[int, Tuple[List[int], int, int]]:
+        """Propose-then-verify for this round's DECODING slots
+        (ISSUE 14). The draft runs ``k`` batched steps on its own
+        compiled ladder — one catch-up chunk (the committed tokens it
+        hasn't ingested, <= 2 lanes, ending with the pending token)
+        that yields proposal d_1, then k-1 singles — and the target
+        verifies all k+1 positions in ONE all-lane chunked call.
+        Acceptance is the deterministic walk: lane j's target choice
+        (per-(seed, position)) either equals proposal d_{j+1} (accept,
+        continue) or replaces it (the bonus/correction token, stop).
+        Returns {id(slot): (committed tokens, k_eff, accepted)} for the
+        answer phase; nothing here touches request/slot state."""
+        _faults.fire("serving.decode.spec")
+        s_bucket = _bucket_for(self._slot_ladder, len(slots))
+        keff = [self._k_eff(s) for s in slots]
+        for s, ke in zip(slots, keff):
+            # the verify chunk writes positions pos .. pos+ke
+            self._check_reservation(s, s.pos + ke + 1)
+        tables = self.cache.table_array(
+            [s.req.seq_id for s in slots], w_bucket, rows=s_bucket)
+        proposals: List[List[int]] = [[] for _ in slots]
+        with _tracing.span("serving.decode.spec.draft", model=self.name,
+                           version=self.version, slots=s_bucket,
+                           k=self._spec_k):
+            # catch-up + first proposal: feed each slot the committed
+            # tokens its draft pool lacks (positions dpos..pos — the
+            # last is the pending token), newest-lane logits -> d_1
+            gaps = [s.pos - s.dpos for s in slots]
+            c1 = _bucket_for(self._draft_chunk_ladder,
+                             max(g + 1 for g in gaps))
+            tokens = np.zeros((s_bucket, c1), np.int32)
+            positions = np.zeros((s_bucket, c1), np.int32)
+            q_lens = np.zeros(s_bucket, np.int32)
+            lens = np.zeros(s_bucket, np.int32)
+            for i, s in enumerate(slots):
+                if keff[i] < 1:
+                    continue  # bonus-only slot: no proposals needed
+                g = gaps[i] + 1
+                for j in range(g):
+                    tokens[i, j] = s.token_at(s.dpos + j)
+                    positions[i, j] = s.dpos + j
+                q_lens[i] = g
+                lens[i] = s.dpos + g        # == s.pos + 1
+            if int(q_lens.max(initial=0)) > 0:
+                lg = np.asarray(self._run_draft_arrays(
+                    tokens, positions, q_lens, tables, lens))
+                for i, s in enumerate(slots):
+                    if keff[i] >= 1:
+                        proposals[i].append(self._choose(
+                            lg[i], s.req, s.pos + 1))
+                # singles: feed d_{j-1}, propose d_j
+                for j in range(2, self._spec_k + 1):
+                    if not any(ke >= j for ke in keff):
+                        break
+                    tokens = np.zeros((s_bucket, 1), np.int32)
+                    positions = np.zeros((s_bucket, 1), np.int32)
+                    q_lens = np.zeros(s_bucket, np.int32)
+                    lens = np.zeros(s_bucket, np.int32)
+                    for i, s in enumerate(slots):
+                        if keff[i] >= j:
+                            tokens[i, 0] = proposals[i][j - 2]
+                            positions[i, 0] = s.pos + j - 1
+                            q_lens[i] = 1
+                            lens[i] = s.pos + j
+                    lg = np.asarray(self._run_draft_arrays(
+                        tokens, positions, q_lens, tables, lens))
+                    for i, s in enumerate(slots):
+                        if keff[i] >= j:
+                            proposals[i].append(self._choose(
+                                lg[i], s.req, s.pos + j))
+        # verify: ONE target call over [pending, d_1..d_k] at the
+        # FIXED spec_k+1 chunk entry; lane j's logits are the target's
+        # distribution for position pos+1+j
+        with _tracing.span("serving.decode.spec.verify",
+                           model=self.name, version=self.version,
+                           slots=s_bucket, lanes=self._verify_lanes):
+            C = self._verify_lanes
+            tokens = np.zeros((s_bucket, C), np.int32)
+            positions = np.zeros((s_bucket, C), np.int32)
+            q_lens = np.zeros(s_bucket, np.int32)
+            lens = np.zeros(s_bucket, np.int32)
+            for i, s in enumerate(slots):
+                tokens[i, 0] = s.token_at(s.pos)
+                positions[i, 0] = s.pos
+                for j, d in enumerate(proposals[i]):
+                    tokens[i, 1 + j] = d
+                    positions[i, 1 + j] = s.pos + 1 + j
+                q_lens[i] = 1 + keff[i]
+                lens[i] = s.pos + 1 + keff[i]
+            lg = np.asarray(self._run_verify_arrays(
+                tokens, positions, q_lens, tables, lens))  # [B, C, V]
+        out: Dict[int, Tuple[List[int], int, int]] = {}
+        for i, s in enumerate(slots):
+            committed: List[int] = []
+            accepted = 0
+            for j in range(keff[i] + 1):
+                choice = self._choose(lg[i, j], s.req, s.pos + 1 + j)
+                committed.append(choice)
+                if j < keff[i] and proposals[i][j] == choice:
+                    accepted += 1      # d_{j+1} accepted — keep going
+                else:
+                    break              # bonus/correction token: stop
+            out[id(s)] = (committed, keff[i], accepted)
+        return out
 
     def _step(self, live: List[_Slot]):
         # named chaos seam for the SCHEDULER cadence: a
@@ -1246,60 +1670,77 @@ class DecodeEngine:
         live, grants = self._prepare(live)
         if not live:
             return
-        s_bucket = _bucket_for(self._slot_ladder, len(live))
+        # split the round: decoding slots with a draft attached ride
+        # the propose/verify path; prefill chunks (and everything when
+        # speculation is off) ride the PR 9 chunked step unchanged
+        spec_rows = [i for i, s in enumerate(live)
+                     if self._spec_k and not s.req.ev.is_set()
+                     and s.pos >= len(s.req.prompt)]
+        spec_set = set(spec_rows)
+        plain_rows = [i for i in range(len(live)) if i not in spec_set]
         w_need = max(s.pages_held for s in live)
         w_bucket = _bucket_for(self._width_ladder, w_need)
-        # pure-decode steps (and 1-token prefill tails) ride the C=1
-        # shapes — exactly the PR 6 step; only steps carrying a real
-        # chunk pay the chunk-wide compute
-        c_bucket = _bucket_for(self._chunk_ladder, max(max(grants), 1))
-        prefill_toks = sum(g for s, g in zip(live, grants)
-                           if s.pos < len(s.req.prompt))
-        tokens = np.zeros((s_bucket, c_bucket), np.int32)
-        positions = np.zeros((s_bucket, c_bucket), np.int32)
-        q_lens = np.zeros(s_bucket, np.int32)
-        lens = np.zeros(s_bucket, np.int32)
-        for i, (s, g) in enumerate(zip(live, grants)):
-            for j in range(g):
-                tokens[i, j] = s.token_at(s.pos + j)
-                positions[i, j] = s.pos + j
-            q_lens[i] = g
-            # keys INCLUDING this chunk; within it, query j attends
-            # only keys up to its own position (chunk-causal)
-            lens[i] = s.pos + g
-            # the reservation (grown by _prepare in demand mode) must
-            # cover every write this step performs. A real raise, not
-            # an assert: writing through a page index past the
-            # reservation would corrupt another sequence's pages, and
-            # `python -O` strips asserts. Canceled slots are exempt —
-            # their pages are gone and their table row is all-garbage,
-            # so their writes land on the garbage page by construction
-            if not s.req.ev.is_set() and \
-                    lens[i] > s.pages_held * self.cache.page_size:
-                raise ServingError(
-                    f"chunk grant escaped seq {s.req.seq_id}'s page "
-                    f"reservation ({lens[i]} tokens > "
-                    f"{s.pages_held} pages x {self.cache.page_size})")
-        tables = self.cache.table_array(
-            [s.req.seq_id for s in live], w_bucket, rows=s_bucket)
+        prefill_toks = sum(grants[i] for i in plain_rows
+                           if live[i].pos < len(live[i].req.prompt))
         t0 = time.perf_counter()
+        logits_np = sampled = None
+        plain_row_of: Dict[int, int] = {}
+        spec_out: Dict[int, Tuple[List[int], int, int]] = {}
         # one decode step joins the OLDEST live request's trace (a span
         # has one parent); per-slot request spans live in the server
         with _tracing.adopt(live[0].req.trace_ctx), \
                 _tracing.span("serving.decode.step", model=self.name,
-                              version=self.version, slots=s_bucket,
-                              width=w_bucket, chunk=c_bucket,
+                              version=self.version, width=w_bucket,
                               prefill_tokens=prefill_toks,
+                              spec_slots=len(spec_rows),
                               live=len(live)):
-            logits = self._run_step_arrays(tokens, positions, q_lens,
+            if plain_rows:
+                ps_slots = [live[i] for i in plain_rows]
+                ps_grants = [grants[i] for i in plain_rows]
+                s_bucket = _bucket_for(self._slot_ladder, len(ps_slots))
+                # pure-decode steps (and 1-token prefill tails) ride
+                # the C=1 shapes — exactly the PR 6 step; only steps
+                # carrying a real chunk pay the chunk-wide compute
+                c_bucket = _bucket_for(self._chunk_ladder,
+                                       max(max(ps_grants), 1))
+                tokens = np.zeros((s_bucket, c_bucket), np.int32)
+                positions = np.zeros((s_bucket, c_bucket), np.int32)
+                q_lens = np.zeros(s_bucket, np.int32)
+                lens = np.zeros(s_bucket, np.int32)
+                for i, (s, g) in enumerate(zip(ps_slots, ps_grants)):
+                    plain_row_of[id(s)] = i
+                    for j in range(g):
+                        tokens[i, j] = s.token_at(s.pos + j)
+                        positions[i, j] = s.pos + j
+                    q_lens[i] = g
+                    # keys INCLUDING this chunk; within it, query j
+                    # attends only keys up to its own position
+                    lens[i] = s.pos + g
+                    self._check_reservation(s, int(lens[i]))
+                tables = self.cache.table_array(
+                    [s.req.seq_id for s in ps_slots], w_bucket,
+                    rows=s_bucket)
+                logits = self._run_step_arrays(tokens, positions,
+                                               q_lens, tables, lens)
+                if self._spec_k:
+                    # the draft shadows every prefill chunk so its
+                    # mirrored pool tracks the committed sequence
+                    # (logits discarded; its watermark advances in the
+                    # answer phase with pos)
+                    self._run_draft_arrays(tokens, positions, q_lens,
                                            tables, lens)
-        logits_np = np.asarray(logits)   # [B, vocab] — newest lane only
-        # the greedy fast path for the whole batch; per-request sampling
-        # policies (temperature/top_k/seed) resolve per slot below
-        sampled = np.asarray(np.argmax(logits_np, axis=-1))  # [B]
+                logits_np = np.asarray(logits)  # [B, vocab] — newest
+                # the greedy fast path for the whole batch; per-request
+                # sampling policies resolve per slot below
+                sampled = np.asarray(np.argmax(logits_np, axis=-1))
+            if spec_rows:
+                spec_out = self._spec_substep(
+                    [live[i] for i in spec_rows], w_bucket)
         _m_step_ms.observe((time.perf_counter() - t0) * 1e3)
         _m_steps.inc()
-        _m_occupancy.observe(len(live) / float(s_bucket))
+        _m_occupancy.observe(
+            len(live) / float(_bucket_for(self._slot_ladder,
+                                          len(live))))
         # prices the token-budget policy next to occupancy: how much of
         # each step's budget real prefill work consumed
         _m_prefill_per_step.observe(prefill_toks)
@@ -1314,6 +1755,7 @@ class DecodeEngine:
         # with it or the two sides can each answer the same request
         notes: Dict[int, int] = {}
         produced_any = False
+        n_proposed = n_accepted = 0
         with self._cond:
             for i, s in enumerate(live):
                 if s.req.ev.is_set():
@@ -1322,44 +1764,94 @@ class DecodeEngine:
                     # or count a completion/token for it
                     done.append(s)
                     continue
-                g = grants[i]        # >= 1: every live slot progresses
                 s.steps += 1
-                s.pos += g
+                finished = False
+                if id(s) in spec_out:
+                    committed, ke, acc = spec_out[id(s)]
+                    pos_old = s.pos
+                    s.req.spec_proposed += ke
+                    s.req.spec_accepted += acc
+                    n_proposed += ke
+                    n_accepted += acc
+                    for tok in committed:
+                        s.pos += 1
+                        s.req.produced.append(tok)
+                        produced_any = True
+                        _m_tokens.inc()
+                        if s.first_token_steps is None:
+                            s.first_token_steps = s.steps
+                            _m_first_token_steps.observe(s.steps)
+                        if (len(s.req.produced) >= s.req.max_new
+                                or (self.spec.eos_id is not None
+                                    and tok == self.spec.eos_id)):
+                            # tokens past an accepted eos are
+                            # discarded: the committed walk ends here
+                            finished = True
+                            break
+                    if ke > 0 and not finished:
+                        # draft validity watermark: the draft wrote
+                        # through pos_old+ke-1 and tokens are committed
+                        # through pos_old+acc — a fully-accepted round
+                        # leaves it one token behind (it never fed its
+                        # own last proposal), anything else re-syncs
+                        s.dpos = pos_old + min(ke - 1, acc) + 1
+                    if not finished and self._reservation == "demand":
+                        # ROLLBACK (ISSUE 14): any page grown for this
+                        # verify chunk that now holds ONLY rejected
+                        # positions goes straight back to the pool;
+                        # coverage for the pending token's next write
+                        # (pos itself) is kept so acceptance never
+                        # thrashes grow/shrink. note_tokens_many below
+                        # records the rolled-back pos — the "un-note".
+                        need = self.cache.allocator.pages_for_tokens(
+                            s.pos + 1)
+                        if s.pages_held > need:
+                            s.pages_held -= self.cache.allocator.shrink(
+                                s.req.seq_id, s.pages_held - need)
+                else:
+                    g = grants[i]    # >= 1: every live slot progresses
+                    s.pos += g
+                    if self._prefix_on and not s.req.published and \
+                            s.pos >= len(s.req.prompt):
+                        # prompt K/V fully on-device as of THIS step:
+                        # publish the prompt pages into the prefix
+                        # index (metadata only; from here they are
+                        # immutable — this sequence only ever writes
+                        # PAST them, and they outlive its free() as
+                        # the shared cache)
+                        self.cache.allocator.publish(s.req.seq_id,
+                                                     s.req.prompt)
+                        s.req.published = True
+                    if self._spec_k:
+                        # the draft shadowed this prefill chunk lane
+                        # for lane — its watermark advances in lockstep
+                        s.dpos = s.pos
+                    tok = None
+                    if s.pos >= len(s.req.prompt):
+                        # logits_np[row] is the slot's newest lane (the
+                        # step unembeds only lane q_len-1): prompt
+                        # token P-1 when the chunk just finished
+                        # prefill, else the decode token. s.pos is the
+                        # new token's absolute index in its sequence —
+                        # the (seed, position) pair that makes sampling
+                        # independent of batch composition AND chunking
+                        row = plain_row_of[id(s)]
+                        tok = (int(sampled[row])
+                               if s.req.temperature <= 0.0
+                               else sample_token(
+                                   logits_np[row], s.req.temperature,
+                                   s.req.top_k, s.req.seed, s.pos))
+                        s.req.produced.append(tok)
+                        produced_any = True
+                        _m_tokens.inc()
+                        if s.first_token_steps is None:
+                            s.first_token_steps = s.steps
+                            _m_first_token_steps.observe(s.steps)
+                    finished = (len(s.req.produced) >= s.req.max_new
+                                or (tok is not None
+                                    and self.spec.eos_id is not None
+                                    and tok == self.spec.eos_id))
                 notes[s.req.seq_id] = s.pos
-                if self._prefix_on and not s.req.published and \
-                        s.pos >= len(s.req.prompt):
-                    # prompt K/V fully on-device as of THIS step:
-                    # publish the prompt pages into the prefix index
-                    # (metadata only; from here they are immutable —
-                    # this sequence only ever writes PAST them, and
-                    # they outlive its free() as the shared cache)
-                    self.cache.allocator.publish(s.req.seq_id,
-                                                 s.req.prompt)
-                    s.req.published = True
-                tok = None
-                if s.pos >= len(s.req.prompt):
-                    # logits_np[i] is the slot's newest lane (the step
-                    # unembeds only lane q_len-1): prompt token P-1
-                    # when the chunk just finished prefill, else the
-                    # decode token. s.pos is the new token's absolute
-                    # index in its sequence — the (seed, position) pair
-                    # that makes sampling independent of batch
-                    # composition AND of chunking
-                    tok = (int(sampled[i])
-                           if s.req.temperature <= 0.0
-                           else sample_token(
-                               logits_np[i], s.req.temperature,
-                               s.req.top_k, s.req.seed, s.pos))
-                    s.req.produced.append(tok)
-                    produced_any = True
-                    _m_tokens.inc()
-                    if s.first_token_steps is None:
-                        s.first_token_steps = s.steps
-                        _m_first_token_steps.observe(s.steps)
-                finished = (len(s.req.produced) >= s.req.max_new
-                            or (tok is not None
-                                and self.spec.eos_id is not None
-                                and tok == self.spec.eos_id))
                 if finished:
                     # finished beats a lapsed deadline: the result is
                     # fully paid for — deliver it rather than discard
@@ -1383,6 +1875,10 @@ class DecodeEngine:
                 # notify lands, ceil(prompt/chunk) steps after
                 # admission, not when the whole sequence finishes
                 self._cond.notify_all()
+        if n_proposed:
+            _m_spec_proposed.inc(n_proposed)
+            _m_spec_accepted.inc(n_accepted)
+            _m_spec_rejected.inc(n_proposed - n_accepted)
 
     def _complete(self, s: _Slot):
         self.cache.allocator.free(s.req.seq_id)
@@ -1401,5 +1897,16 @@ class DecodeEngine:
             # prompt tokens answered from the prefix index instead of
             # prefilled (0 = cold)
             "cached_tokens": int(s.req.cached_tokens),
+            # speculative decoding (ISSUE 14): draft proposals this
+            # request saw and the fraction the target accepted (None =
+            # no speculative round touched it / speculation off)
+            "spec_proposed": int(s.req.spec_proposed),
+            "spec_accepted": int(s.req.spec_accepted),
+            "accept_rate": (
+                round(s.req.spec_accepted / s.req.spec_proposed, 4)
+                if s.req.spec_proposed else None),
         }
+        if s.req.spec_proposed:
+            _m_spec_accept_rate.observe(
+                s.req.spec_accepted / s.req.spec_proposed)
         s.req.ev.set()

@@ -87,9 +87,63 @@ _m_prefix_cow = _metrics.counter("serving.prefix.cow_copies")
 # preemption spill traffic (ISSUE 13): pages/bytes that crossed to host
 _m_spilled_pages = _metrics.counter("serving.kv.spilled_pages")
 _m_spill_bytes = _metrics.counter("serving.kv.spill_bytes")
+# speculative-decode rollback (ISSUE 14): pages that were grown for a
+# verify chunk but ended up holding ONLY rejected tokens, returned to
+# the free list by PageAllocator.shrink (the exact-pool invariant)
+_m_shrunk_pages = _metrics.counter("serving.kv.shrunk_pages")
+# one inc per TRACE of a fused page-move helper — i.e. one per distinct
+# (pool shape, index count) the jitted gather/scatter/copy ops compile
+# (the ROADMAP spill-economics residual: the helpers used to be eager
+# whole-pool .at[].set updates; the counter proves repeat moves at the
+# same shape re-use the executable)
+_m_pagemove_compiles = _metrics.counter("serving.kv.pagemove_compiles")
 
 # the root of every prefix chain; depth-1 entries hang off it
 PREFIX_ROOT = "root"
+
+# fused page-move executables (ISSUE 14 satellite): COW copies, spill
+# gathers and restore scatters are jitted batched ops compiled once per
+# (pool shape, page count) instead of eager whole-pool .at[].set
+# updates — on TPU the copy/scatter donate the pools so XLA updates the
+# pages in place. Built lazily (the backend must not initialize at
+# import) and shared by every PagedKvCache in the process.
+_page_move_mu = threading.Lock()
+_PAGE_MOVE: Dict[str, Any] = {}  # guarded-by: _page_move_mu
+
+
+def _page_move_fns() -> Dict[str, Any]:
+    with _page_move_mu:
+        if _PAGE_MOVE:
+            return dict(_PAGE_MOVE)
+        import jax
+
+        # CPU ignores donation (and warns per call) — donate only where
+        # it buys the in-place update, same as the decode step
+        donate = jax.default_backend() == "tpu"
+
+        # the .inc() calls run at TRACE time only: each fires once per
+        # compiled shape, never per call — that IS the compiled-once
+        # evidence the satellite test pins
+        def copy_kv(k, v, src, dst):
+            _m_pagemove_compiles.inc()
+            return (k.at[:, dst].set(k[:, src]),
+                    v.at[:, dst].set(v[:, src]))
+
+        def gather_kv(k, v, idx):
+            _m_pagemove_compiles.inc()
+            return k[:, idx], v[:, idx]
+
+        def scatter_kv(k, v, idx, ks, vs):
+            _m_pagemove_compiles.inc()
+            return (k.at[:, idx].set(ks.astype(k.dtype)),
+                    v.at[:, idx].set(vs.astype(v.dtype)))
+
+        _PAGE_MOVE["copy"] = jax.jit(
+            copy_kv, donate_argnums=(0, 1) if donate else ())
+        _PAGE_MOVE["gather"] = jax.jit(gather_kv)
+        _PAGE_MOVE["scatter"] = jax.jit(
+            scatter_kv, donate_argnums=(0, 1) if donate else ())
+        return dict(_PAGE_MOVE)
 
 
 def chain_digest(parent: str, tokens) -> str:
@@ -351,30 +405,34 @@ class HostSpillStore:
         return os.path.join(self._dir,
                             f"kvspill-{self._label}-{int(seq_id)}.npz")
 
-    def put(self, seq_id: int, k: np.ndarray, v: np.ndarray):
-        n_pages = int(k.shape[1])
-        nbytes = int(k.nbytes + v.nbytes)
+    def put(self, seq_id: int, *arrays: np.ndarray):
+        """Park one preempted sequence's page contents: ``(k, v)`` for
+        a plain decoder, ``(k, v, draft_k, draft_v)`` when a
+        speculative draft's mirrored pool spills alongside (ISSUE 14 —
+        same page ids, so one spill covers both pools)."""
+        n_pages = int(arrays[0].shape[1])
+        nbytes = int(sum(a.nbytes for a in arrays))
         if self._dir:
             # disk I/O outside the mutex: count()/stats() callers hold
             # the engine condition and must not stall on a slow savez
             os.makedirs(self._dir, exist_ok=True)
             ent: Any = self._path(seq_id)
-            np.savez(ent, k=k, v=v)
+            np.savez(ent, **{f"a{i}": a for i, a in enumerate(arrays)})
         else:
-            ent = (k, v)
+            ent = tuple(arrays)
         with self._mu:
             self._store[int(seq_id)] = ent
         _m_spilled_pages.inc(n_pages)
         _m_spill_bytes.inc(nbytes)
 
-    def pop(self, seq_id: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    def pop(self, seq_id: int) -> Optional[Tuple[np.ndarray, ...]]:
         with self._mu:
             ent = self._store.pop(int(seq_id), None)
         if ent is None:
             return None
         if isinstance(ent, str):
             with np.load(ent) as z:
-                out = (z["k"], z["v"])
+                out = tuple(z[f"a{i}"] for i in range(len(z.files)))
             try:
                 os.remove(ent)
             except OSError:  # pragma: no cover - already swept
@@ -689,6 +747,35 @@ class PageAllocator:
             self._publish_locked()
             return pages
 
+    def shrink(self, seq_id: int, n_pages: int) -> int:
+        """Return the LAST ``n_pages`` of a live sequence's reservation
+        to the free list — the speculative-decode rollback (ISSUE 14):
+        a verify chunk grows the reservation to cover ``k+1`` writes,
+        and a page that ended up holding ONLY rejected tokens must not
+        stay reserved (the exact-pool invariant). Tail pages during
+        decode are always private fresh pages, but each popped page
+        still routes through the prefix-release check defensively.
+        Returns how many pages were actually freed (capped so the
+        sequence always keeps >= 1 page)."""
+        with self._mu:
+            pages = self._owner.get(seq_id)
+            if pages is None:
+                raise ValueError(f"sequence {seq_id} holds no pages")
+            take = max(0, min(int(n_pages), len(pages) - 1))
+            freed = 0
+            for _ in range(take):
+                p = pages.pop()
+                if self.prefix is not None and \
+                        self.prefix.release_page_locked(p):
+                    continue  # pragma: no cover - published tail page
+                self._free.append(p)
+                freed += 1
+            if freed:
+                _m_shrunk_pages.inc(freed)
+                _m_frees.inc(freed)
+                self._publish_locked()
+            return freed
+
     def publish(self, seq_id: int, prompt: Sequence[int]) -> int:
         """Publish a sequence's completed prompt pages into the prefix
         index (no-op without prefix caching). Metadata only — the K/V
@@ -805,14 +892,30 @@ class PagedKvCache:
 
     def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
                  *, page_size: int, num_pages: int, dtype=None,
-                 label: Optional[str] = None, prefix_cache: bool = False):
+                 label: Optional[str] = None, prefix_cache: bool = False,
+                 allocator: Optional[PageAllocator] = None):
         import jax.numpy as jnp
 
         self.num_layers = int(num_layers)
         self.num_kv_heads = int(num_kv_heads)
         self.head_dim = int(head_dim)
-        self.allocator = PageAllocator(num_pages, page_size, label=label,
-                                       prefix_cache=prefix_cache)
+        # a speculative DRAFT pool (ISSUE 14) MIRRORS its target's page
+        # geometry: pass the target's allocator and the two pools share
+        # one set of page ids/tables — one reservation, one free, one
+        # set of occupancy gauges; only the per-page payload shape
+        # (layers/heads/dim) differs
+        if allocator is not None:
+            if (allocator.num_pages != int(num_pages)
+                    or allocator.page_size != int(page_size)):
+                raise ValueError(
+                    f"shared allocator geometry "
+                    f"({allocator.num_pages}x{allocator.page_size}) != "
+                    f"pool geometry ({num_pages}x{page_size})")
+            self.allocator = allocator
+        else:
+            self.allocator = PageAllocator(num_pages, page_size,
+                                           label=label,
+                                           prefix_cache=prefix_cache)
         self.dtype = jnp.float32 if dtype is None else dtype
         shape = (self.num_layers, int(num_pages), int(page_size),
                  self.num_kv_heads, self.head_dim)
@@ -845,31 +948,40 @@ class PagedKvCache:
 
     def copy_pages(self, pairs: Sequence[Tuple[int, int]]):
         """Copy-on-write: duplicate page contents src -> dst in one
-        batched functional update (whole pages — the mapper trusts only
-        the published token offsets and overwrites the rest itself).
-        Caller holds the engine's step mutex."""
+        jitted batched update, compiled once per (pool shape, pair
+        count) — the ROADMAP spill-economics residual replaced the
+        eager whole-pool ``.at[].set`` form (whole pages either way:
+        the mapper trusts only the published token offsets and
+        overwrites the rest itself). Caller holds the engine's step
+        mutex."""
         if not pairs:
             return
         if self.k is None:
             raise ServingError("KV pools released — engine retired")
         srcs = np.asarray([p[0] for p in pairs], np.int32)
         dsts = np.asarray([p[1] for p in pairs], np.int32)
-        self.k = self.k.at[:, dsts].set(self.k[:, srcs])
-        self.v = self.v.at[:, dsts].set(self.v[:, srcs])
+        self.k, self.v = _page_move_fns()["copy"](self.k, self.v,
+                                                  srcs, dsts)
 
     def gather_pages(self, pages: Sequence[int]
                      ) -> Tuple[np.ndarray, np.ndarray]:
         """Read page contents to host (preemption spill): bitwise
-        copies of ``[layers, len(pages), page_size, heads, dim]``."""
+        copies of ``[layers, len(pages), page_size, heads, dim]`` via
+        the jitted batched gather (one executable per page count, not
+        one whole-pool slice per call)."""
+        if self.k is None:
+            raise ServingError("KV pools released — engine retired")
         idx = np.asarray(list(pages), np.int32)
-        return (np.asarray(self.k[:, idx]), np.asarray(self.v[:, idx]))
+        k, v = _page_move_fns()["gather"](self.k, self.v, idx)
+        return np.asarray(k), np.asarray(v)
 
     def scatter_pages(self, pages: Sequence[int], k: np.ndarray,
                       v: np.ndarray):
         """Write spilled page contents back (preemption restore) —
         the bitwise inverse of ``gather_pages``, into a possibly
         DIFFERENT set of physical pages (the table rebinds; content,
-        not placement, is what round-trips)."""
+        not placement, is what round-trips). Same jitted batched
+        scatter, donated in place on TPU."""
         if self.k is None:
             raise ServingError("KV pools released — engine retired")
         idx = np.asarray(list(pages), np.int32)
@@ -877,8 +989,8 @@ class PagedKvCache:
             raise ServingError(
                 f"spill restore shape mismatch: {k.shape[1]} spilled "
                 f"pages vs {idx.shape[0]} target pages")
-        self.k = self.k.at[:, idx].set(k.astype(self.k.dtype))
-        self.v = self.v.at[:, idx].set(v.astype(self.v.dtype))
+        self.k, self.v = _page_move_fns()["scatter"](self.k, self.v,
+                                                     idx, k, v)
 
     def table_array(self, seq_ids: Sequence[int], width: int,
                     rows: Optional[int] = None) -> np.ndarray:

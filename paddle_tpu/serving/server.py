@@ -428,6 +428,33 @@ class ServingServer:
                 f"auto-assign v{live + 1}")
         return version
 
+    @staticmethod
+    def _resolve_decoder_artifact(what: str, spec, checkpoint_dir):
+        """One rule for (spec dict, checkpoint_dir) -> (DecoderSpec,
+        params), shared by the target and the speculative draft
+        (ISSUE 14): a checkpoint loads real weights and its saved spec,
+        a bare spec builds the deterministic seed decoder, and giving
+        both cross-validates — a contradiction is a wrong-model deploy,
+        refused before any compile."""
+        from .decode import DecoderSpec
+
+        if checkpoint_dir is not None:
+            from ..checkpoint import load_decoder_checkpoint
+
+            use_spec, params = load_decoder_checkpoint(
+                str(checkpoint_dir))
+            if spec is not None:
+                want = DecoderSpec.from_dict(dict(spec))
+                if want.to_dict() != use_spec.to_dict():
+                    raise ValueError(
+                        f"{what} spec given to load_decoder contradicts "
+                        f"checkpoint '{checkpoint_dir}': "
+                        f"{want.to_dict()} != {use_spec.to_dict()}")
+            return use_spec, params
+        if spec is None:
+            return None, None
+        return DecoderSpec.from_dict(dict(spec)), None
+
     def _load_decoder(self, model: str,
                       spec: Optional[Dict[str, Any]] = None,
                       version: Optional[int] = None,
@@ -439,7 +466,10 @@ class ServingServer:
                       prefill_chunk: Optional[int] = None,
                       checkpoint_dir: Optional[str] = None,
                       prefix_cache: Optional[bool] = None,
-                      reservation: Optional[str] = None
+                      reservation: Optional[str] = None,
+                      draft_spec: Optional[Dict[str, Any]] = None,
+                      draft_checkpoint_dir: Optional[str] = None,
+                      spec_k: Optional[int] = None
                       ) -> Dict[str, Any]:
         """Build + warm (every slot/width shape) + atomically install a
         DecodeEngine. ``checkpoint_dir`` loads REAL weights (and the
@@ -447,31 +477,24 @@ class ServingServer:
         typed tensor-named failure on corruption); ``spec`` alone
         deploys the deterministic seed-built decoder as before. Giving
         both cross-validates: a spec that contradicts the checkpoint's
-        is a wrong-model deploy, refused before any compile. Hot-
-        swapping a decoder drains the old engine — every in-flight
-        SEQUENCE finishes on its own KV cache before the old pool
-        releases."""
-        from .decode import DecodeEngine, DecoderSpec
+        is a wrong-model deploy, refused before any compile.
+        ``draft_spec``/``draft_checkpoint_dir`` attach a speculative
+        DRAFT decoder the same way (ISSUE 14; cross-validated against
+        the target — same vocab/eos required, typed refusal naming the
+        field) and ``spec_k`` pins the proposals-per-round (None = the
+        server's autotune cache / FLAGS default). Hot-swapping a
+        decoder drains the old engine — every in-flight SEQUENCE
+        finishes on its own KV cache before the old pool releases."""
+        from .decode import DecodeEngine
 
         model = str(model)
-        params = None
-        if checkpoint_dir is not None:
-            from ..checkpoint import load_decoder_checkpoint
-
-            use_spec, params = load_decoder_checkpoint(
-                str(checkpoint_dir))
-            if spec is not None:
-                want = DecoderSpec.from_dict(dict(spec))
-                if want.to_dict() != use_spec.to_dict():
-                    raise ValueError(
-                        f"spec given to load_decoder contradicts "
-                        f"checkpoint '{checkpoint_dir}': "
-                        f"{want.to_dict()} != {use_spec.to_dict()}")
-        elif spec is None:
+        use_spec, params = self._resolve_decoder_artifact(
+            "target", spec, checkpoint_dir)
+        if use_spec is None:
             raise ValueError(
                 "load_decoder needs a spec dict or a checkpoint_dir")
-        else:
-            use_spec = DecoderSpec.from_dict(dict(spec))
+        use_draft, draft_params = self._resolve_decoder_artifact(
+            "draft", draft_spec, draft_checkpoint_dir)
         # lint: allow-blocking — deploys serialize end-to-end; see
         # _load_mu above. generate/infer traffic never takes this lock.
         with self._load_mu:
@@ -487,7 +510,9 @@ class ServingServer:
                     prefix_cache=(None if prefix_cache is None
                                   else bool(prefix_cache)),
                     reservation=(None if reservation is None
-                                 else str(reservation)))
+                                 else str(reservation)),
+                    draft_spec=use_draft, draft_params=draft_params,
+                    spec_k=(None if spec_k is None else int(spec_k)))
 
             engine = self._registry.deploy(model, build)
             return engine.stats()
@@ -558,6 +583,10 @@ class ServingServer:
                 entry["live_slots"] = st["live"]
                 entry["max_slots"] = max(st["slots"])
                 entry["max_seq_len"] = st["max_seq_len"]
+                # speculative decoding (ISSUE 14): proposals per round
+                # (0 = off) — lets operators see which replicas carry a
+                # draft after a partial rollout
+                entry["spec_k"] = st.get("spec_k", 0)
                 # prefix-cache warmth (ISSUE 13): the MRU depth-1
                 # chain digests let a FleetRouter recognize a replica
                 # whose cache already covers a request's prefix —
