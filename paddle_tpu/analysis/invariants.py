@@ -251,6 +251,7 @@ class NameUniverse:
         "autotune": "paddle_tpu.autotune",
         "fleet": "paddle_tpu.fleet",
         "checkpoint": "paddle_tpu.checkpoint",
+        "mesh": "paddle_tpu.mesh",
     }
 
     def __init__(self, names: Tuple[Set[str], Set[str]],
@@ -563,7 +564,7 @@ def check_repo(root: Optional[str] = None) -> List[Diagnostic]:
     docs = [os.path.join(root, "docs", n)
             for n in ("OBSERVABILITY.md", "FAULT_TOLERANCE.md",
                       "STATIC_ANALYSIS.md", "SERVING.md", "AUTOTUNE.md",
-                      "FLEET.md", "CHECKPOINT.md")]
+                      "FLEET.md", "CHECKPOINT.md", "MESH.md")]
     diags: List[Diagnostic] = []
 
     sites = collect_declared_sites(pkg)

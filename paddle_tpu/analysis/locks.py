@@ -559,4 +559,5 @@ def default_lint_paths(repo_root: Optional[str] = None) -> List[str]:
             os.path.join(pkg, "serving"),
             os.path.join(pkg, "autotune"),
             os.path.join(pkg, "fleet"),
-            os.path.join(pkg, "checkpoint")]
+            os.path.join(pkg, "checkpoint"),
+            os.path.join(pkg, "mesh")]

@@ -65,6 +65,7 @@ _RUNTIME_CLASSES: Tuple[Tuple[str, str], ...] = (
     ("paddle_tpu.fleet.router", "FleetRouter"),
     ("paddle_tpu.fleet.member", "FleetMember"),
     ("paddle_tpu.checkpoint.format", "CheckpointWriter"),
+    ("paddle_tpu.mesh.observe", "_MeshStats"),
 )
 
 _ARMED_FLAG = "_guard_sanitizer_armed_"

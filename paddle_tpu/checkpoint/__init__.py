@@ -16,17 +16,21 @@ controller's intent log. See docs/CHECKPOINT.md.
     python -m paddle_tpu.checkpoint verify DIR    # full checksum pass
     python -m paddle_tpu.checkpoint --selftest    # in-process proof
 """
-from .decoder import (expected_decoder_tensors, load_decoder_checkpoint,
-                      save_decoder_checkpoint)
+from .decoder import (decoder_checkpoint_mesh, expected_decoder_tensors,
+                      load_decoder_checkpoint, save_decoder_checkpoint)
 from .format import (CheckpointCorruptError, CheckpointError,
                      CheckpointWriter, load_checkpoint_arrays,
                      load_checkpoint_tree, read_manifest,
                      save_checkpoint_tree)
+from .sharded import (load_sharded_arrays, load_sharded_checkpoint,
+                      save_sharded_checkpoint)
 
 __all__ = [
     "CheckpointError", "CheckpointCorruptError", "CheckpointWriter",
     "save_checkpoint_tree", "load_checkpoint_tree",
     "load_checkpoint_arrays", "read_manifest",
     "save_decoder_checkpoint", "load_decoder_checkpoint",
-    "expected_decoder_tensors",
+    "expected_decoder_tensors", "decoder_checkpoint_mesh",
+    "save_sharded_checkpoint", "load_sharded_checkpoint",
+    "load_sharded_arrays",
 ]

@@ -33,22 +33,39 @@ def cmd_inspect(dirname: str) -> int:
     from .format import read_manifest
 
     m = read_manifest(dirname)
-    total = sum(int(t["nbytes"]) for t in m["tensors"])
+    sharded = "payloads" in m
+
+    def _t_bytes(t):
+        if sharded:
+            return sum(int(s["nbytes"]) for s in t["segments"])
+        return int(t["nbytes"])
+
+    total = sum(_t_bytes(t) for t in m["tensors"])
     print(f"checkpoint {dirname}")
     print(f"  format:  v{m['format']}")
-    print(f"  payload: {m['payload']} ({total} tensor bytes, "
-          f"{len(m['tensors'])} tensors)")
+    if sharded:
+        print(f"  payloads: {len(m['payloads'])} shard files over axis "
+              f"'{m['shard_axis']}' ({total} tensor bytes, "
+              f"{len(m['tensors'])} tensors)")
+    else:
+        print(f"  payload: {m['payload']} ({total} tensor bytes, "
+              f"{len(m['tensors'])} tensors)")
     meta = m.get("meta") or {}
     if meta:
         print(f"  meta:    {json.dumps(meta, sort_keys=True)}")
     if m.get("base"):
         print(f"  base:    {m['base']}")
     for t in m["tensors"]:
-        # delta checkpoints: a base-resident tensor has no offset here
-        loc = "base" if t.get("base") else f"@{t['offset']}"
+        if sharded:
+            dim = t.get("dim")
+            loc = ("replicated" if dim is None
+                   else f"dim {dim} over {len(t['segments'])} shards")
+        else:
+            # delta checkpoints: a base-resident tensor has no offset
+            loc = "base" if t.get("base") else f"@{t['offset']}"
         print(f"  {t['name']:<24} {t['dtype']:<10} "
               f"{str(tuple(t['shape'])):<18} {loc} "
-              f"({t['nbytes']} B)")
+              f"({_t_bytes(t)} B)")
     return 0
 
 
@@ -65,8 +82,10 @@ def cmd_verify(dirname: str) -> int:
         print(f"INVALID: {e}")
         return 1
     total = sum(a.nbytes for a in arrays.values())
+    what = (f"{len(m['payloads'])} shard payloads"
+            if "payloads" in m else m["payload"])
     print(f"OK: {len(arrays)} tensors, {total} bytes, every "
-          f"checksum verified ({m['payload']})")
+          f"checksum verified ({what})")
     return 0
 
 

@@ -19,7 +19,7 @@ from .format import (CheckpointError, load_checkpoint_tree,
                      save_checkpoint_tree)
 
 __all__ = ["save_decoder_checkpoint", "load_decoder_checkpoint",
-           "expected_decoder_tensors"]
+           "expected_decoder_tensors", "decoder_checkpoint_mesh"]
 
 
 def expected_decoder_tensors(spec) -> Dict[str, Tuple[int, ...]]:
@@ -52,7 +52,10 @@ def expected_decoder_tensors(spec) -> Dict[str, Tuple[int, ...]]:
 def save_decoder_checkpoint(dirname: str, spec,
                             params: Optional[Dict[str, Any]] = None,
                             step: Optional[int] = None,
-                            base_manifest: Optional[str] = None) -> str:
+                            base_manifest: Optional[str] = None,
+                            mesh_axes: Optional[Any] = None,
+                            mesh_rules: Optional[Any] = None,
+                            shard_axis: Optional[str] = None) -> str:
     """Persist a decoder (spec + parameter tree) as a manifest
     checkpoint. ``params=None`` saves the spec's deterministic
     seed-built tree (the test/bench vehicle); a live engine passes its
@@ -62,7 +65,19 @@ def save_decoder_checkpoint(dirname: str, spec,
     names a prior decoder checkpoint DIRECTORY: only tensors whose
     crc32 differs from the base are written — the rest become base
     references the loader follows — so a fine-tune that touched two
-    layers costs two layers of payload, not the whole model."""
+    layers costs two layers of payload, not the whole model.
+
+    ``mesh_axes`` (ISSUE 15) RECORDS the serving mesh in the manifest
+    meta — ``load_decoder(checkpoint_dir=)`` then deploys the engine
+    sharded exactly as exported, no operator knob needed;
+    ``mesh_rules`` overrides the default ``mesh.decoder_rules``.
+    ``shard_axis`` additionally writes the SHARDED payload layout (one
+    file per shard of that mesh axis, merged manifest) instead of one
+    monolithic payload; it requires ``mesh_axes`` and is incompatible
+    with ``base_manifest`` (delta chains are a monolithic-layout
+    feature)."""
+    import numpy as _np
+
     from ..serving.decode import build_decoder_params
 
     if params is None:
@@ -70,8 +85,46 @@ def save_decoder_checkpoint(dirname: str, spec,
     meta: Dict[str, Any] = {"kind": "decoder", "spec": spec.to_dict()}
     if step is not None:
         meta["step"] = int(step)
+    if shard_axis is not None and mesh_axes is None:
+        raise CheckpointError(
+            "shard_axis needs mesh_axes — the shard count is that mesh "
+            "axis's size")
+    if mesh_axes is not None:
+        from ..mesh import MeshSpec, ShardingRules, decoder_rules
+
+        ms = MeshSpec.coerce(mesh_axes)
+        rules = ShardingRules.coerce(mesh_rules, default=decoder_rules)
+        if shard_axis is not None:
+            if base_manifest is not None:
+                raise CheckpointError(
+                    "sharded decoder checkpoints do not support "
+                    "base_manifest deltas — save monolithic or full")
+            import jax
+
+            from .sharded import save_sharded_checkpoint
+
+            # jax arrays (possibly device-sharded) -> host before the
+            # splitter slices them
+            host = jax.tree_util.tree_map(_np.asarray, params)
+            return save_sharded_checkpoint(
+                dirname, host, shard_axis=str(shard_axis),
+                mesh_spec=ms, rules=rules, meta=meta)
+        meta["mesh"] = {"spec": ms.to_dict(), "rules": rules.to_dict()}
     return save_checkpoint_tree(dirname, params, meta=meta,
                                 base=base_manifest)
+
+
+def decoder_checkpoint_mesh(dirname: str) -> Optional[Dict[str, Any]]:
+    """The mesh a decoder checkpoint RECORDED at export (``{"spec":
+    MeshSpec dict, "rules": ShardingRules dict}``), or None for
+    single-chip artifacts. Reads only the manifest — no payload I/O —
+    so the serving deploy path can decide the engine's mesh before
+    loading a single tensor."""
+    from .format import read_manifest
+
+    manifest = read_manifest(dirname)
+    meta = manifest.get("meta") or {}
+    return meta.get("mesh")
 
 
 def load_decoder_checkpoint(dirname: str, verify: bool = True):

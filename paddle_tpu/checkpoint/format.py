@@ -390,6 +390,73 @@ def save_checkpoint_tree(dirname: str, tree,
 
 # --- reader -------------------------------------------------------------
 
+def open_payload_map(path: str):
+    """mmap a payload file read-only; returns ``(map, size)``. The map
+    holds its own file reference. Missing file is the caller's
+    stale-manifest concern — this helper assumes existence."""
+    size = os.path.getsize(path)
+    f = open(path, "rb")
+    try:
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) \
+            if size else b""
+    finally:
+        f.close()
+    return mm, size
+
+
+def verified_segment(mm, size: int, path: str, name: str, off: int,
+                     nbytes: int, dtype_str: str, shape, crc32: int,
+                     verify: bool, where: str = "") -> np.ndarray:
+    """ONE payload segment as a zero-copy read-only view: bounds check,
+    chunked crc32 fold, nbytes-vs-declared-shape check — every failure
+    is ``CheckpointCorruptError`` NAMING the tensor (``where`` adds
+    shard context). The one segment-verification rule both the
+    monolithic and the sharded loader use, so a fix lands once."""
+    if off < 0 or off + nbytes > size:
+        _m_corrupt.inc()
+        raise CheckpointCorruptError(
+            f"tensor '{name}' is truncated{where}: segment "
+            f"[{off}, {off + nbytes}) exceeds payload size "
+            f"{size} ('{path}')", tensor=name)
+    if verify:
+        crc = 0
+        for c0 in range(off, off + nbytes, _CRC_CHUNK):
+            c1 = min(c0 + _CRC_CHUNK, off + nbytes)
+            crc = zlib.crc32(mm[c0:c1], crc)
+        if (crc & 0xFFFFFFFF) != int(crc32):
+            _m_corrupt.inc()
+            raise CheckpointCorruptError(
+                f"tensor '{name}' failed its checksum{where} "
+                f"(crc {crc & 0xFFFFFFFF:#010x} != manifest "
+                f"{int(crc32):#010x}) — '{path}' is corrupt",
+                tensor=name)
+    dtype = np.dtype(str(dtype_str))
+    count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if count * dtype.itemsize != nbytes:
+        _m_corrupt.inc()
+        raise CheckpointCorruptError(
+            f"tensor '{name}' declares shape {list(shape)} "
+            f"({count} x {dtype}) but {nbytes} payload bytes{where}",
+            tensor=name)
+    return np.frombuffer(mm, dtype=dtype, count=count,
+                         offset=off).reshape(shape)
+
+
+def restore_tree(arrays: Dict[str, np.ndarray], manifest: Dict[str, Any]):
+    """Rebuild the nested container tree a manifest's ``tree`` skeleton
+    describes over a flat array map (shared by the monolithic and
+    sharded tree loaders)."""
+    skel = manifest.get("tree")
+    if skel is None:
+        return dict(arrays)
+    try:
+        return _unflatten(skel, arrays)
+    except KeyError as e:
+        raise CheckpointError(
+            f"manifest tree references tensor {e.args[0]!r} that the "
+            "tensor index does not declare") from e
+
+
 def read_manifest(dirname: str) -> Dict[str, Any]:
     """Parse + structurally validate the manifest. Typed errors name
     the offending path; corrupt JSON is a CheckpointError, not a
@@ -409,7 +476,7 @@ def read_manifest(dirname: str) -> Dict[str, Any]:
     except (ValueError, OSError) as e:
         raise CheckpointError(f"manifest '{path}' unreadable: {e}") from e
     if not isinstance(manifest, dict) or "tensors" not in manifest \
-            or "payload" not in manifest:
+            or ("payload" not in manifest and "payloads" not in manifest):
         raise CheckpointError(f"manifest '{path}' is not a checkpoint "
                               "manifest (missing payload/tensors)")
     fmt = manifest.get("format")
@@ -438,6 +505,15 @@ def load_checkpoint_arrays(dirname: str, verify: bool = True,
             f"checkpoint base chain at '{dirname}' exceeds 64 links — "
             "circular base references?")
     manifest = read_manifest(dirname)
+    if "payloads" in manifest:
+        # sharded layout (ISSUE 15): one payload per mesh shard, merged
+        # manifest — delegate so every flat-view consumer (decoder
+        # deploys, inspect/verify) reads both layouts transparently
+        # (handing over the manifest we already parsed)
+        from .sharded import load_sharded_arrays
+
+        return load_sharded_arrays(dirname, verify=verify,
+                                   _manifest=manifest)
     payload_path = os.path.join(dirname, manifest["payload"])
     if not os.path.exists(payload_path):
         # a CONCURRENT cross-process save may have committed between
@@ -447,6 +523,13 @@ def load_checkpoint_arrays(dirname: str, verify: bool = True,
         # healthy and simply moved on; the same payload still missing
         # means it really was deleted out from under the manifest.
         fresh = read_manifest(dirname)
+        if "payloads" in fresh:
+            # the overwriting save switched the directory to the
+            # SHARDED layout — delegate, same recovery contract
+            from .sharded import load_sharded_arrays
+
+            return load_sharded_arrays(dirname, verify=verify,
+                                       _manifest=fresh)
         if fresh["payload"] != manifest["payload"]:
             manifest = fresh
             payload_path = os.path.join(dirname, manifest["payload"])
@@ -454,16 +537,9 @@ def load_checkpoint_arrays(dirname: str, verify: bool = True,
         raise CheckpointError(
             f"manifest references missing payload '{payload_path}' — "
             "the checkpoint directory was partially deleted")
-    size = os.path.getsize(payload_path)
     with _tracing.span("checkpoint.load", dir=dirname,
                        tensors=len(manifest["tensors"])):
-        f = open(payload_path, "rb")
-        try:
-            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) \
-                if size else b""
-        finally:
-            # the map holds its own reference to the file
-            f.close()
+        mm, size = open_payload_map(payload_path)
         out: Dict[str, np.ndarray] = {}
         read = 0
         base_refs: List[Dict[str, Any]] = []
@@ -472,37 +548,11 @@ def load_checkpoint_arrays(dirname: str, verify: bool = True,
             if t.get("base"):
                 base_refs.append(t)
                 continue
-            off, nbytes = int(t["offset"]), int(t["nbytes"])
-            if off < 0 or off + nbytes > size:
-                _m_corrupt.inc()
-                raise CheckpointCorruptError(
-                    f"tensor '{name}' is truncated: segment "
-                    f"[{off}, {off + nbytes}) exceeds payload size "
-                    f"{size} ('{payload_path}')", tensor=name)
-            if verify:
-                crc = 0
-                for c0 in range(off, off + nbytes, _CRC_CHUNK):
-                    c1 = min(c0 + _CRC_CHUNK, off + nbytes)
-                    crc = zlib.crc32(mm[c0:c1], crc)
-                if (crc & 0xFFFFFFFF) != int(t["crc32"]):
-                    _m_corrupt.inc()
-                    raise CheckpointCorruptError(
-                        f"tensor '{name}' failed its checksum "
-                        f"(crc {crc & 0xFFFFFFFF:#010x} != manifest "
-                        f"{int(t['crc32']):#010x}) — '{payload_path}' "
-                        "is corrupt", tensor=name)
-            dtype = np.dtype(str(t["dtype"]))
-            count = int(np.prod(t["shape"], dtype=np.int64)) \
-                if t["shape"] else 1
-            if count * dtype.itemsize != nbytes:
-                _m_corrupt.inc()
-                raise CheckpointCorruptError(
-                    f"tensor '{name}' declares shape {t['shape']} "
-                    f"({count} x {dtype}) but {nbytes} payload bytes",
-                    tensor=name)
-            arr = np.frombuffer(mm, dtype=dtype, count=count,
-                                offset=off).reshape(t["shape"])
-            out[name] = arr  # read-only view over the map: zero-copy
+            nbytes = int(t["nbytes"])
+            # read-only view over the map: zero-copy
+            out[name] = verified_segment(
+                mm, size, payload_path, name, int(t["offset"]), nbytes,
+                str(t["dtype"]), t["shape"], int(t["crc32"]), verify)
             read += nbytes
         if base_refs:
             base_rec = manifest.get("base")
@@ -555,12 +605,4 @@ def load_checkpoint_tree(dirname: str, verify: bool = True
     """Load and restore the nested tree structure (dicts/tuples/lists
     as saved). Returns ``(tree, manifest)``."""
     arrays, manifest = load_checkpoint_arrays(dirname, verify=verify)
-    skel = manifest.get("tree")
-    if skel is None:
-        return dict(arrays), manifest
-    try:
-        return _unflatten(skel, arrays), manifest
-    except KeyError as e:
-        raise CheckpointError(
-            f"manifest tree references tensor {e.args[0]!r} that the "
-            "tensor index does not declare") from e
+    return restore_tree(arrays, manifest), manifest

@@ -72,10 +72,13 @@ def decoder_artifact(spec: Optional[Dict[str, Any]] = None,
     (ISSUE 12 — the path must be readable on every replica host, same
     shared-storage assumption as model_artifact). Either alone works;
     both together cross-validate. Engine kwargs = slots/page_size/
-    num_pages/max_seq_len/max_queue/prefill_chunk — and the ISSUE 14
-    speculative trio draft_spec/draft_checkpoint_dir/spec_k — pass
-    through load_decoder, so a fleet intent deploys a drafted decoder
-    exactly like a plain one."""
+    num_pages/max_seq_len/max_queue/prefill_chunk — plus the ISSUE 14
+    speculative trio draft_spec/draft_checkpoint_dir/spec_k and the
+    ISSUE 15 ``mesh_axes`` (a mesh-sharded replica deploys through the
+    intent log like any other; a checkpoint that RECORDED its mesh
+    needs no kwarg at all) — pass through load_decoder, so a fleet
+    intent deploys a drafted or chip-spanning decoder exactly like a
+    plain one."""
     if spec is None and checkpoint_dir is None:
         raise ValueError(
             "decoder_artifact needs a spec dict or a checkpoint_dir")

@@ -215,6 +215,21 @@ FLAGS: Dict[str, Any] = _Flags({
     # autotune cache overrides per device kind (decode_bench's
     # measure-or-model session persists the measured winner)
     "spec_k": 0,
+    # SPMD mesh layer (paddle_tpu/mesh, ISSUE 15). Default TRAINING
+    # mesh: a ParallelExecutor built without an explicit mesh= parses
+    # this ("dp=2,tp=2,fsdp=2" — ordered named axes, sizes multiply to
+    # the device count) and trains sharded; '' = the plain all-devices
+    # dp mesh (bit-identical pre-mesh behavior). Pair with a
+    # ShardingRules plan (mesh.transformer_rules gives the dp x tp x
+    # fsdp layout for the flagship transformer)
+    "mesh_axes": "",
+    # default SERVING mesh for DecodeEngine/load_decoder: '' = single-
+    # chip (the PR 6 engine); an axes string makes one decode replica
+    # SPAN chips — params shard per mesh.decoder_rules and the paged KV
+    # pool shards over the kv-head axis. A checkpoint that RECORDS a
+    # mesh (save_decoder_checkpoint(mesh_axes=)) wins over this
+    # default; an explicit load_decoder(mesh_axes=) wins over both
+    "serving_mesh_axes": "",
     # serving fleet (paddle_tpu/fleet, ISSUE 11). Replica lease TTL in
     # seconds: a replica that misses heartbeats for this long is
     # evicted from the routing table (the pserver heartbeat/eviction

@@ -92,11 +92,33 @@ class ParallelExecutor:
         self._program = main_program or default_main_program()
         self._loss_name = loss_name
         if mesh is not None:
+            if not isinstance(mesh, Mesh):
+                # MeshSpec / axes dict / "dp=2,tp=4" string (ISSUE 15):
+                # the mesh layer's one coercion rule, built here
+                from ..mesh import MeshSpec
+
+                mesh = MeshSpec.coerce(mesh).build(devices=devices)
             self._mesh = mesh
         else:
-            devs = list(devices) if devices is not None else jax.devices()
-            self._mesh = Mesh(np.asarray(devs), ("dp",))
+            from .flags import FLAGS
+
+            if FLAGS["mesh_axes"]:
+                # operator-configured default mesh: a run that passes
+                # no mesh= still trains sharded per the flag
+                from ..mesh import MeshSpec
+
+                self._mesh = MeshSpec.parse(
+                    FLAGS["mesh_axes"]).build(devices=devices)
+            else:
+                devs = (list(devices) if devices is not None
+                        else jax.devices())
+                self._mesh = Mesh(np.asarray(devs), ("dp",))
         self._plan = sharding_plan or ShardingPlan(batch_axis=self._mesh.axis_names[0])
+        self._sharded = int(self._mesh.devices.size) > 1
+        if self._sharded:
+            from ..mesh import note_mesh
+
+            note_mesh(self._mesh, label="parallel_executor")
         self._scope = (
             share_vars_from._scope if share_vars_from is not None else global_scope()
         )
@@ -225,7 +247,7 @@ class ParallelExecutor:
             )
             entry = {"jfn": jfn, "ro": ro_names, "rw": rw_names,
                      "state_out": tuple(state_out), "compiled": None,
-                     "cost": None}
+                     "cost": None, "collectives": None}
             self._cache[cache_key] = entry
 
         jfn, ro_names, rw_names, state_out = (
@@ -251,28 +273,58 @@ class ParallelExecutor:
         # emitters that need explicit SPMD (ring attention) see the mesh
         # during tracing, which happens inside this first call
         t0 = _time.perf_counter()
+        collectives = None
         with mesh_context(mesh), _tracing.span(
                 "parallel_executor.step", devices=int(mesh.devices.size),
-                program_version=program._version):
-            if self._collect_cost:
+                program_version=program._version) as _step_span:
+            if self._collect_cost or self._sharded:
+                # AOT path: sharded runs always lower explicitly so the
+                # compiled program's COLLECTIVES can be counted exactly
+                # (mesh.collectives.* — the number a communication
+                # regression moves; wall clocks on a contended host
+                # cannot carry that evidence), collect_cost additionally
+                # records XLA's flop/byte analysis
                 if entry["compiled"] is None:
-                    from ..jax_compat import cost_analysis_dict
-
                     compiled = jfn.lower(
                         feed_arrays, state_ro, state_rw, seed).compile()
-                    ca = cost_analysis_dict(compiled)
+                    if self._sharded:
+                        # count from the COMPILED text: the SPMD
+                        # partitioner inserts collectives after
+                        # StableHLO, so the lowered form has none yet
+                        from ..mesh import note_sharded_compile
+
+                        try:
+                            hlo = compiled.as_text()
+                        except Exception:  # pragma: no cover - backend
+                            hlo = ""
+                        entry["collectives"] = note_sharded_compile(hlo)
                     entry["compiled"] = compiled
-                    entry["cost"] = {
-                        "flops": float(ca.get("flops", -1.0)),
-                        "bytes_accessed": float(
-                            ca.get("bytes accessed", -1.0)),
-                    }
+                    if self._collect_cost:
+                        from ..jax_compat import cost_analysis_dict
+
+                        ca = cost_analysis_dict(compiled)
+                        entry["cost"] = {
+                            "flops": float(ca.get("flops", -1.0)),
+                            "bytes_accessed": float(
+                                ca.get("bytes accessed", -1.0)),
+                        }
                 self.last_cost_analysis = entry["cost"]
+                collectives = entry["collectives"]
                 fetches, new_state = entry["compiled"](
                     feed_arrays, state_ro, state_rw, seed)
             else:
                 fetches, new_state = jfn(feed_arrays, state_ro, state_rw,
                                          seed)
+            if self._sharded:
+                from ..mesh import sharded_step_counter
+
+                sharded_step_counter().inc()
+                if collectives:
+                    # the span carries the compiled program's collective
+                    # census, so a trace shows what each step ships
+                    # over ICI without a device profiler
+                    _step_span.set_arg(
+                        "collectives", int(sum(collectives.values())))
         step_ms = (_time.perf_counter() - t0) * 1e3
         _m_pe_step_ms.observe(step_ms)
         if self._loss_name:  # a training step: includes the grad all-reduce

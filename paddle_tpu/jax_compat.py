@@ -19,6 +19,66 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
                       out_specs=out_specs, **{_CHECK_KW: check_vma})
 
 
+def make_device_mesh(axes, devices=None):
+    """Named-axis device Mesh construction, one place for any topology
+    skew (ISSUE 15). ``axes``: ordered {name: size}. Uses the first
+    prod(sizes) devices when more are available (tier-1's virtual
+    8-device CPU mesh frequently outnumbers a 2-way test mesh); on TPU
+    prefers ``mesh_utils.create_device_mesh`` for ICI-aware ordering,
+    off-TPU a plain reshape (virtual CPU devices have no topology).
+    Typed error when devices run short."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    names = tuple(str(n) for n in axes)
+    shape = tuple(int(axes[n]) for n in axes)
+    need = int(np.prod(shape))
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < need:
+        raise ValueError(
+            f"mesh {dict(zip(names, shape))} needs {need} devices, have "
+            f"{len(devs)} — off-TPU set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=N")
+    devs = devs[:need]
+    if devices is None and devs and devs[0].platform == "tpu":
+        try:  # ICI-topology-aware ordering where the backend knows one
+            from jax.experimental import mesh_utils
+
+            return Mesh(mesh_utils.create_device_mesh(shape), names)
+        except Exception:  # pragma: no cover - odd topologies fall back
+            pass
+    return Mesh(np.asarray(devs).reshape(shape), names)
+
+
+# collective HLO spellings as they appear in StableHLO / HLO text; the
+# keys are the counter suffixes mesh.observe registers
+_COLLECTIVE_OPS = (
+    ("all_reduce", ("all_reduce", "all-reduce")),
+    ("all_gather", ("all_gather", "all-gather")),
+    ("reduce_scatter", ("reduce_scatter", "reduce-scatter")),
+    ("collective_permute", ("collective_permute", "collective-permute")),
+    ("all_to_all", ("all_to_all", "all-to-all")),
+)
+
+
+def collective_counts(lowered_text: str) -> dict:
+    """Count collective ops in a lowered/compiled program's text — the
+    compile-time evidence of what the SPMD partitioner inserted (host
+    code cannot time individual device collectives; it CAN count them
+    exactly). Returns {kind: count} with zero entries elided."""
+    out = {}
+    for kind, spellings in _COLLECTIVE_OPS:
+        n = 0
+        for s in spellings:
+            n += lowered_text.count(f"stablehlo.{s} ") + \
+                lowered_text.count(f"stablehlo.{s}(")
+            n += lowered_text.count(f" {s}(")  # HLO text form
+        if n:
+            out[kind] = n
+    return out
+
+
 def cost_analysis_dict(stage) -> dict:
     """Normalize `.cost_analysis()` across jax versions and stage kinds.
 

@@ -1,0 +1,53 @@
+"""First-class SPMD mesh layer (ISSUE 15): named logical device axes
+and name-based parameter sharding as ONE serializable object pair.
+
+The reference framework's multi-device story is replicate-and-allreduce
+(`paddle/fluid/framework/parallel_executor.cc`); the TPU-native story is
+a named device mesh (``dp`` x ``tp`` x ``fsdp``) plus PartitionSpec
+rules over parameter NAMES, with XLA's SPMD partitioner inserting the
+ICI collectives. This package owns that layer end to end:
+
+  - ``MeshSpec``: named logical axes -> a jax device Mesh, parse/build/
+    serialize (``"dp=2,tp=2,fsdp=2"`` <-> dict <-> Mesh), so a TRAINED
+    sharding travels with its artifact (checkpoint meta, serving
+    deploys, fleet intents) instead of living in whoever's head built
+    the run;
+  - ``ShardingRules``: ordered regex -> PartitionSpec assignment over
+    var/param names, speaking the same plan protocol ParallelExecutor
+    already consumes (``spec_for``/``feed_spec``/``batch_axis``) AND
+    JSON round-tripping for export;
+  - stock rule sets: ``transformer_rules()`` (dp x tp x fsdp training
+    over the fluid transformer's param names), ``decoder_rules()``
+    (tensor-parallel serving over the DecoderSpec param tree — KV
+    projections shard the kv-head axis, so the paged KV pool shards
+    with them);
+  - observability (``observe.py``): mesh gauges, per-collective-kind
+    compile counters, the ``/statusz`` mesh section.
+
+Downstream: ParallelExecutor accepts a MeshSpec (or FLAGS['mesh_axes'])
+and ShardingRules directly; DecodeEngine/load_decoder load mesh-sharded
+decoders with the KV pool sharded over the kv-head axis; checkpoint/
+sharded.py persists one payload per mesh shard with a merged manifest.
+"""
+from .spec import (  # noqa: F401
+    MeshSpec,
+    ShardingRules,
+    decoder_rules,
+    flatten_param_names,
+    shard_param_tree,
+    transformer_rules,
+)
+from .observe import (  # noqa: F401
+    collective_counts,
+    mesh_status,
+    note_mesh,
+    note_sharded_compile,
+    sharded_step_counter,
+)
+
+__all__ = [
+    "MeshSpec", "ShardingRules", "transformer_rules", "decoder_rules",
+    "flatten_param_names", "shard_param_tree",
+    "collective_counts", "note_mesh", "note_sharded_compile",
+    "mesh_status",
+]

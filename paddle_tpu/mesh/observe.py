@@ -1,0 +1,131 @@
+"""Mesh observability (ISSUE 15 satellite): gauges for the active mesh,
+per-collective-kind compile counters, and the ``/statusz`` mesh section.
+
+Host code cannot time individual device collectives — XLA fuses them
+into the step program — but it CAN count them exactly at compile time
+(``jax_compat.collective_counts`` over the lowered text) and carry the
+counts on the step span. So the observability contract is:
+
+  - ``mesh.devices`` / ``mesh.axes`` gauges describe the active mesh
+    (device count / axis count), per-axis sizes ride the f-string
+    family ``mesh.axis.<name>`` (the fleet ``fleet.replica_up.<rid>``
+    discipline);
+  - ``mesh.collectives.<kind>`` counters accumulate per COMPILED
+    sharded executable — a communication regression (an extra
+    all-gather from a changed spec) moves a counter, not just a wall
+    clock;
+  - ``mesh.sharded_steps`` counts sharded step dispatches, and the
+    executor's step span carries ``collectives=`` so traces show what
+    each program shipped over ICI;
+  - ``/statusz`` grows a ``mesh`` section (axes, device count, compile
+    collective totals) via the process-shared debug server.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ..observability import debug_server as _debug
+from ..observability import metrics as _metrics
+
+__all__ = ["note_mesh", "note_sharded_compile", "collective_counts",
+           "mesh_status", "sharded_step_counter"]
+
+# re-exported so callers needing the counting rule import ONE module
+from ..jax_compat import collective_counts  # noqa: E402  (re-export)
+
+_m_devices = _metrics.gauge("mesh.devices")
+_m_axes = _metrics.gauge("mesh.axes")
+_m_sharded_steps = _metrics.counter("mesh.sharded_steps")
+_m_sharded_compiles = _metrics.counter("mesh.sharded_compiles")
+# one counter per collective kind the partitioner can insert; names
+# must match jax_compat._COLLECTIVE_OPS keys
+_m_collectives = {
+    kind: _metrics.counter(f"mesh.collectives.{kind}")
+    for kind in ("all_reduce", "all_gather", "reduce_scatter",
+                 "collective_permute", "all_to_all")
+}
+
+
+def sharded_step_counter():
+    """The ``mesh.sharded_steps`` counter (executors inc it per sharded
+    dispatch; tests read it)."""
+    return _m_sharded_steps
+
+
+class _MeshStats:
+    """Process-wide record of active meshes for /statusz — written by
+    ``note_mesh``/``note_sharded_compile`` from whatever thread builds
+    or compiles (executor callers, serving scheduler), read by the
+    debug server's scrape thread."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._meshes: Dict[str, Dict[str, Any]] = {}  # guarded-by: _mu
+        self._collective_totals: Dict[str, int] = {}  # guarded-by: _mu
+
+    def note_mesh(self, label: str, axes: Dict[str, int]):
+        with self._mu:
+            self._meshes[str(label)] = dict(axes)
+        # (re-)register every time: add_status no-ops without a shared
+        # debug server, and the server may attach AFTER the first mesh
+        # was built — idempotent dict set either way
+        _debug.add_status("mesh", self.status)
+
+    def note_collectives(self, counts: Dict[str, int]):
+        with self._mu:
+            for k, v in counts.items():
+                self._collective_totals[k] = \
+                    self._collective_totals.get(k, 0) + int(v)
+
+    def status(self) -> Dict[str, Any]:
+        with self._mu:
+            meshes = {k: dict(v) for k, v in self._meshes.items()}
+            totals = dict(self._collective_totals)
+        return {
+            "meshes": meshes,
+            "collectives_compiled": totals,
+            "sharded_steps": _m_sharded_steps.value(),
+            "sharded_compiles": _m_sharded_compiles.value(),
+        }
+
+
+_stats = _MeshStats()
+
+
+def note_mesh(mesh, label: str = "default") -> None:
+    """Record an ACTIVE mesh: sets the ``mesh.devices``/``mesh.axes``
+    gauges and the per-axis ``mesh.axis.<name>`` family, and registers
+    the /statusz section on first use. ``mesh`` is a built jax Mesh (or
+    anything with ``axis_names`` + ``devices``)."""
+    axes = dict(zip(mesh.axis_names,
+                    (int(s) for s in mesh.devices.shape)))
+    _m_devices.set(int(mesh.devices.size))
+    _m_axes.set(len(axes))
+    for name, size in axes.items():
+        _metrics.gauge(f"mesh.axis.{name}").set(size)
+    _stats.note_mesh(label, axes)
+
+
+def note_sharded_compile(lowered_text: str,
+                         counts: Optional[Dict[str, int]] = None
+                         ) -> Dict[str, int]:
+    """Account one freshly COMPILED sharded executable: count its
+    collectives (or take pre-counted ``counts``), bump the
+    ``mesh.collectives.*`` counters and ``mesh.sharded_compiles``, and
+    return the counts so the caller can stamp its step span."""
+    if counts is None:
+        counts = collective_counts(lowered_text)
+    _m_sharded_compiles.inc()
+    for kind, n in counts.items():
+        c = _m_collectives.get(kind)
+        if c is not None:
+            c.inc(int(n))
+    _stats.note_collectives(counts)
+    return counts
+
+
+def mesh_status() -> Dict[str, Any]:
+    """The /statusz ``mesh`` section payload (also directly callable —
+    selftests and tests read it without an HTTP round trip)."""
+    return _stats.status()

@@ -26,6 +26,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from ..jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
@@ -76,14 +77,16 @@ def _causal_mask(my, src, sq, sk):
     return (qpos[:, None] >= kpos[None, :])[None, None]  # [1,1,Sq,Sk]
 
 
-def _axis_info(axis_name):
+def _axis_size(axis_name) -> int:
+    """Static axis size: lax.psum of a concrete 1 constant-folds to the
+    axis size at trace time — no collective, no device code."""
     if axis_name is None:
-        return 1, 0
-    return lax.psum(1, axis_name), lax.axis_index(axis_name)
+        return 1
+    return lax.psum(1, axis_name)
 
 
-def _ring_fwd_pass(q, k, v, axis_name, causal, scale):
-    n, my = _axis_info(axis_name)
+def _ring_fwd_pass(q, k, v, my, axis_name, causal, scale):
+    n = _axis_size(axis_name)
     b, sq, h, d = q.shape
     sk = k.shape[1]
     q32 = q.astype(jnp.float32)
@@ -117,27 +120,48 @@ def _ring_fwd_pass(q, k, v, axis_name, causal, scale):
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def ring_attention_shard(q, k, v, axis_name=None, causal=False,
-                         scale: Optional[float] = None):
-    """Per-shard ring attention. q: [B, Sq_local, H, D]; k/v: [B, Sk_local,
-    H, D], sequence-sharded over `axis_name` (None = single chunk, plain
-    flash attention). Softmax in f32; output in q.dtype."""
-    scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
-    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _ring_attention_impl(q, k, v, my_idx, axis_name, causal, scale):
+    """custom_vjp core: ``my_idx`` is an int32[1] array carrying THIS
+    shard's ring position. It is a primal input (zero float0 cotangent)
+    rather than ``lax.axis_index(axis_name)`` because axis_index lowers
+    to the ``partition-id`` HLO, which jax-0.4.37's CPU SPMD partitioner
+    rejects whenever jaxpr DCE leaves it alive ("PartitionId instruction
+    is not supported for SPMD partitioning") — the skew that aborted the
+    dryrun's ring phases. A sharded-iota input says the same thing in
+    data, which every backend partitions."""
+    out, _ = _ring_fwd_pass(q, k, v, my_idx[0], axis_name, causal, scale)
     return out
 
 
-def _ring_fwd_rule(q, k, v, axis_name, causal, scale):
+def ring_attention_shard(q, k, v, axis_name=None, causal=False,
+                         scale: Optional[float] = None, my_idx=None):
+    """Per-shard ring attention. q: [B, Sq_local, H, D]; k/v: [B, Sk_local,
+    H, D], sequence-sharded over `axis_name` (None = single chunk, plain
+    flash attention). Softmax in f32; output in q.dtype.
+
+    ``my_idx`` (int32[1]): this shard's ring position, normally threaded
+    in by ``sequence_parallel_attention`` as a P(seq_axis)-sharded iota.
+    Direct shard_map users on newer jax may omit it (falls back to
+    ``lax.axis_index`` — fine there, but that path lowers to the
+    partition-id HLO the 0.4.x CPU partitioner rejects)."""
     scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
-    out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, scale)
-    return out, (q, k, v, out, lse)
+    if my_idx is None:
+        if axis_name is None:
+            my_idx = jnp.zeros((1,), jnp.int32)
+        else:
+            my_idx = lax.axis_index(axis_name).reshape(1).astype(jnp.int32)
+    return _ring_attention_impl(q, k, v, my_idx, axis_name, causal, scale)
+
+
+def _ring_fwd_rule(q, k, v, my_idx, axis_name, causal, scale):
+    out, lse = _ring_fwd_pass(q, k, v, my_idx[0], axis_name, causal, scale)
+    return out, (q, k, v, my_idx, out, lse)
 
 
 def _ring_bwd_rule(axis_name, causal, scale, res, dout):
-    q, k, v, out, lse = res
-    scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
-    n, my = _axis_info(axis_name)
+    q, k, v, my_idx, out, lse = res
+    n, my = _axis_size(axis_name), my_idx[0]
     b, sq, h, d = q.shape
     sk = k.shape[1]
     q32 = q.astype(jnp.float32)
@@ -178,17 +202,19 @@ def _ring_bwd_rule(axis_name, causal, scale, res, dout):
     (dq, dk, dv, _, _), _ = lax.scan(
         step, (dq0, dk0, dv0, k, v), jnp.arange(n)
     )
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    # my_idx is an integer primal: its cotangent is the float0 zero
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            np.zeros(np.shape(my_idx), jax.dtypes.float0))
 
 
-ring_attention_shard.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+_ring_attention_impl.defvjp(_ring_fwd_rule, _ring_bwd_rule)
 
 
 def ulysses_attention_shard(q, k, v, axis_name, causal=False,
                             scale: Optional[float] = None):
     """Per-shard Ulysses attention: all_to_all heads<->sequence, then full
     attention on a head subset. Requires H %% axis_size == 0."""
-    n, _ = _axis_info(axis_name)
+    n = _axis_size(axis_name)
     if n > 1:
         if q.shape[2] % n:
             raise ValueError(
@@ -214,15 +240,24 @@ def sequence_parallel_attention(
     `batch_axis`, heads over `head_axis` when given) and attention runs
     SPMD via shard_map."""
     if impl == "ring":
-        body = functools.partial(ring_attention_shard, axis_name=seq_axis,
-                                 causal=causal, scale=scale)
+        def body(qs, ks, vs, idx):
+            return ring_attention_shard(qs, ks, vs, seq_axis, causal,
+                                        scale, my_idx=idx)
     elif impl == "ulysses":
-        body = functools.partial(ulysses_attention_shard, axis_name=seq_axis,
-                                 causal=causal, scale=scale)
+        def body(qs, ks, vs, idx):
+            del idx  # ulysses needs only the axis SIZE, never the index
+            return ulysses_attention_shard(qs, ks, vs, seq_axis,
+                                           causal=causal, scale=scale)
     else:
         raise ValueError(f"unknown sequence-parallel impl '{impl}'")
     spec = P(batch_axis, seq_axis, head_axis, None)
-    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    # the ring index rides in as DATA: a P(seq_axis)-sharded iota hands
+    # each shard its own position, so the body never calls
+    # lax.axis_index (whose partition-id lowering the jax-0.4.x CPU
+    # SPMD partitioner rejects — the `PartitionId` dryrun skew)
+    n_sp = dict(zip(mesh.axis_names, mesh.devices.shape))[seq_axis]
+    ring_idx = jnp.arange(n_sp, dtype=jnp.int32)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec, P(seq_axis)),
                    out_specs=spec, check_vma=False)
     # Pin the boundary shardings explicitly. Under GSPMD the producers
     # (e.g. tp column-parallel qkv projections) already carry compatible
@@ -232,5 +267,7 @@ def sequence_parallel_attention(
     # and falling back to full rematerialization (spmd_partitioner.cc:652).
     cons = lambda x: jax.lax.with_sharding_constraint(
         x, jax.sharding.NamedSharding(mesh, spec))
-    out = fn(cons(q), cons(k), cons(v))
+    idx = jax.lax.with_sharding_constraint(
+        ring_idx, jax.sharding.NamedSharding(mesh, P(seq_axis)))
+    out = fn(cons(q), cons(k), cons(v), idx)
     return cons(out)

@@ -216,7 +216,8 @@ class ServingClient:
                      reservation: Optional[str] = None,
                      draft_spec: Optional[Dict[str, Any]] = None,
                      draft_checkpoint_dir: Optional[str] = None,
-                     spec_k: Optional[int] = None
+                     spec_k: Optional[int] = None,
+                     mesh_axes: Optional[str] = None
                      ) -> Dict[str, Any]:
         """Deploy a DecodeEngine; hot-swaps like load_model. From a
         ``spec`` dict (see serving.decode.DecoderSpec) the server
@@ -234,7 +235,11 @@ class ServingClient:
         them in one chunked step; output stays bitwise-equal to
         non-speculative decode). spec_k=None defers to the server's
         autotune cache/FLAGS; a vocab/eos-mismatched draft is refused
-        typed at load."""
+        typed at load. ``mesh_axes`` (ISSUE 15, e.g. "tp=2") makes the
+        replica SPAN chips — params shard per the decoder rules and the
+        paged KV pool shards over the kv-head axis; '' pins single-chip
+        even when the checkpoint recorded a mesh, None defers to the
+        checkpoint's recording, then the server's FLAGS."""
         try:
             return self._rpc.call(
                 "load_decoder", model,
@@ -248,7 +253,8 @@ class ServingClient:
                 None if draft_spec is None else dict(draft_spec),
                 (None if draft_checkpoint_dir is None
                  else str(draft_checkpoint_dir)),
-                None if spec_k is None else int(spec_k))
+                None if spec_k is None else int(spec_k),
+                None if mesh_axes is None else str(mesh_axes))
         except RuntimeError as e:
             _raise_typed(e)
 

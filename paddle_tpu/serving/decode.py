@@ -549,16 +549,48 @@ class DecodeEngine:
                  draft_spec: Optional[Any] = None,
                  draft_params: Optional[Dict[str, Any]] = None,
                  spec_k: Optional[int] = None,
+                 mesh: Optional[Any] = None,
+                 mesh_rules: Optional[Any] = None,
                  warm: bool = True):
         from ..fluid.flags import FLAGS, effective_flag
 
         self.name = str(name)
         self.version = int(version)
         self.spec = spec
+        # mesh-sharded serving (ISSUE 15): one replica SPANS chips.
+        # `mesh` is a MeshSpec / axes dict / "tp=2" string (None reads
+        # FLAGS['serving_mesh_axes']; '' = single-chip, bit-identical
+        # PR 6 behavior). Params shard per name-matched `mesh_rules`
+        # (default mesh.decoder_rules) and the paged KV pool shards
+        # over the kv-head axis — the axis the wk/wv rules put on their
+        # column dim — with the step fns' out_shardings pinned so churn
+        # still compiles nothing post-warm.
+        mesh_arg = FLAGS["serving_mesh_axes"] if mesh is None else mesh
+        self._mesh_spec = None
+        self._mesh = None
+        self._mesh_rules = None
+        self._kv_head_axes = None
+        if mesh_arg:
+            from ..mesh import (MeshSpec, ShardingRules, decoder_rules,
+                                note_mesh)
+
+            self._mesh_spec = MeshSpec.coerce(mesh_arg)
+            self._mesh = self._mesh_spec.build()
+            rules = ShardingRules.coerce(mesh_rules,
+                                         default=decoder_rules)
+            self._mesh_rules = rules
+            self._kv_head_axes = self._kv_pool_axes(rules)
+            self._check_kv_divisible("target", spec)
+            note_mesh(self._mesh, label=f"decode:{name}.v{version}")
         # shares _step_mu with the compiled step + shape set: the lock
         # serializes every read-step-rebind against retirement's drop
         self._params = (build_decoder_params(spec)
                         if params is None else params)  # guarded-by: _step_mu
+        if self._mesh is not None:
+            from ..mesh import shard_param_tree
+
+            self._params = shard_param_tree(self._params, self._mesh,
+                                            self._mesh_rules)
         # slots="auto" resolves through the tuner exactly like the
         # one-shot engine's buckets="auto": a derived ladder from the
         # observed slot-demand histogram (or the cached one), else the
@@ -598,7 +630,8 @@ class DecodeEngine:
             spec.n_layers, spec.n_kv_heads, spec.head_dim,
             page_size=ps, num_pages=npages,
             label=f"{self.name}.v{self.version}",
-            prefix_cache=self._prefix_on)
+            prefix_cache=self._prefix_on,
+            mesh=self._mesh, shard_spec=self._pool_spec())
         # host refuge for preempted sequences' pages (kv_spill_dir
         # moves it to disk); cleared at retirement — leaks nothing
         self._spill = HostSpillStore(
@@ -648,6 +681,8 @@ class DecodeEngine:
                 "server)")
         if draft_spec is not None:
             validate_draft_spec(spec, draft_spec)
+            if self._mesh is not None:
+                self._check_kv_divisible("draft", draft_spec)
         if draft_spec is None:
             k_spec = 0
         # the verify chunk writes through pos + k: never past the
@@ -665,10 +700,17 @@ class DecodeEngine:
                 build_decoder_params(draft_spec)
                 if draft_params is None
                 else draft_params)  # guarded-by: _step_mu
+            if self._mesh is not None:
+                from ..mesh import shard_param_tree
+
+                self._draft_params = shard_param_tree(
+                    self._draft_params, self._mesh, self._mesh_rules)
             self._draft_cache = PagedKvCache(
                 draft_spec.n_layers, draft_spec.n_kv_heads,
                 draft_spec.head_dim, page_size=ps, num_pages=npages,
-                allocator=self.cache.allocator)  # guarded-by: _step_mu
+                allocator=self.cache.allocator,
+                mesh=self._mesh,
+                shard_spec=self._pool_spec())  # guarded-by: _step_mu
         else:
             self._verify_lanes = 0
             self._draft_chunk_ladder = []
@@ -706,9 +748,26 @@ class DecodeEngine:
         donate = (bool(FLAGS["donate_state"])
                   and jax.default_backend() == "tpu")
         self._donate = donate
+        step_out_shardings = None
+        if self._mesh is not None:
+            # pin the step outputs: pools keep the kv-head sharding they
+            # came in with, logits come back replicated (the scheduler
+            # samples host-side). Without the pin GSPMD may choose a
+            # different output layout per shape and the next step's
+            # input sharding drift would mint a post-warm compile.
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as _P
+
+            pool_sh = NamedSharding(self._mesh, self._pool_spec())
+            step_out_shardings = (pool_sh, pool_sh,
+                                  NamedSharding(self._mesh, _P()))
+        self._step_out_shardings = step_out_shardings
         self._step_fn = jax.jit(
             _step,
-            donate_argnums=(4, 5) if donate else ())  # guarded-by: _step_mu
+            donate_argnums=(4, 5) if donate else (),
+            **({"out_shardings": step_out_shardings}
+               if step_out_shardings is not None
+               else {}))  # guarded-by: _step_mu
         if self._spec_k:
             draft_ref = self._draft_spec
 
@@ -725,14 +784,16 @@ class DecodeEngine:
                                             positions, q_lens, k_pool,
                                             v_pool, tables, lens)
 
+            _sharded_kw = ({"out_shardings": step_out_shardings}
+                           if step_out_shardings is not None else {})
             self._verify_fn = jax.jit(
                 _verify,
                 donate_argnums=(4, 5) if donate
-                else ())  # guarded-by: _step_mu
+                else (), **_sharded_kw)  # guarded-by: _step_mu
             self._draft_fn = jax.jit(
                 _draft,
                 donate_argnums=(4, 5) if donate
-                else ())  # guarded-by: _step_mu
+                else (), **_sharded_kw)  # guarded-by: _step_mu
         else:
             self._verify_fn = None  # guarded-by: _step_mu
             self._draft_fn = None  # guarded-by: _step_mu
@@ -780,6 +841,63 @@ class DecodeEngine:
     @property
     def draft_spec(self) -> Optional[DecoderSpec]:
         return self._draft_spec
+
+    @property
+    def mesh_spec(self):
+        """The MeshSpec this engine spans (None = single-chip)."""
+        return self._mesh_spec
+
+    @staticmethod
+    def _kv_pool_axes(rules):
+        """The mesh axes sharding the KV-HEAD dim of the paged pool:
+        whatever the rules put on the COLUMN dim of the K projection
+        (wk's columns reshape to [kv_heads, head_dim], so a tp-sharded
+        wk writes tp-sharded kv heads — the pool must shard the same
+        way or every step pays a reshard)."""
+        spec = tuple(rules.spec_for("layer0/wk", 2))
+        entry = spec[1] if len(spec) > 1 else None
+        if entry is None:
+            return None
+        return entry if isinstance(entry, tuple) else (str(entry),)
+
+    def _kv_shard_degree(self) -> int:
+        if not self._kv_head_axes:
+            return 1
+        import numpy as _np
+
+        for a in self._kv_head_axes:
+            # typed here: axis_size would KeyError from deep inside
+            # construction, breaking the ValueError discipline every
+            # other load_decoder misconfiguration follows
+            if a not in self._mesh_spec:
+                raise ValueError(
+                    f"decoder rules shard kv heads over axis {a!r}, "
+                    f"which mesh {self._mesh_spec} does not have — add "
+                    "the axis or pass matching mesh_rules")
+        return int(_np.prod([self._mesh_spec.axis_size(a)
+                             for a in self._kv_head_axes]))
+
+    def _check_kv_divisible(self, what: str, spec: DecoderSpec):
+        deg = self._kv_shard_degree()
+        if deg > 1 and spec.n_kv_heads % deg:
+            raise ValueError(
+                f"{what} decoder has {spec.n_kv_heads} kv heads, not "
+                f"divisible by the mesh kv-head shard degree {deg} "
+                f"(axes {self._kv_head_axes} of {self._mesh_spec}) — "
+                "resize the mesh or the model's kv heads")
+
+    def _pool_spec(self):
+        """PartitionSpec of the paged pools ([layers, pages, page_size,
+        kv_heads, head_dim] — kv-head axis sharded, the rest
+        replicated); None when unsharded."""
+        if self._mesh is None:
+            return None
+        import jax.sharding as _shd
+
+        ax = self._kv_head_axes
+        return _shd.PartitionSpec(
+            None, None, None,
+            (ax if ax is None or len(ax) > 1 else ax[0]), None)
 
     def warm(self):
         """Pre-compile EVERY (slot-count, table-width, chunk) triple on
@@ -1071,6 +1189,8 @@ class DecodeEngine:
                 "continuous": self._continuous,
                 "reservation": self._reservation,
                 "spec_k": self._spec_k,
+                "mesh": (dict(self._mesh_spec.axes)
+                         if self._mesh_spec is not None else None),
                 "draft": (self._draft_spec.to_dict()
                           if self._draft_spec is not None else None),
                 "prefix_cache": self._prefix_on,

@@ -893,7 +893,8 @@ class PagedKvCache:
     def __init__(self, num_layers: int, num_kv_heads: int, head_dim: int,
                  *, page_size: int, num_pages: int, dtype=None,
                  label: Optional[str] = None, prefix_cache: bool = False,
-                 allocator: Optional[PageAllocator] = None):
+                 allocator: Optional[PageAllocator] = None,
+                 mesh=None, shard_spec=None):
         import jax.numpy as jnp
 
         self.num_layers = int(num_layers)
@@ -921,6 +922,20 @@ class PagedKvCache:
                  self.num_kv_heads, self.head_dim)
         self.k = jnp.zeros(shape, self.dtype)
         self.v = jnp.zeros(shape, self.dtype)
+        # mesh-sharded pools (ISSUE 15): one decode replica spans chips
+        # with the pool sharded over the kv-head axis — hbm_bytes stays
+        # the GLOBAL budget, each chip holds 1/|axis| of it. `sharding`
+        # is the pinned NamedSharding every rebind conforms to, so a
+        # page-move helper's output can never drift the step's input
+        # sharding (which would mint a post-warm compile).
+        self.sharding = None
+        if mesh is not None and shard_spec is not None:
+            import jax
+            from jax.sharding import NamedSharding
+
+            self.sharding = NamedSharding(mesh, shard_spec)
+            self.k = jax.device_put(self.k, self.sharding)
+            self.v = jax.device_put(self.v, self.sharding)
 
     @property
     def page_size(self) -> int:
@@ -943,6 +958,18 @@ class PagedKvCache:
             raise ValueError(
                 f"decode step changed the pool shape: "
                 f"{tuple(self.k.shape)} -> {tuple(k.shape)}")
+        if self.sharding is not None:
+            # conform to the pinned sharding: the decode steps already
+            # come back pinned (out_shardings), but the jitted page-move
+            # helpers let GSPMD choose — a drifted pool would change the
+            # next step's input sharding and mint a post-warm compile.
+            # device_put to an identical sharding is a no-op.
+            import jax
+
+            if getattr(k, "sharding", None) != self.sharding:
+                k = jax.device_put(k, self.sharding)
+            if getattr(v, "sharding", None) != self.sharding:
+                v = jax.device_put(v, self.sharding)
         self.k = k
         self.v = v
 

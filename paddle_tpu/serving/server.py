@@ -431,18 +431,22 @@ class ServingServer:
     @staticmethod
     def _resolve_decoder_artifact(what: str, spec, checkpoint_dir):
         """One rule for (spec dict, checkpoint_dir) -> (DecoderSpec,
-        params), shared by the target and the speculative draft
-        (ISSUE 14): a checkpoint loads real weights and its saved spec,
-        a bare spec builds the deterministic seed decoder, and giving
-        both cross-validates — a contradiction is a wrong-model deploy,
-        refused before any compile."""
+        params, mesh_meta), shared by the target and the speculative
+        draft (ISSUE 14): a checkpoint loads real weights and its saved
+        spec, a bare spec builds the deterministic seed decoder, and
+        giving both cross-validates — a contradiction is a wrong-model
+        deploy, refused before any compile. ``mesh_meta`` is the mesh
+        the checkpoint RECORDED at export (ISSUE 15; None for
+        single-chip artifacts or bare specs)."""
         from .decode import DecoderSpec
 
         if checkpoint_dir is not None:
-            from ..checkpoint import load_decoder_checkpoint
+            from ..checkpoint import (decoder_checkpoint_mesh,
+                                      load_decoder_checkpoint)
 
             use_spec, params = load_decoder_checkpoint(
                 str(checkpoint_dir))
+            mesh_meta = decoder_checkpoint_mesh(str(checkpoint_dir))
             if spec is not None:
                 want = DecoderSpec.from_dict(dict(spec))
                 if want.to_dict() != use_spec.to_dict():
@@ -450,10 +454,10 @@ class ServingServer:
                         f"{what} spec given to load_decoder contradicts "
                         f"checkpoint '{checkpoint_dir}': "
                         f"{want.to_dict()} != {use_spec.to_dict()}")
-            return use_spec, params
+            return use_spec, params, mesh_meta
         if spec is None:
-            return None, None
-        return DecoderSpec.from_dict(dict(spec)), None
+            return None, None, None
+        return DecoderSpec.from_dict(dict(spec)), None, None
 
     def _load_decoder(self, model: str,
                       spec: Optional[Dict[str, Any]] = None,
@@ -469,7 +473,8 @@ class ServingServer:
                       reservation: Optional[str] = None,
                       draft_spec: Optional[Dict[str, Any]] = None,
                       draft_checkpoint_dir: Optional[str] = None,
-                      spec_k: Optional[int] = None
+                      spec_k: Optional[int] = None,
+                      mesh_axes: Optional[str] = None
                       ) -> Dict[str, Any]:
         """Build + warm (every slot/width shape) + atomically install a
         DecodeEngine. ``checkpoint_dir`` loads REAL weights (and the
@@ -488,13 +493,26 @@ class ServingServer:
         from .decode import DecodeEngine
 
         model = str(model)
-        use_spec, params = self._resolve_decoder_artifact(
+        use_spec, params, ckpt_mesh = self._resolve_decoder_artifact(
             "target", spec, checkpoint_dir)
         if use_spec is None:
             raise ValueError(
                 "load_decoder needs a spec dict or a checkpoint_dir")
-        use_draft, draft_params = self._resolve_decoder_artifact(
+        use_draft, draft_params, _ = self._resolve_decoder_artifact(
             "draft", draft_spec, draft_checkpoint_dir)
+        # mesh resolution (ISSUE 15): explicit mesh_axes wins ('' pins
+        # single-chip), else the mesh the checkpoint RECORDED at
+        # export, else None = the engine's FLAGS['serving_mesh_axes']
+        # default
+        mesh_arg: Optional[Any] = None
+        mesh_rules_arg: Optional[Any] = None
+        if mesh_axes is not None:
+            mesh_arg = str(mesh_axes)
+        elif ckpt_mesh is not None:
+            from ..mesh import MeshSpec
+
+            mesh_arg = MeshSpec.from_dict(ckpt_mesh["spec"])
+            mesh_rules_arg = ckpt_mesh.get("rules")
         # lint: allow-blocking — deploys serialize end-to-end; see
         # _load_mu above. generate/infer traffic never takes this lock.
         with self._load_mu:
@@ -512,7 +530,8 @@ class ServingServer:
                     reservation=(None if reservation is None
                                  else str(reservation)),
                     draft_spec=use_draft, draft_params=draft_params,
-                    spec_k=(None if spec_k is None else int(spec_k)))
+                    spec_k=(None if spec_k is None else int(spec_k)),
+                    mesh=mesh_arg, mesh_rules=mesh_rules_arg)
 
             engine = self._registry.deploy(model, build)
             return engine.stats()
@@ -587,6 +606,11 @@ class ServingServer:
                 # (0 = off) — lets operators see which replicas carry a
                 # draft after a partial rollout
                 entry["spec_k"] = st.get("spec_k", 0)
+                # mesh-sharded replica (ISSUE 15): the axes this one
+                # engine SPANS — operators and the fleet see which
+                # replicas are multi-chip after a partial rollout
+                if st.get("mesh"):
+                    entry["mesh"] = st["mesh"]
                 # prefix-cache warmth (ISSUE 13): the MRU depth-1
                 # chain digests let a FleetRouter recognize a replica
                 # whose cache already covers a request's prefix —

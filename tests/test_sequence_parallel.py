@@ -188,13 +188,10 @@ def test_ring_attention_layer_parallel_executor():
     np.testing.assert_allclose(ref_loss, sp_loss, atol=1e-5)
 
 
-@pytest.mark.skipif(
-    tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5),
-    reason="jax 0.4.x SPMD partitioner rejects the ring-attention "
-           "shard_map under jit: 'PartitionId instruction is not "
-           "supported for SPMD partitioning'",
-)
 def test_transformer_seq_parallel_trains():
+    # un-gated: the ring shard index now rides in as a P(sp)-sharded
+    # iota input instead of lax.axis_index, so no partition-id HLO
+    # reaches the jax-0.4.x CPU SPMD partitioner (PR 14 shim)
     """Flagship model with seq_parallel=True on a dp x sp mesh: loss
     decreases over steps (capability: long-context sharded attention)."""
     import paddle_tpu.fluid as fluid

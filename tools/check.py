@@ -36,7 +36,11 @@ locally" and "works in CI" are the same claim:
                                                    rollout, decode-aware
                                                    routing, cluster-wide
                                                    shed, failover)
-  7. `python -m pytest tests/ --collect-only -q`  (imports every test
+  7. `python -m paddle_tpu.mesh --selftest`       (SPMD mesh layer:
+                                                   spec/rules, sharded
+                                                   train parity, sharded
+                                                   decode + checkpoint)
+  8. `python -m pytest tests/ --collect-only -q`  (imports every test
                                                    module under
                                                    --strict-markers: a
                                                    bad import or an
@@ -99,6 +103,8 @@ def main(argv=None) -> int:
                [py, "-m", "paddle_tpu.checkpoint", "--selftest"])
     rc |= _run("fleet selftest",
                [py, "-m", "paddle_tpu.fleet", "--selftest"])
+    rc |= _run("mesh selftest",
+               [py, "-m", "paddle_tpu.mesh", "--selftest"])
     rc |= _run("pytest collect smoke",
                [py, "-m", "pytest", "tests/", "--collect-only", "-q",
                 "-p", "no:cacheprovider"])
