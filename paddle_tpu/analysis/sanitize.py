@@ -64,6 +64,9 @@ _RUNTIME_CLASSES: Tuple[Tuple[str, str], ...] = (
     ("paddle_tpu.fleet.controller", "FleetController"),
     ("paddle_tpu.fleet.router", "FleetRouter"),
     ("paddle_tpu.fleet.member", "FleetMember"),
+    ("paddle_tpu.fleet.policy", "FleetPolicy"),
+    ("paddle_tpu.fleet.launcher", "ReplicaLauncher"),
+    ("paddle_tpu.fleet.auth", "NonceWindow"),
     ("paddle_tpu.checkpoint.format", "CheckpointWriter"),
     ("paddle_tpu.mesh.observe", "_MeshStats"),
 )

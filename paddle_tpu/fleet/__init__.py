@@ -3,28 +3,46 @@ service — the ROADMAP's "millions of users" layer.
 
     FleetController  replica membership (TTL leases, heartbeat/eviction,
                      rejoin) + the replicated model-deploy intent log
+                     (compacted below the fleet-wide applied watermark)
+                     + the scale-intent channel
     FleetMember      joins one ServingServer to a fleet: registers,
-                     beats, converges the model set to the intent log
+                     beats (piggybacking a load summary), converges the
+                     model set to the intent log — re-verifying intent
+                     signatures and the path allowlist before applying
     FleetRouter      capacity-aware client/proxy: routes on scraped
                      load_report (free KV pages for decoders, queue
-                     headroom for engines), sheds cluster-wide only
-                     when NO replica has capacity, fails over off dead
-                     replicas with dedup-safe retransmits
+                     headroom for engines), skips draining replicas,
+                     sheds cluster-wide only when NO replica has
+                     capacity, fails over off dead replicas with
+                     dedup-safe retransmits
     RolloutDriver    training→serving loop: canary → health-gate →
-                     durable intent → fleet-wide roll with zero
-                     dropped requests
+                     durable (signed) intent → fleet-wide roll with
+                     zero dropped requests
+    FleetPolicy      the autoscale policy loop (ISSUE 17): hysteretic
+                     scale-up on fleet-wide free-page/headroom floors,
+                     cache-aware scale-down draining the COLDEST
+                     replica
+    ReplicaLauncher  turns scale intents into real replica processes:
+                     spawn, crash-restart with backoff, SIGTERM-grace-
+                     SIGKILL stop, orphan reaping
+    IntentRefused    typed refusal of an unsigned/tampered/replayed/
+                     out-of-allowlist intent (fleet/auth.py)
 
 See docs/FLEET.md for the full model; `python -m paddle_tpu.fleet
 --selftest` is the in-process end-to-end proof.
 """
-from .controller import FleetController
+from .auth import IntentRefused
+from .controller import FleetController, INTENT_ACTIONS, SCALE_ACTIONS
+from .launcher import ReplicaLauncher
 from .member import FleetMember
+from .policy import FleetPolicy
 from .rollout import (RolloutDriver, RolloutError, decoder_artifact,
                       model_artifact)
 from .router import FleetRouter, FleetTokenStream, NoReplicasError
 
 __all__ = [
     "FleetController", "FleetMember", "FleetRouter", "FleetTokenStream",
-    "NoReplicasError",
+    "NoReplicasError", "FleetPolicy", "ReplicaLauncher", "IntentRefused",
+    "INTENT_ACTIONS", "SCALE_ACTIONS",
     "RolloutDriver", "RolloutError", "decoder_artifact", "model_artifact",
 ]

@@ -21,6 +21,15 @@
 
     python -m paddle_tpu.fleet --controller [--port N]
         Operator mode: run a FleetController until interrupted.
+
+    python -m paddle_tpu.fleet --replica --controller-addr HOST:PORT \
+            [--replica-id RID]
+        Replica mode — what the ReplicaLauncher spawns (ISSUE 17): a
+        ServingServer joined to the fleet by a FleetMember. The model
+        set converges entirely from the controller's intent log
+        (checkpoint-dir deploys included), so the process needs no
+        model arguments. SIGTERM = clean leave (deregister, drain);
+        SIGKILL = crash, and the launcher's backoff brings it back.
 """
 from __future__ import annotations
 
@@ -153,6 +162,131 @@ def run_selftest(verbose: bool = True) -> int:
             alloc1.free(99003)
         finally:
             router2.close()
+
+        # -- 5. signed intents + autoscale policy + launcher -------------
+        import subprocess  # noqa: F401  (spawned via ReplicaLauncher)
+        import time as _time
+
+        from paddle_tpu.distributed.rpc import RpcClient
+
+        from . import FleetPolicy, ReplicaLauncher
+        from . import auth as _fauth
+
+        os.environ["PADDLE_TPU_FLEET_KEY"] = "selftest-key"
+        ctl2 = FleetController(lease_ttl=30.0, sweep_interval=0)
+        ctl2_addr = ctl2.serve()
+        cli2 = RpcClient(ctl2_addr, retries=0)
+        ln = None
+        try:
+            # 5a: unsigned append refused typed + counted; signed lands
+            base_ref = _metrics.counter(
+                "fleet.auth.refused.unsigned").value()
+            try:
+                cli2.call("add_intent", "unload_model", "ghost", {})
+                check(False, "unsigned intent refused on a keyed fleet")
+            except RuntimeError as e:
+                check("intent refused (unsigned)" in str(e),
+                      "unsigned intent refused on a keyed fleet")
+            check(_metrics.counter("fleet.auth.refused.unsigned").value()
+                  == base_ref + 1,
+                  "refusal counted (fleet.auth.refused.unsigned)")
+            f = _fauth.signed_fields("unload_model", "ghost", {})
+            r = cli2.call("add_intent", "unload_model", "ghost", {},
+                          f["nonce"], f["sig"])
+            check(r.get("ok"), "signed intent accepted")
+            try:
+                cli2.call("add_intent", "unload_model", "ghost", {},
+                          f["nonce"], f["sig"])
+                check(False, "replayed intent refused")
+            except RuntimeError as e:
+                check("intent refused (replayed)" in str(e),
+                      "replayed intent refused")
+
+            # 5b: policy — hysteretic scale-up, cache-aware scale-down
+            for i, rid in enumerate(("p0", "p1")):
+                cli2.call("register", rid, ["127.0.0.1", 10000 + i])
+
+            def beat(rid, free, cached):
+                cli2.call("heartbeat", rid, 0,
+                          {"free_pages": free, "queue_headroom": 4,
+                           "cached_tokens": cached, "queue_depth": 0,
+                           "live_slots": 0, "models": {}})
+
+            pol = FleetPolicy(ctl2, beats=2, cooldown=0,
+                              free_page_floor=8, headroom_floor=1,
+                              margin=1.0, min_replicas=1,
+                              max_replicas=3, start=False)
+            beat("p0", 2, 0)
+            beat("p1", 2, 500)
+            d1 = pol.tick()  # under floor (4 < 8): streak 1 -> hold
+            d2 = pol.tick()  # streak 2 == beats -> scale_up
+            check(d1["decision"] == "hold"
+                  and d2["decision"] == "scale_up",
+                  "policy scales UP only after N consecutive "
+                  f"under-floor beats ({d1['decision']}, "
+                  f"{d2['decision']})")
+            beat("p0", 50, 0)
+            beat("p1", 50, 500)
+            d3 = pol.tick()  # capacity back: drain the COLDEST (p0)
+            d4 = pol.tick()  # p0 idle -> scale_down intent
+            check(d3["decision"] == "drain" and d3["replica"] == "p0",
+                  "cache-aware scale-down drains the COLDEST replica "
+                  f"({d3})")
+            check(d4["decision"] == "scale_down"
+                  and d4["replica"] == "p0",
+                  "drained-idle replica handed to the launcher "
+                  f"({d4['decision']})")
+            scale_log = cli2.call("scale_intents", 0)
+            check(len(scale_log) == 2
+                  and all(i.get("sig") for i in scale_log),
+                  "policy's scale intents are signed")
+
+            # 5c: launcher — spawn, SIGKILL resurrection, signed stop
+            def fake_cmd(rid):
+                return [sys.executable, "-c",
+                        "import time; time.sleep(60)"]
+
+            ln = ReplicaLauncher(ctl2_addr, command_factory=fake_cmd,
+                                 backoff=0.05, grace=2.0, start=False)
+            ln.poll_once()
+            rep = ln.stats()["replicas"]
+            check(rep.get("auto-1", {}).get("alive")
+                  and "p0" not in rep,
+                  "launcher spawned the scale_up replica (and ignored "
+                  "the never-spawned drain victim)")
+            pid1 = ln.pid_of("auto-1")
+            ln.kill_replica("auto-1")
+            pid2 = None
+            deadline = _time.monotonic() + 20.0
+            while _time.monotonic() < deadline:
+                ln.poll_once()
+                pid2 = ln.pid_of("auto-1")
+                if pid2 is not None and pid2 != pid1:
+                    break
+                _time.sleep(0.05)
+            check(pid2 is not None and pid2 != pid1,
+                  "launcher resurrected the SIGKILLed replica "
+                  f"(pid {pid1} -> {pid2})")
+            check(_metrics.counter("fleet.launcher.restarts").value()
+                  >= 1, "resurrection counted as a crash-restart")
+            f2 = _fauth.signed_fields("scale_down", "_fleet",
+                                      {"replica_id": "auto-1"})
+            cli2.call("add_scale_intent", "scale_down",
+                      {"replica_id": "auto-1"}, f2["nonce"], f2["sig"])
+            deadline = _time.monotonic() + 20.0
+            while _time.monotonic() < deadline:
+                ln.poll_once()
+                if not ln.stats()["replicas"]["auto-1"]["alive"]:
+                    break
+                _time.sleep(0.05)
+            check(not ln.stats()["replicas"]["auto-1"]["alive"],
+                  "signed scale_down stopped the replica")
+        finally:
+            os.environ.pop("PADDLE_TPU_FLEET_KEY", None)
+            if ln is not None:
+                ln.stop()
+            cli2.close()
+            ctl2.shutdown()
     finally:
         router.close()
         for m in members:
@@ -177,12 +311,49 @@ def main(argv=None) -> int:
                     help="run the in-process end-to-end selftest")
     ap.add_argument("--controller", action="store_true",
                     help="run a FleetController until interrupted")
+    ap.add_argument("--replica", action="store_true",
+                    help="run one fleet replica (a ServingServer + "
+                         "FleetMember) — what the ReplicaLauncher "
+                         "spawns; converges its model set from the "
+                         "controller's intent log")
+    ap.add_argument("--controller-addr", default=None,
+                    help="HOST:PORT of the fleet controller "
+                         "(replica mode)")
+    ap.add_argument("--replica-id", default=None)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--lease-ttl", type=float, default=None)
     args = ap.parse_args(argv)
 
     _force_cpu()
+    if args.replica:
+        import signal
+        import threading
+
+        from paddle_tpu.serving import ServingServer
+
+        from . import FleetMember
+
+        if not args.controller_addr:
+            ap.error("--replica requires --controller-addr HOST:PORT")
+        chost, _, cport = args.controller_addr.rpartition(":")
+        srv = ServingServer()
+        host, port = srv.serve(args.host, args.port)
+        member = FleetMember(srv, (chost or "127.0.0.1", int(cport)),
+                             replica_id=args.replica_id)
+        done = threading.Event()
+        # SIGTERM is the launcher's polite stop: deregister (the
+        # controller must not count this as an eviction) and drain
+        # in-flight work before exiting. SIGKILL needs no handler —
+        # that is the crash path the launcher resurrects.
+        for s in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(s, lambda *_: done.set())
+        print(f"fleet replica {member.replica_id} on {host}:{port}",
+              flush=True)
+        done.wait()
+        member.stop(deregister=True)
+        srv.shutdown(drain=True)
+        return 0
     if args.controller:
         from . import FleetController
 

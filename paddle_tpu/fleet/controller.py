@@ -24,6 +24,30 @@ task state): it keeps
     deploy gets the registry's warm-then-flip + drain guarantees).
     Heartbeat responses carry the latest intent seq, so a live replica
     learns of new intents at heartbeat cadence with zero extra RPCs.
+    Heartbeats carry each member's APPLIED seq back (ISSUE 17), and
+    the log COMPACTS below the fleet-wide applied watermark: superseded
+    deploys and unloaded models drop, the latest live-model intent
+    below the watermark is kept VERBATIM (original seq, nonce, and
+    signature — a re-signed copy would be a forgery), so a long-lived
+    fleet's restart replay and controller memory stay O(live models)
+    while every assigned seq remains monotone (`_next_seq` never
+    regresses, so the member's controller-restart log-regression
+    detection keeps firing only on a real restart).
+
+  * a SCALE-INTENT CHANNEL — the autoscale policy loop's output
+    (`scale_up` / `scale_down`), numbered independently of the deploy
+    log and consumed by the ReplicaLauncher (fleet/launcher.py): a
+    scale_up spawns a fresh replica subprocess (its model set then
+    converges from the deploy log — checkpoint-dir deploys included),
+    a scale_down names the drained victim the launcher must stop.
+    Replicas the policy is draining carry a `draining` flag in the
+    replica table; routers stop sending NEW requests to a draining
+    replica while its in-flight work finishes.
+
+When the fleet is keyed (fleet/auth.py), `add_intent` and
+`add_scale_intent` refuse unsigned, tampered, or replayed appends
+typed + counted — the log is the fleet's write surface, and garbage
+must not enter it even before the members' own verification.
 
 Every handler fires the `fleet.<method>` fault site first, so chaos
 plans reach the control plane by name. `add_intent` rides the RPC dedup
@@ -47,8 +71,9 @@ from ..distributed import faults as _faults
 from ..distributed.rpc import RpcServer
 from ..observability import debug_server as _debug, metrics as _metrics
 from ..observability.log import get_logger
+from . import auth as _auth
 
-__all__ = ["FleetController", "INTENT_ACTIONS"]
+__all__ = ["FleetController", "INTENT_ACTIONS", "SCALE_ACTIONS"]
 
 _log = get_logger("fleet")
 
@@ -56,11 +81,17 @@ _m_registrations = _metrics.counter("fleet.registrations")
 _m_evictions = _metrics.counter("fleet.evictions")
 _m_heartbeats = _metrics.counter("fleet.heartbeats")
 _m_intents = _metrics.counter("fleet.intents")
+_m_compacted = _metrics.counter("fleet.intents.compacted")
+_m_scale_intents = _metrics.counter("fleet.scale.intents")
 _g_replicas = _metrics.gauge("fleet.replicas")
+_g_intent_log = _metrics.gauge("fleet.intent_log")
 
 # the deploy verbs a FleetMember knows how to apply against its own
 # ServingServer (member.py _apply_intent is the consumer)
 INTENT_ACTIONS = ("load_model", "load_decoder", "unload_model")
+
+# the scale verbs the ReplicaLauncher consumes (launcher.py)
+SCALE_ACTIONS = ("scale_up", "scale_down")
 
 
 class FleetController:
@@ -84,9 +115,17 @@ class FleetController:
                                 if sweep_interval is None
                                 else float(sweep_interval))
         self._mu = threading.Lock()
-        # rid -> {endpoint, deadline, registered_at, beats}
+        # rid -> {endpoint, deadline, registered_at, beats, draining,
+        #         applied_seq, load}
         self._replicas: Dict[str, Dict[str, Any]] = {}  # guarded-by: _mu
+        # ascending by seq; seqs may be SPARSE after compaction, so the
+        # latest assigned seq lives in _next_seq, never len()
         self._intents: List[Dict[str, Any]] = []  # guarded-by: _mu
+        self._next_seq = 0  # guarded-by: _mu
+        self._scale_intents: List[Dict[str, Any]] = []  # guarded-by: _mu
+        self._next_scale_seq = 0  # guarded-by: _mu
+        # replay refusal for signed appends (fleet/auth.py)
+        self._nonces = _auth.NonceWindow()
         # recent evictions only (statusz evidence), bounded so replica
         # churn over a long-lived controller can't grow it forever
         self._evicted: Dict[str, float] = {}  # guarded-by: _mu
@@ -106,15 +145,20 @@ class FleetController:
             "intents": self._intents_since,
             "evict": self._evict,
             "fleet_status": self._fleet_status,
+            "set_draining": self._set_draining,
+            "add_scale_intent": self._add_scale_intent,
+            "scale_intents": self._scale_intents_since,
         }
         self._rpc = RpcServer(
             {m: self._guarded(m, fn) for m, fn in handlers.items()},
-            # add_intent APPENDS — a retransmit after a lost reply must
-            # answer from the dedup cache, not append a duplicate
-            # intent. Everything else is convergent or a read.
+            # add_intent / add_scale_intent APPEND — a retransmit after
+            # a lost reply must answer from the dedup cache, not append
+            # a duplicate intent. Everything else is convergent or a
+            # read.
             idempotent={"register", "heartbeat", "deregister",
                         "list_replicas", "intents", "evict",
-                        "fleet_status"},
+                        "fleet_status", "set_draining",
+                        "scale_intents"},
         )
 
     @staticmethod
@@ -226,6 +270,14 @@ class FleetController:
                 "deadline": now + self.lease_ttl,
                 "registered_at": now,
                 "beats": 0,
+                # a REJOIN starts un-draining: the policy drains live
+                # replicas, and a re-registered one is a fresh worker
+                "draining": False,
+                # applied watermark unknown until the first modern
+                # heartbeat reports it — None disables compaction, so
+                # a fleet of old members never loses log they need
+                "applied_seq": None,
+                "load": None,
             }
             self._evicted.pop(rid, None)
             g = self._up_gauges.get(rid)
@@ -234,18 +286,25 @@ class FleetController:
                     f"fleet.replica_up.{rid}")
             g.set(1)
             _g_replicas.set(len(self._replicas))
-            seq = len(self._intents)
+            seq = self._next_seq
         if fresh:
             _m_registrations.inc()
             _log.info("fleet: replica %s registered at %s:%d",
                       rid, endpoint[0], endpoint[1])
         return {"ok": True, "ttl": self.lease_ttl, "intent_seq": seq}
 
-    def _heartbeat(self, replica_id: str) -> Dict[str, Any]:
+    def _heartbeat(self, replica_id: str,
+                   applied_seq: Optional[int] = None,
+                   load: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
         """Renew the lease. `ok: False` (not an error — heartbeats are
         hot-path) tells an evicted/unknown replica to re-register; the
         response's intent_seq is how live replicas learn of new deploy
-        intents without any extra RPC."""
+        intents without any extra RPC. Modern members (ISSUE 17) also
+        report their APPLIED intent seq — the fleet-wide minimum is the
+        compaction watermark — and piggyback a compact load summary
+        (free pages, queue headroom, cached-token mass) that feeds the
+        autoscale policy loop with zero extra scrape RPCs."""
         rid = str(replica_id)
         now = time.time()
         with self._mu:
@@ -255,9 +314,15 @@ class FleetController:
                 return {"ok": False, "reason": "unregistered"}
             st["deadline"] = now + self.lease_ttl
             st["beats"] += 1
-            seq = len(self._intents)
+            if applied_seq is not None:
+                st["applied_seq"] = int(applied_seq)
+            if load is not None:
+                st["load"] = dict(load)
+            self._compact_locked()
+            seq = self._next_seq
+            draining = bool(st["draining"])
         _m_heartbeats.inc()
-        return {"ok": True, "intent_seq": seq}
+        return {"ok": True, "intent_seq": seq, "draining": draining}
 
     def _deregister(self, replica_id: str) -> Dict[str, Any]:
         """Clean leave: removed from the table WITHOUT counting as an
@@ -279,6 +344,7 @@ class FleetController:
         with self._mu:
             self._expire_locked(now)
             return {rid: {"endpoint": list(st["endpoint"]),
+                          "draining": bool(st["draining"]),
                           "beat_age": round(
                               now - (st["deadline"] - self.lease_ttl), 3)}
                     for rid, st in self._replicas.items()}
@@ -300,12 +366,19 @@ class FleetController:
 
     # -- intent log -------------------------------------------------------
     def _add_intent(self, action: str, model: str,
-                    payload: Optional[Dict[str, Any]] = None
-                    ) -> Dict[str, Any]:
+                    payload: Optional[Dict[str, Any]] = None,
+                    nonce: Optional[str] = None,
+                    sig: Optional[str] = None) -> Dict[str, Any]:
         """Append a deploy intent. `payload` carries the action's
         arguments verbatim (spec/dirname/version/engine knobs — whatever
         the matching ServingClient method takes); the controller only
-        validates the envelope, members interpret the payload."""
+        validates the envelope, members interpret the payload. When the
+        fleet is keyed, the append must carry a valid `(nonce, sig)`
+        pair (fleet/auth.py) — unsigned/tampered/replayed appends are
+        refused typed + counted before they can enter the log. Members
+        RE-verify before applying: the controller check keeps garbage
+        out of the log, the member check survives a spoofed
+        controller."""
         action = str(action)
         if action not in INTENT_ACTIONS:
             raise ValueError(
@@ -315,22 +388,141 @@ class FleetController:
         if not model:
             raise ValueError("empty model name")
         payload = dict(payload or {})
+        record: Dict[str, Any] = {"action": action, "model": model,
+                                  "payload": payload}
+        if nonce is not None:
+            record["nonce"] = str(nonce)
+        if sig is not None:
+            record["sig"] = str(sig)
+        _auth.verify_intent(_auth.intent_key(), record,
+                            window=self._nonces)
         with self._mu:
-            seq = len(self._intents) + 1
-            self._intents.append({"seq": seq, "action": action,
-                                  "model": model, "payload": payload,
-                                  "at": time.time()})
+            self._next_seq += 1
+            seq = record["seq"] = self._next_seq
+            record["at"] = time.time()
+            self._intents.append(record)
+            _g_intent_log.set(len(self._intents))
         _m_intents.inc()
         _log.info("fleet: intent #%d: %s %s", seq, action, model)
         return {"ok": True, "seq": seq}
 
     def _intents_since(self, since: int = 0) -> List[Dict[str, Any]]:
         """The log tail with seq > since — what a converging member
-        fetches. Intents are immutable once appended; the slice is
-        cheap (seq is position+1 by construction)."""
+        fetches. Intents are immutable once appended; seqs are sparse
+        after compaction, so filter on the stored seq, never on list
+        position."""
         since = max(0, int(since))
         with self._mu:
-            return [dict(i) for i in self._intents[since:]]
+            return [dict(i) for i in self._intents if i["seq"] > since]
+
+    def _compact_locked(self):
+        """Drop log entries no live member still needs: below the
+        fleet-wide applied watermark (min applied_seq over live
+        replicas), only the LATEST load intent of each still-loaded
+        model matters to a future joiner — superseded versions and
+        load/unload pairs compact away. Kept intents keep their
+        ORIGINAL record verbatim (seq, nonce, signature): a re-signed
+        or re-numbered copy would break member-side verification and
+        the monotone-seq contract. A replica that has never reported
+        an applied seq (None — an old member) pins the watermark at
+        zero, so compaction is strictly opt-in per fleet."""
+        if not self._replicas:
+            return
+        applied = [st["applied_seq"] for st in self._replicas.values()]
+        if any(a is None for a in applied):
+            return
+        watermark = min(applied)
+        if watermark <= 0 or not self._intents:
+            return
+        # last action per model at-or-below the watermark, in log order
+        last: Dict[str, Dict[str, Any]] = {}
+        for rec in self._intents:
+            if rec["seq"] <= watermark:
+                last[rec["model"]] = rec
+        keep_ids = {id(rec) for rec in last.values()
+                    if rec["action"] != "unload_model"}
+        kept = [rec for rec in self._intents
+                if rec["seq"] > watermark or id(rec) in keep_ids]
+        dropped = len(self._intents) - len(kept)
+        if dropped <= 0:
+            return
+        self._intents = kept
+        _g_intent_log.set(len(self._intents))
+        _m_compacted.inc(dropped)
+        _log.info("fleet: compacted %d intent(s) below watermark %d "
+                  "(%d kept)", dropped, watermark, len(kept))
+
+    # -- scale intents (autoscale policy -> launcher) ---------------------
+    def _add_scale_intent(self, action: str,
+                          payload: Optional[Dict[str, Any]] = None,
+                          nonce: Optional[str] = None,
+                          sig: Optional[str] = None) -> Dict[str, Any]:
+        """Append a scale intent (`scale_up` / `scale_down`). Numbered
+        independently of the deploy log; the ReplicaLauncher is the
+        consumer. Signed under the same fleet key as deploy intents
+        (model field is the empty-string sentinel '_fleet' so the
+        canonical form stays one shape)."""
+        action = str(action)
+        if action not in SCALE_ACTIONS:
+            raise ValueError(
+                f"unknown scale action {action!r}; known: "
+                f"{SCALE_ACTIONS}")
+        payload = dict(payload or {})
+        record: Dict[str, Any] = {"action": action, "model": "_fleet",
+                                  "payload": payload}
+        if nonce is not None:
+            record["nonce"] = str(nonce)
+        if sig is not None:
+            record["sig"] = str(sig)
+        _auth.verify_intent(_auth.intent_key(), record,
+                            window=self._nonces)
+        with self._mu:
+            self._next_scale_seq += 1
+            seq = record["seq"] = self._next_scale_seq
+            record["at"] = time.time()
+            self._scale_intents.append(record)
+            # bounded: the launcher consumes from its local watermark,
+            # and a scale intent is meaningless to a LATE joiner (the
+            # fleet it described is gone) — keep a short tail only
+            if len(self._scale_intents) > 256:
+                self._scale_intents = self._scale_intents[-128:]
+        _m_scale_intents.inc()
+        _log.info("fleet: scale intent #%d: %s %s", seq, action, payload)
+        return {"ok": True, "seq": seq}
+
+    def _scale_intents_since(self, since: int = 0) -> List[Dict[str, Any]]:
+        since = max(0, int(since))
+        with self._mu:
+            return [dict(i) for i in self._scale_intents
+                    if i["seq"] > since]
+
+    def _set_draining(self, replica_id: str,
+                      draining: bool = True) -> Dict[str, Any]:
+        """Mark a replica draining (or not). Routers stop sending NEW
+        requests to a draining replica; its in-flight work finishes
+        normally; the policy loop appends the scale_down intent once
+        the replica's heartbeat summary reports it idle."""
+        rid = str(replica_id)
+        with self._mu:
+            st = self._replicas.get(rid)
+            if st is None:
+                return {"ok": False, "reason": "unregistered"}
+            st["draining"] = bool(draining)
+        _log.info("fleet: replica %s draining=%s", rid, bool(draining))
+        return {"ok": True}
+
+    def policy_view(self) -> Dict[str, Dict[str, Any]]:
+        """The autoscale policy loop's input (in-process read — the
+        policy runs next to the controller): every live replica's
+        draining flag, applied seq, and last heartbeat load summary."""
+        now = time.time()
+        with self._mu:
+            self._expire_locked(now)
+            return {rid: {"draining": bool(st["draining"]),
+                          "applied_seq": st["applied_seq"],
+                          "load": (dict(st["load"])
+                                   if st["load"] else None)}
+                    for rid, st in self._replicas.items()}
 
     # -- introspection ----------------------------------------------------
     def _fleet_status(self) -> Dict[str, Any]:
@@ -344,10 +536,14 @@ class FleetController:
                 "replicas": {
                     rid: {"endpoint": list(st["endpoint"]),
                           "beats": st["beats"],
+                          "draining": bool(st["draining"]),
+                          "applied_seq": st["applied_seq"],
                           "lease_remaining": round(
                               st["deadline"] - now, 3)}
                     for rid, st in self._replicas.items()},
                 "evicted": sorted(self._evicted),
-                "intent_seq": len(self._intents),
+                "intent_seq": self._next_seq,
+                "intent_log_len": len(self._intents),
+                "scale_seq": self._next_scale_seq,
                 "rpc": self._rpc.stats(),
             }

@@ -34,6 +34,7 @@ from ..observability import metrics as _metrics
 from ..observability.log import get_logger
 from ..serving.client import ServingClient
 from ..serving.errors import ModelNotFound
+from . import auth as _auth
 
 __all__ = ["FleetMember"]
 
@@ -73,6 +74,9 @@ class FleetMember:
         self._registered = False  # guarded-by: _cond
         self._stopping = False  # guarded-by: _cond
         self._threads = []
+        # replay refusal for signed intents (ISSUE 17): per-member so a
+        # replayed append is refused by EVERY replica independently
+        self._nonces = _auth.NonceWindow()
         if start:
             self.start()
 
@@ -146,6 +150,34 @@ class FleetMember:
                     "applied_seq": self._applied_seq,
                     "target_seq": self._target_seq}
 
+    def _load_summary(self) -> Optional[Dict[str, Any]]:
+        """Compact load snapshot piggybacked on every heartbeat
+        (ISSUE 17): the autoscale policy loop's per-replica input —
+        free KV pages, queue headroom, cached-token mass (the
+        cache-aware drain-order signal), idleness, and the model set.
+        Computed from the server's in-process load_report (no loopback
+        RPC: a beat must never queue behind the replica's own data
+        plane)."""
+        try:
+            report = self._server.load_report()
+        except Exception:  # beat must survive any registry hiccup
+            return None
+        free = headroom = cached = depth = live = 0
+        models: Dict[str, int] = {}
+        for name, m in report.get("models", {}).items():
+            models[name] = int(m.get("version", 0))
+            depth += int(m.get("queue_depth", 0))
+            headroom += max(0, int(m.get("max_queue", 0))
+                            - int(m.get("queue_depth", 0)))
+            free += int(m.get("free_pages", 0))
+            live += int(m.get("live_slots", 0))
+            pc = m.get("prefix_cache")
+            if pc:
+                cached += int(pc.get("tokens", 0))
+        return {"free_pages": free, "queue_headroom": headroom,
+                "cached_tokens": cached, "queue_depth": depth,
+                "live_slots": live, "models": models}
+
     # -- controller RPC ---------------------------------------------------
     def _ctl_client(self) -> RpcClient:
         # fail-fast like TcpLease: a beat that can't reach the
@@ -176,7 +208,10 @@ class FleetMember:
                                   "(intent seq %s)", self.replica_id,
                                   r.get("intent_seq"))
                     else:
-                        r = cli.call("heartbeat", self.replica_id)
+                        with self._cond:
+                            applied = self._applied_seq
+                        r = cli.call("heartbeat", self.replica_id,
+                                     applied, self._load_summary())
                         if not r.get("ok"):
                             # evicted (or the controller restarted):
                             # re-register next tick — rejoin, converge
@@ -293,6 +328,19 @@ class FleetMember:
         model = str(intent.get("model"))
         payload = dict(intent.get("payload") or {})
         version = payload.get("version")
+        try:
+            # signed-fleet gate (ISSUE 17): the member re-verifies the
+            # signature (the controller may be spoofed) AND enforces
+            # the path allowlist (paths mean something on THIS host).
+            # A refusal is typed + counted by auth; the seq still
+            # advances — same poisoned-intent discipline as below.
+            _auth.verify_intent(_auth.intent_key(), intent,
+                                window=self._nonces)
+            _auth.check_allowlist(_auth.intent_allowlist(), intent)
+        except _auth.IntentRefused as e:
+            _log.error("fleet member %s: intent #%s REFUSED: %s",
+                       self.replica_id, intent.get("seq"), e)
+            return
         try:
             if action in ("load_model", "load_decoder"):
                 live = self._live_version(model)

@@ -45,6 +45,7 @@ from ..observability import metrics as _metrics, tracing as _tracing
 from ..observability.log import get_logger
 from ..serving.client import ServingClient
 from ..serving.errors import ServingError
+from . import auth as _auth
 
 __all__ = ["RolloutDriver", "RolloutError", "decoder_artifact",
            "model_artifact"]
@@ -188,10 +189,16 @@ class RolloutDriver:
                                    version, probe)
 
                 # 3: durable intent — members converge even if we die now
+                # (signed when the fleet is keyed: the driver is an
+                # intent PRODUCER, so it attaches nonce+sig over the
+                # canonical payload — fleet/auth.py)
                 payload = dict(artifact["payload"])
                 payload["version"] = version
+                signed = _auth.signed_fields(artifact["action"], model,
+                                             payload)
                 seq = int(ctl.call("add_intent", artifact["action"],
-                                   model, payload)["seq"])
+                                   model, payload, signed.get("nonce"),
+                                   signed.get("sig"))["seq"])
 
                 # 4: roll the rest, one at a time
                 deployed, skipped = [canary], []

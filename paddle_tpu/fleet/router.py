@@ -81,6 +81,16 @@ _m_routed_warm = _metrics.counter("fleet.routed_warm")
 # mid-stream failovers that re-established a token stream on a
 # survivor and spliced at the delivered offset (ISSUE 12)
 _m_stream_resumes = _metrics.counter("fleet.stream.resumes")
+# first-class fleet-wide capacity gauges (ISSUE 17): what the
+# autoscale policy loop sees, exported from the router's scrape view
+# so dashboards and the policy agree on the same signal. Totals count
+# ROUTABLE capacity only (draining replicas excluded — their pages
+# take no new work); replicas_live counts every reachable replica,
+# draining included. Zeroed at close() — a closed router's last
+# scrape must not linger as live fleet capacity (the N205 class).
+_g_free_total = _metrics.gauge("fleet.free_pages_total")
+_g_headroom_total = _metrics.gauge("fleet.queue_headroom")
+_g_replicas_live = _metrics.gauge("fleet.replicas_live")
 
 
 class NoReplicasError(ServingError):
@@ -114,6 +124,9 @@ class FleetRouter:
         self._mu = threading.Lock()
         self._replicas: Dict[str, Tuple[str, int]] = {}  # guarded-by: _mu
         self._replicas_at = 0.0  # guarded-by: _mu
+        # replicas the policy is draining: in the table (in-flight work
+        # and streams continue) but taken out of NEW-request ranking
+        self._draining: set = set()  # guarded-by: _mu
         # per-THREAD per-replica persistent clients. Per-replica
         # persistence is what makes same-replica retransmits ride the
         # original (client_id, seq) and get dedup-answered; per-THREAD
@@ -161,6 +174,8 @@ class FleetRouter:
                 return dict(self._replicas)
         table = {str(rid): (str(st["endpoint"][0]), int(st["endpoint"][1]))
                  for rid, st in listed.items()}
+        draining = {str(rid) for rid, st in listed.items()
+                    if st.get("draining")}
         # not a lost-update risk: the controller response is the whole
         # truth (last refresh wins wholesale), and the staleness read
         # above only decides WHETHER to ask — never what to write
@@ -170,6 +185,7 @@ class FleetRouter:
             for rid in gone:
                 self._drop_replica_locked(rid)
             self._replicas = table
+            self._draining = draining
             self._replicas_at = now
             return dict(self._replicas)
 
@@ -320,15 +336,29 @@ class FleetRouter:
         (ISSUE 13 — it prefills only the suffix); free KV pages break
         warmth ties, queue headroom breaks those."""
         table = self.refresh()
+        with self._mu:
+            draining = set(self._draining)
         scored: List[Tuple[float, str, Tuple[str, int], bool]] = []
         serving_model = 0
         reachable = 0
+        free_total = 0
+        headroom_total = 0
         reports = self._loads_for(sorted(table.items()))
         for rid, ep in sorted(table.items()):
             report = reports.get(rid)
             if report is None:
                 continue
             reachable += 1
+            if rid in draining:
+                # draining (policy scale-down in progress): in-flight
+                # work finishes, but NO new requests — and its pages
+                # are not routable capacity
+                continue
+            for mm in report["models"].values():
+                free_total += int(mm.get("free_pages", 0))
+                headroom_total += max(
+                    0, int(mm.get("max_queue", 0))
+                    - int(mm.get("queue_depth", 0)))
             m = report["models"].get(model)
             if m is None or m.get("stopping"):
                 continue
@@ -349,6 +379,9 @@ class FleetRouter:
             else:
                 score = float(m["max_queue"] - m["queue_depth"])
             scored.append((score, rid, ep, warm))
+        _g_free_total.set(free_total)
+        _g_headroom_total.set(headroom_total)
+        _g_replicas_live.set(reachable)
         scored.sort(key=lambda s: (-s[0], s[1]))
         return ([(rid, ep, warm) for _s, rid, ep, warm in scored],
                 serving_model, reachable)
@@ -514,7 +547,13 @@ class FleetRouter:
             for rid in list(self._all_clients):
                 self._drop_replica_locked(rid)
             self._replicas = {}
+            self._draining = set()
             pool, self._pool = self._pool, None
+        # fleet-wide gauges must not outlive the router that computed
+        # them — a closed router's last scrape is not live capacity
+        _g_free_total.set(0)
+        _g_headroom_total.set(0)
+        _g_replicas_live.set(0)
         if pool is not None:
             pool.shutdown(wait=False)
         # outside the lock: close() serializes with any in-flight call

@@ -240,6 +240,39 @@ FLAGS: Dict[str, Any] = _Flags({
     # before the next routing decision re-scrapes. Small = accurate
     # balancing, large = fewer load_report RPCs per routed request
     "fleet_scrape_ttl": 0.25,
+    # autoscale policy loop (paddle_tpu/fleet/policy.py, ISSUE 17).
+    # Evaluation cadence in seconds, and the hysteresis discipline:
+    # a scale decision needs `fleet_policy_beats` CONSECUTIVE ticks of
+    # the same verdict, and after any action the loop holds still for
+    # `fleet_policy_cooldown` ticks (a spawning replica takes several
+    # ticks to register — acting again before it lands would overshoot)
+    "fleet_policy_interval": 0.5,
+    "fleet_policy_beats": 3,
+    "fleet_policy_cooldown": 8,
+    # scale-UP floors: intent when fleet-wide free KV pages OR queue
+    # headroom sits below these for `beats` consecutive ticks
+    "fleet_free_page_floor": 8,
+    "fleet_headroom_floor": 2,
+    # scale-DOWN hysteresis margin: the fleet MINUS the drain victim
+    # must retain margin x both scale-up floors — the dead band between
+    # the up floor and the down bar is what keeps a boundary load from
+    # flapping the fleet up and down forever
+    "fleet_scale_margin": 2.0,
+    # replica-count bounds the policy loop may never cross
+    "fleet_min_replicas": 1,
+    "fleet_max_replicas": 4,
+    # replica-launcher crash-restart backoff base in seconds (doubles
+    # per consecutive crash, capped launcher-side)
+    "fleet_launcher_backoff": 0.25,
+    # intent signing + deploy-path allowlist (fleet/auth.py). Key '' =
+    # open mode (unsigned intents, bit-identical PR 11 behavior); the
+    # PADDLE_TPU_FLEET_KEY env var wins over the flag so launcher-
+    # spawned replica subprocesses inherit it. The allowlist is a
+    # ':'-separated list of absolute dir prefixes every checkpoint_dir/
+    # dirname/draft_checkpoint_dir payload path must resolve under
+    # (PADDLE_TPU_FLEET_ALLOW env wins; '' = unrestricted)
+    "fleet_intent_key": "",
+    "fleet_intent_allowlist": "",
 })
 
 

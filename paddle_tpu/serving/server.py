@@ -621,5 +621,12 @@ class ServingServer:
             models[name] = entry
         return {"ok": True, "models": models}
 
+    def load_report(self) -> Dict[str, Any]:
+        """In-process alias for the load_report RPC: the same snapshot,
+        without a loopback dial — FleetMember piggybacks it on every
+        heartbeat (ISSUE 17), and a beat must never block on its own
+        server's RPC queue."""
+        return self._load_report()
+
     def _health(self) -> Dict[str, Any]:
         return {"ok": True, "models": self._registry.names()}

@@ -1,0 +1,732 @@
+"""Fleet control plane (ISSUE 17): autoscale policy loop, replica
+launcher, and signed intents.
+
+Coverage map:
+  - intent signing: roundtrip, tamper, replay, allowlist (unit, no
+    processes);
+  - refusals over the wire: an unsigned append bounces TYPED at the
+    controller; poison injected into the log (a spoofed controller)
+    is refused by a LIVE member — typed, counted per reason, zero
+    state change — and the applied watermark still passes the poison;
+  - compaction: the intent log stays O(live models) below the
+    fleet-wide applied watermark, kept records stay VERBATIM, and the
+    PR 10 controller-restart reset is regression-tested against
+    compaction's sparse seqs (shrinkage must NOT read as a restart);
+  - policy loop: hysteresis (no scale-up off a single hot beat, no
+    flap on boundary load), cooldown, min/max bounds, cache-aware
+    coldest-victim drain with the dead band, undrain on mid-drain
+    pressure — all on a scripted controller, tick()-exact;
+  - coldest-victim integration: two REAL decoder replicas, seeded
+    prefix traffic warms one, the policy drains the other
+    (counter-exact cached-token ordering from live load summaries);
+  - launcher: spawn from a signed scale intent, crash-restart with
+    exponential backoff gating, SIGTERM-grace-SIGKILL stop for a child
+    that ignores SIGTERM;
+  - router: draining replicas are skipped by NEW requests and excluded
+    from the fleet-wide capacity gauges; close() zeroes the gauges;
+  - the fleet soak smoke (slow lane): the full subprocess choreography
+    of tools/chaos_soak.py --fleet --smoke, evidence JSON checked.
+
+All assertions are counter/state-based; sleeps only poll state with a
+deadline and never assert timing.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.rpc import RpcClient
+from paddle_tpu.fleet import (
+    FleetController, FleetMember, FleetPolicy, FleetRouter,
+    IntentRefused, ReplicaLauncher,
+)
+from paddle_tpu.fleet import auth as fauth
+from paddle_tpu.observability import metrics
+from paddle_tpu.serving import ServingClient, ServingServer
+from paddle_tpu.serving.decode import DecoderSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPEC = DecoderSpec(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                   n_kv_heads=1, seed=3)
+DEC_KW = dict(slots=[2], page_size=4, num_pages=32, max_seq_len=16,
+              prefill_chunk=4)
+
+
+def _ctr(name):
+    return metrics.counter(name).value()
+
+
+@pytest.fixture
+def fleet_key(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FLEET_KEY", "test-key")
+    return "test-key"
+
+
+# --- signing (unit) -----------------------------------------------------
+
+def test_intent_signing_roundtrip_tamper_replay(fleet_key, monkeypatch):
+    fields = fauth.signed_fields("load_decoder", "m", {"version": 1})
+    intent = {"action": "load_decoder", "model": "m",
+              "payload": {"version": 1}, **fields}
+    win = fauth.NonceWindow()
+    fauth.verify_intent(fleet_key, intent, window=win)  # accepts
+    # replay: the SAME nonce bounces the second time
+    with pytest.raises(IntentRefused) as e:
+        fauth.verify_intent(fleet_key, intent, window=win)
+    assert e.value.reason == "replayed"
+    # tamper: flip the payload AFTER signing
+    bad = dict(intent, payload={"version": 2})
+    with pytest.raises(IntentRefused) as e:
+        fauth.verify_intent(fleet_key, bad, window=fauth.NonceWindow())
+    assert e.value.reason == "bad_signature"
+    # unsigned under a keyed fleet
+    with pytest.raises(IntentRefused) as e:
+        fauth.verify_intent(fleet_key, {"action": "load_decoder",
+                                        "model": "m", "payload": {}})
+    assert e.value.reason == "unsigned"
+    # open mode (no key): everything passes, bit-identical old behavior
+    fauth.verify_intent(None, {"action": "x", "model": "m",
+                               "payload": {}})
+    monkeypatch.delenv("PADDLE_TPU_FLEET_KEY")
+    assert fauth.signed_fields("x", "m", {}) == {}
+
+
+def test_allowlist_checks_realpath_prefixes(fleet_key, monkeypatch,
+                                            tmp_path):
+    allow = str(tmp_path / "deploys")
+    os.makedirs(allow)
+    monkeypatch.setenv("PADDLE_TPU_FLEET_ALLOW", allow)
+    ok = {"action": "load_decoder", "model": "m",
+          "payload": {"checkpoint_dir": os.path.join(allow, "ck1")}}
+    fauth.check_allowlist(fauth.intent_allowlist(), ok)
+    for evil in ("/etc/shadow-model",
+                 allow + "-sibling/ck",           # prefix-string trap
+                 os.path.join(allow, "..", "escape")):
+        bad = {"action": "load_decoder", "model": "m",
+               "payload": {"checkpoint_dir": evil}}
+        with pytest.raises(IntentRefused) as e:
+            fauth.check_allowlist(fauth.intent_allowlist(), bad)
+        assert e.value.reason == "path_not_allowed"
+    # pathless intents (unload, scale) never consult the allowlist
+    fauth.check_allowlist(fauth.intent_allowlist(),
+                          {"action": "unload_model", "model": "m",
+                           "payload": {}})
+
+
+# --- refusals over the wire ---------------------------------------------
+
+def test_unsigned_append_refused_typed_at_controller(fleet_key):
+    ctl = FleetController(lease_ttl=30.0, sweep_interval=0)
+    addr = ctl.serve()
+    cli = RpcClient(addr)
+    try:
+        before = _ctr("fleet.auth.refused")
+        with pytest.raises(RuntimeError, match=r"intent refused \(unsigned\)"):
+            cli.call("add_intent", "load_decoder", "ghost",
+                     {"version": 1})
+        with pytest.raises(RuntimeError,
+                           match=r"intent refused \(bad_signature\)"):
+            cli.call("add_intent", "load_decoder", "ghost",
+                     {"version": 1}, fauth.make_nonce(), "0" * 64)
+        assert _ctr("fleet.auth.refused") >= before + 2
+        assert ctl._fleet_status()["intent_seq"] == 0  # nothing landed
+        # scale channel enforces the same gate
+        with pytest.raises(RuntimeError, match=r"intent refused \(unsigned\)"):
+            cli.call("add_scale_intent", "scale_up",
+                     {"replica_id": "evil-1"})
+        assert ctl._fleet_status()["scale_seq"] == 0
+        # a SIGNED append still lands
+        f = fauth.signed_fields("unload_model", "scratch", {})
+        assert cli.call("add_intent", "unload_model", "scratch", {},
+                        f["nonce"], f["sig"])["seq"] == 1
+    finally:
+        cli.close()
+        ctl.shutdown()
+
+
+def test_member_refuses_poison_with_zero_state_change(fleet_key,
+                                                      monkeypatch,
+                                                      tmp_path):
+    """Poison injected DIRECTLY into the log — a spoofed controller —
+    reaches a live member, which refuses each variant typed+counted and
+    keeps converging past it (the applied watermark advances; the ghost
+    model never exists)."""
+    allow = str(tmp_path)
+    monkeypatch.setenv("PADDLE_TPU_FLEET_ALLOW", allow)
+    from paddle_tpu.serving.__main__ import make_model_dir
+
+    d1, _probe, _ref = make_model_dir(str(tmp_path / "v1"))
+    ctl = FleetController(lease_ttl=30.0, sweep_interval=0)
+    ctl_addr = ctl.serve()
+    srv = ServingServer()
+    srv.serve()
+    member = FleetMember(srv, ctl_addr, replica_id="r0",
+                         beat_interval=0.05)
+    try:
+        assert member.wait_registered(30.0)
+        refused0 = _ctr("fleet.auth.refused")
+        # the poison names a REAL loadable model dir inside the
+        # allowlist: only the signature check stands between it and a
+        # live "ghost" model
+        load_payload = {"dirname": d1, "version": 1, "buckets": [4],
+                        "max_wait_ms": 1.0}
+        evil_payload = {"dirname": "/etc/evil", "version": 1}
+        esig = fauth.signed_fields("load_model", "ghost",
+                                   dict(evil_payload))
+        poisons = [
+            {"action": "load_model", "model": "ghost",
+             "payload": dict(load_payload)},                  # unsigned
+            {"action": "load_model", "model": "ghost",
+             "payload": dict(load_payload),
+             "nonce": fauth.make_nonce(), "sig": "f" * 64},   # tampered
+            {"action": "load_model", "model": "ghost",
+             "payload": dict(evil_payload), **esig},  # out-of-allowlist
+        ]
+        with ctl._mu:
+            for rec in poisons:
+                ctl._next_seq += 1
+                rec["seq"] = ctl._next_seq
+                rec["at"] = time.time()
+                ctl._intents.append(rec)
+        # then one GOOD signed intent: convergence past the poison
+        f = fauth.signed_fields("load_model", "m", dict(load_payload))
+        seq = ctl._add_intent("load_model", "m", dict(load_payload),
+                              f["nonce"], f["sig"])["seq"]
+        assert member.wait_converged(seq=seq, timeout=60.0), \
+            member.stats()
+        # refused typed + counted PER REASON; zero ghost state
+        assert _ctr("fleet.auth.refused") >= refused0 + 3
+        for reason in ("unsigned", "bad_signature", "path_not_allowed"):
+            assert _ctr(f"fleet.auth.refused.{reason}") >= 1
+        assert srv.registry.get("m").version == 1
+        from paddle_tpu.serving.errors import ModelNotFound
+        with pytest.raises(ModelNotFound):
+            srv.registry.get("ghost")
+    finally:
+        member.stop(deregister=False)
+        srv.shutdown()
+        ctl.shutdown()
+
+
+# --- compaction ----------------------------------------------------------
+
+def test_compaction_keeps_log_o_live_models_verbatim():
+    ctl = FleetController(lease_ttl=30.0, sweep_interval=0)
+    try:
+        ctl._register("r0", ["127.0.0.1", 1])
+        for v in (1, 2, 3):
+            ctl._add_intent("load_decoder", "m",
+                            {"version": v, "num_pages": 8})
+        ctl._add_intent("load_model", "ghost", {"version": 1})
+        ctl._add_intent("unload_model", "ghost", {})
+        assert ctl._fleet_status()["intent_log_len"] == 5
+        # the heartbeat carries the applied watermark; compaction runs
+        # inline — superseded versions AND the load/unload pair drop
+        ctl._heartbeat("r0", applied_seq=5)
+        st = ctl._fleet_status()
+        assert st["intent_log_len"] == 1
+        assert st["intent_seq"] == 5  # monotone: seqs never reissued
+        (kept,) = ctl._intents_since(0)
+        assert (kept["model"], kept["payload"]["version"],
+                kept["seq"]) == ("m", 3, 3)  # VERBATIM record
+        assert _ctr("fleet.intents.compacted") >= 4
+        # a live replica that has not reported applied_seq pins
+        # compaction off (opt-in per fleet)
+        ctl._register("r1", ["127.0.0.1", 2])
+        ctl._add_intent("load_decoder", "m", {"version": 4})
+        ctl._heartbeat("r0", applied_seq=6)
+        assert ctl._fleet_status()["intent_log_len"] == 2
+    finally:
+        ctl.shutdown()
+
+
+def test_compaction_not_mistaken_for_controller_restart(tmp_path):
+    """PR 10 regression vs compaction: after the log compacts, the
+    controller's intent_seq stays HIGH while the log SHRANK — a member
+    whose watermark sits above the surviving seqs must NOT reset to 0
+    (that is the restart path) and must not re-apply anything."""
+    from paddle_tpu.serving.__main__ import make_model_dir
+
+    d1, _p, _r = make_model_dir(str(tmp_path / "v1"))
+    ctl = FleetController(lease_ttl=30.0, sweep_interval=0)
+    ctl_addr = ctl.serve()
+    srv = ServingServer()
+    srv.serve()
+    ctl._add_intent("unload_model", "scratch", {})  # compacts away
+    ctl._add_intent("load_model", "m",
+                    {"dirname": d1, "version": 1, "buckets": [4],
+                     "max_wait_ms": 1.0})
+    member = FleetMember(srv, ctl_addr, replica_id="r0",
+                         beat_interval=0.05)
+    try:
+        assert member.wait_converged(seq=2, timeout=60.0)
+        deadline = time.monotonic() + 30.0
+        while ctl._fleet_status()["intent_log_len"] > 1:
+            assert time.monotonic() < deadline, "never compacted"
+            time.sleep(0.05)
+        converges = _ctr("fleet.member.converges")
+        beats0 = ctl._fleet_status()["replicas"]["r0"]["beats"]
+        deadline = time.monotonic() + 30.0
+        # several beat cycles over the compacted log: a reset would
+        # zero applied_seq and re-apply (bumping converges) — neither
+        # may happen; the watermark stays put
+        while ctl._fleet_status()["replicas"]["r0"]["beats"] \
+                < beats0 + 10:
+            assert time.monotonic() < deadline, "beats stalled"
+            time.sleep(0.05)
+        assert _ctr("fleet.member.converges") == converges
+        assert member.stats()["applied_seq"] == 2
+        assert srv.registry.get("m").version == 1
+    finally:
+        member.stop(deregister=False)
+        srv.shutdown()
+        ctl.shutdown()
+
+
+# --- the policy loop (scripted controller, tick()-exact) ----------------
+
+class ScriptedController:
+    """A policy_view() the test scripts directly; records every side
+    effect the policy takes."""
+
+    def __init__(self):
+        self.view = {}
+        self.drains = []
+        self.intents = []
+
+    def policy_view(self):
+        return {rid: {"draining": st.get("draining", False),
+                      "applied_seq": st.get("applied_seq", 0),
+                      "load": (dict(st["load"]) if st.get("load")
+                               else None)}
+                for rid, st in self.view.items()}
+
+    def _set_draining(self, rid, draining=True):
+        self.drains.append((rid, draining))
+        self.view[rid]["draining"] = draining
+
+    def _add_scale_intent(self, action, payload, **fields):
+        self.intents.append({"action": action, "payload": payload,
+                             **fields})
+
+
+def _load(free, headroom=10, cached=0, depth=0, slots=0):
+    return {"free_pages": free, "queue_headroom": headroom,
+            "cached_tokens": cached, "queue_depth": depth,
+            "live_slots": slots, "models": {"m": 1}}
+
+
+def _mk_policy(ctl, **kw):
+    kw.setdefault("beats", 3)
+    kw.setdefault("cooldown", 5)
+    kw.setdefault("free_page_floor", 10)
+    kw.setdefault("headroom_floor", 2)
+    kw.setdefault("margin", 2.0)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    return FleetPolicy(ctl, interval=60.0, start=False, **kw)
+
+
+def test_policy_hysteresis_no_flap_on_boundary_load():
+    ctl = ScriptedController()
+    ctl.view = {"r0": {"load": _load(free=4)},
+                "r1": {"load": _load(free=5)}}
+    pol = _mk_policy(ctl)
+    # boundary flapping: the fleet-wide free total alternates 9 / 25
+    # around the floor of 10 — the under-streak resets on every
+    # recovery so no scale-up fires, and on the recovered ticks the
+    # dead band (survivor would keep only 5 < margin*floor) blocks any
+    # drain: twelve boundary ticks, zero intents, zero drains
+    for i in range(12):
+        ctl.view["r0"]["load"] = _load(free=(4 if i % 2 == 0 else 20))
+        d = pol.tick()
+        assert d["decision"] == "hold", d
+    assert ctl.intents == [] and ctl.drains == []
+    # one hot beat does not buy a replica; `beats` consecutive do
+    ctl.view["r0"]["load"] = _load(free=0)
+    assert pol.tick()["decision"] == "hold"
+    assert pol.tick()["decision"] == "hold"
+    d = pol.tick()
+    assert d["decision"] == "scale_up" and d["replica"] == "auto-1"
+    [up] = ctl.intents
+    assert (up["action"], up["payload"]["reason"]) == \
+        ("scale_up", "under_floor")
+    # cooldown: the SAME sustained pressure cannot buy another replica
+    # until it elapses
+    for _ in range(pol.cooldown - 1):
+        assert pol.tick()["decision"] == "hold"
+    assert pol.tick()["decision"] == "scale_up"
+    assert len(ctl.intents) == 2
+
+
+def test_policy_bounds_bootstrap_and_blind_abstain():
+    ctl = ScriptedController()
+    pol = _mk_policy(ctl, min_replicas=2, max_replicas=2, cooldown=0)
+    # bootstrap: an EMPTY fleet scales up unconditionally (no streak)
+    assert pol.tick()["decision"] == "scale_up"
+    assert ctl.intents[0]["payload"]["reason"] == "bootstrap"
+    # a registered-but-silent replica blinds the totals: abstain
+    ctl.view = {"auto-1": {"load": None}}
+    assert pol.tick()["decision"] == "abstain"
+    # at max_replicas, pressure cannot overshoot the bound
+    ctl.view = {"auto-1": {"load": _load(free=0)},
+                "auto-2": {"load": _load(free=0)}}
+    for _ in range(6):
+        assert pol.tick()["decision"] in ("hold",)
+    assert len(ctl.intents) == 1
+
+
+def test_policy_coldest_victim_drain_undrain_and_deadband():
+    ctl = ScriptedController()
+    ctl.view = {
+        "hot":  {"load": _load(free=40, cached=500)},
+        "cold": {"load": _load(free=40, cached=3, depth=1, slots=1)},
+        "warm": {"load": _load(free=40, cached=80)},
+    }
+    pol = _mk_policy(ctl, min_replicas=1, cooldown=4)
+    # dead band: survivors would keep 80 >= 2.0*10 AND headroom — drain
+    # fires, victim is the COLDEST (least cached tokens), never random
+    d = pol.tick()
+    assert (d["decision"], d["replica"]) == ("drain", "cold")
+    assert ctl.drains == [("cold", True)]
+    # still busy: the drain holds (no scale_down yet)
+    assert pol.tick()["decision"] == "draining"
+    assert not ctl.intents
+    # pressure returns mid-drain (active survivors fall under the
+    # floor): UNDRAIN, not a kill
+    ctl.view["hot"]["load"] = _load(free=4, cached=500)
+    ctl.view["warm"]["load"] = _load(free=4, cached=80)
+    d = pol.tick()
+    assert (d["decision"], d["replica"]) == ("undrain", "cold")
+    assert ctl.drains[-1] == ("cold", False)
+    assert not ctl.intents
+    # pressure gone and the victim idle: drain again, then hand the
+    # idle victim to the launcher
+    ctl.view["hot"]["load"] = _load(free=40, cached=500)
+    ctl.view["warm"]["load"] = _load(free=40, cached=80)
+    ctl.view["cold"]["load"] = _load(free=40, cached=3)
+    d = pol.tick()
+    assert (d["decision"], d["replica"]) == ("drain", "cold")
+    d = pol.tick()
+    assert (d["decision"], d["replica"]) == ("scale_down", "cold")
+    [down] = ctl.intents
+    assert down["action"] == "scale_down"
+    assert down["payload"]["replica_id"] == "cold"
+    del ctl.view["cold"]
+    # cooldown from the scale_down gates the next decision; after it,
+    # the dead band blocks a SECOND drain (the survivor would keep
+    # only 12 free < margin*floor)
+    ctl.view["hot"]["load"] = _load(free=12, cached=500)
+    ctl.view["warm"]["load"] = _load(free=12, cached=80)
+    for _ in range(pol.cooldown + 2):
+        d = pol.tick()
+    assert d["decision"] == "hold"
+    assert len(ctl.intents) == 1
+
+
+def test_policy_scale_down_deadband_blocks_boundary_drain():
+    ctl = ScriptedController()
+    # two replicas just above the floor: draining one would leave the
+    # survivor UNDER margin*floor — without the dead band this flaps
+    ctl.view = {"r0": {"load": _load(free=12, cached=0)},
+                "r1": {"load": _load(free=12, cached=9)}}
+    pol = _mk_policy(ctl, margin=2.0, cooldown=0)
+    for _ in range(8):
+        assert pol.tick()["decision"] == "hold"
+    assert not ctl.drains and not ctl.intents
+
+
+def test_policy_signed_scale_intents(fleet_key):
+    ctl = ScriptedController()
+    pol = _mk_policy(ctl, min_replicas=1)
+    pol.tick()  # bootstrap
+    [up] = ctl.intents
+    assert "nonce" in up and "sig" in up
+    rec = {"action": up["action"], "model": "_fleet",
+           "payload": up["payload"], "nonce": up["nonce"],
+           "sig": up["sig"]}
+    fauth.verify_intent("test-key", rec)  # launcher-side re-verify
+
+
+# --- coldest victim from REAL load summaries ----------------------------
+
+def test_policy_drains_coldest_by_real_prefix_traffic():
+    """Integration: two live decoder replicas; seeded prefix traffic
+    warms r-warm's cache, r-cold serves one cacheless request — the
+    policy reads the heartbeat load summaries and drains r-cold."""
+    ctl = FleetController(lease_ttl=30.0, sweep_interval=0)
+    ctl_addr = ctl.serve()
+    servers, members, clients = [], [], []
+    try:
+        for rid in ("r-cold", "r-warm"):
+            srv = ServingServer()
+            addr = srv.serve()
+            servers.append(srv)
+            cli = ServingClient(addr)
+            cli.load_decoder("m", SPEC.to_dict(), prefix_cache=True,
+                             **DEC_KW)
+            clients.append(cli)
+            members.append(FleetMember(srv, ctl_addr, replica_id=rid,
+                                       beat_interval=0.05))
+        assert all(m.wait_registered(30.0) for m in members)
+        warm_prefix = [7, 9, 11, 13, 5, 3]  # > page_size: cacheable
+        for i in range(4):
+            clients[1].generate("m", warm_prefix + [20 + i],
+                                max_new_tokens=2)
+        clients[0].generate("m", [2, 4], max_new_tokens=2)
+        # wait for both heartbeats to carry load summaries
+        deadline = time.monotonic() + 30.0
+        while True:
+            view = ctl.policy_view()
+            loads = {r: s["load"] for r, s in view.items()}
+            if all(loads.values()) and len(loads) == 2:
+                break
+            assert time.monotonic() < deadline, view
+            time.sleep(0.05)
+        assert loads["r-warm"]["cached_tokens"] > \
+            loads["r-cold"]["cached_tokens"]
+        pol = FleetPolicy(ctl, interval=60.0, beats=3, cooldown=0,
+                          free_page_floor=1, headroom_floor=1,
+                          margin=1.0, min_replicas=1, max_replicas=2,
+                          start=False)
+        d = pol.tick()
+        assert (d["decision"], d["replica"]) == ("drain", "r-cold"), d
+        assert ctl.policy_view()["r-cold"]["draining"]
+    finally:
+        for cli in clients:
+            cli.close()
+        for m in members:
+            m.stop(deregister=False)
+        for srv in servers:
+            srv.shutdown(drain=False)
+        ctl.shutdown()
+
+
+# --- the launcher --------------------------------------------------------
+
+def test_launcher_spawn_crash_restart_backoff_and_stop(fleet_key):
+    ctl = FleetController(lease_ttl=30.0, sweep_interval=0)
+    addr = ctl.serve()
+    sleeper = [sys.executable, "-c",
+               "import time; time.sleep(600)"]
+    ln = ReplicaLauncher(addr, command_factory=lambda rid: list(sleeper),
+                         poll_interval=0.05, grace=0.3, backoff=30.0,
+                         start=False)
+    try:
+        f = fauth.signed_fields("scale_up", "_fleet",
+                                {"replica_id": "auto-1"})
+        ctl._add_scale_intent("scale_up", {"replica_id": "auto-1"},
+                              f["nonce"], f["sig"])
+        spawns0 = _ctr("fleet.launcher.spawns")
+        ln.poll_once()
+        assert _ctr("fleet.launcher.spawns") == spawns0 + 1
+        pid = ln.pid_of("auto-1")
+        assert pid is not None
+        # an UNSIGNED scale intent in the channel is refused (counted)
+        # and spawns nothing
+        with ctl._mu:
+            ctl._next_scale_seq += 1
+            ctl._scale_intents.append(
+                {"action": "scale_up", "model": "_fleet",
+                 "payload": {"replica_id": "evil-1"},
+                 "seq": ctl._next_scale_seq, "at": time.time()})
+        refused0 = _ctr("fleet.auth.refused")
+        ln.poll_once()
+        assert _ctr("fleet.auth.refused") == refused0 + 1
+        assert ln.pid_of("evil-1") is None
+        # SIGKILL = crash: supervised restart under the SAME id, gated
+        # by the exponential backoff (restart_at in the future blocks;
+        # forcing it due releases) — no timing sleeps
+        assert ln.kill_replica("auto-1") == pid
+        deadline = time.monotonic() + 10.0
+        while ln.pid_of("auto-1") is not None:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        ln.poll_once()  # notices the corpse, schedules the restart
+        with ln._mu:
+            rec = ln._procs["auto-1"]
+            assert rec["crashes"] == 1
+            assert rec["restart_at"] is not None  # 30s away: gated
+        restarts0 = _ctr("fleet.launcher.restarts")
+        ln.poll_once()
+        assert ln.pid_of("auto-1") is None  # backoff still gating
+        with ln._mu:
+            ln._procs["auto-1"]["restart_at"] = 0.0  # force due
+        ln.poll_once()
+        pid2 = ln.pid_of("auto-1")
+        assert pid2 is not None and pid2 != pid
+        assert _ctr("fleet.launcher.restarts") == restarts0 + 1
+        # crash again: the scheduled delay DOUBLES (2^(crashes-1))
+        ln.kill_replica("auto-1")
+        while ln.pid_of("auto-1") is not None:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        ln.poll_once()
+        with ln._mu:
+            assert ln._procs["auto-1"]["crashes"] == 2
+        # signed scale_down stops it: SIGTERM, then the stats mark it
+        # stopped (no restart ever again)
+        f2 = fauth.signed_fields("scale_down", "_fleet",
+                                 {"replica_id": "auto-1"})
+        ctl._add_scale_intent("scale_down", {"replica_id": "auto-1"},
+                              f2["nonce"], f2["sig"])
+        ln.poll_once()
+        with ln._mu:
+            assert ln._procs["auto-1"]["stopped"]
+        for _ in range(100):
+            ln.poll_once()
+            if not ln.stats()["replicas"]["auto-1"]["alive"]:
+                break
+            time.sleep(0.05)
+        assert not ln.stats()["replicas"]["auto-1"]["alive"]
+    finally:
+        ln.stop()
+        ctl.shutdown()
+
+
+def test_launcher_sigterm_grace_then_sigkill(fleet_key):
+    """A child that IGNORES SIGTERM is escalated to SIGKILL after the
+    grace window — scale_down can never wedge on a stuck replica."""
+    ctl = FleetController(lease_ttl=30.0, sweep_interval=0)
+    addr = ctl.serve()
+    stubborn = [sys.executable, "-c",
+                "import signal, time; "
+                "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+                "time.sleep(600)"]
+    ln = ReplicaLauncher(addr, command_factory=lambda rid: list(stubborn),
+                         poll_interval=0.05, grace=0.3, backoff=0.05,
+                         start=False)
+    try:
+        for action, rid_payload in (("scale_up", "auto-1"),):
+            f = fauth.signed_fields(action, "_fleet",
+                                    {"replica_id": rid_payload})
+            ctl._add_scale_intent(action, {"replica_id": rid_payload},
+                                  f["nonce"], f["sig"])
+        ln.poll_once()
+        pid = ln.pid_of("auto-1")
+        assert pid is not None
+        # give the child a beat to install its SIGTERM ignorer —
+        # otherwise the polite signal lands first and proves nothing
+        time.sleep(0.5)
+        f = fauth.signed_fields("scale_down", "_fleet",
+                                {"replica_id": "auto-1"})
+        ctl._add_scale_intent("scale_down", {"replica_id": "auto-1"},
+                              f["nonce"], f["sig"])
+        reaped0 = _ctr("fleet.launcher.reaped")
+        deadline = time.monotonic() + 15.0
+        while ln.stats()["replicas"]["auto-1"]["alive"]:
+            assert time.monotonic() < deadline, ln.stats()
+            ln.poll_once()
+            time.sleep(0.05)
+        ln.poll_once()  # the pass after death reaps the corpse
+        assert _ctr("fleet.launcher.reaped") == reaped0 + 1
+        assert _ctr("fleet.launcher.stops") >= 1
+    finally:
+        ln.stop()
+        ctl.shutdown()
+
+
+def test_scale_intent_channel_is_bounded(fleet_key):
+    ctl = FleetController(lease_ttl=30.0, sweep_interval=0)
+    try:
+        for i in range(300):
+            f = fauth.signed_fields("scale_up", "_fleet", {"n": i})
+            ctl._add_scale_intent("scale_up", {"n": i}, f["nonce"],
+                                  f["sig"])
+        tail = ctl._scale_intents_since(0)
+        assert len(tail) <= 256  # bounded, late-joiner-meaningless
+        assert tail[-1]["seq"] == 300  # newest survive the trim
+    finally:
+        ctl.shutdown()
+
+
+# --- router: draining + fleet-wide gauges -------------------------------
+
+def test_router_skips_draining_and_zeroes_gauges(tmp_path):
+    from paddle_tpu.serving.__main__ import make_model_dir
+
+    d1, probe, _ref = make_model_dir(str(tmp_path / "v1"))
+    ctl = FleetController(lease_ttl=30.0, sweep_interval=0)
+    ctl_addr = ctl.serve()
+    servers, members = [], []
+    router = None
+    try:
+        for rid in ("r0", "r1"):
+            srv = ServingServer()
+            addr = srv.serve()
+            servers.append(srv)
+            cli = ServingClient(addr)
+            cli.load_model("m", d1, buckets=[4], max_wait_ms=1.0)
+            cli.close()
+            members.append(FleetMember(srv, ctl_addr, replica_id=rid,
+                                       beat_interval=0.05))
+        assert all(m.wait_registered(30.0) for m in members)
+        router = FleetRouter(ctl_addr, scrape_ttl=0.0, replica_ttl=0.0)
+        router.infer("m", {"x": probe})
+        assert metrics.gauge("fleet.replicas_live").value() == 2
+        headroom_both = metrics.gauge("fleet.queue_headroom").value()
+        assert headroom_both > 0
+        # drain r0: NEW requests all land on r1, and the CAPACITY
+        # gauges stop counting the draining replica's pages/headroom —
+        # but replicas_live still counts it (it is reachable and
+        # finishing in-flight work)
+        ctl._set_draining("r0", True)
+        r0_before = metrics.counter("fleet.routed.r0").value()
+        for _ in range(4):
+            router.infer("m", {"x": probe})
+        assert metrics.counter("fleet.routed.r0").value() == r0_before
+        assert metrics.gauge("fleet.replicas_live").value() == 2
+        assert metrics.gauge("fleet.queue_headroom").value() \
+            < headroom_both
+        # undrain: capacity returns to the pool
+        ctl._set_draining("r0", False)
+        router.infer("m", {"x": probe})
+        assert metrics.gauge("fleet.queue_headroom").value() \
+            == headroom_both
+        # N205: a closed router's last scrape is not live capacity
+        router.close()
+        assert metrics.gauge("fleet.replicas_live").value() == 0
+        assert metrics.gauge("fleet.free_pages_total").value() == 0
+        assert metrics.gauge("fleet.queue_headroom").value() == 0
+    finally:
+        if router is not None:
+            router.close()
+        for m in members:
+            m.stop(deregister=False)
+        for srv in servers:
+            srv.shutdown(drain=False)
+        ctl.shutdown()
+
+
+# --- the fleet soak (slow lane) -----------------------------------------
+
+@pytest.mark.slow
+def test_fleet_soak_smoke(tmp_path):
+    """The full ISSUE 17 choreography in subprocesses: bootstrap ->
+    traffic scale-up -> SIGKILL mid-stream -> v2 rollout with a SIGKILL
+    mid-rollout -> poison refused fleet-wide -> cache-aware drain.
+    Asserts on the evidence JSON, which the soak writes even on
+    failure."""
+    out = str(tmp_path / "evidence.json")
+    proc = subprocess.run(
+        [sys.executable, "tools/chaos_soak.py", "--fleet", "--smoke",
+         "--seed", "7", "--out", out],
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, \
+        f"stdout:\n{proc.stdout[-8000:]}\nstderr:\n{proc.stderr[-3000:]}"
+    with open(out) as fh:
+        ev = json.load(fh)
+    assert ev["ok"] and all(c["ok"] for c in ev["checks"])
+    assert ev["traffic"]["dropped"] == 0
+    assert ev["traffic"]["corrupted"] == 0
+    assert ev["traffic"]["completed"] >= 20
+    assert ev["metrics"]["fleet.launcher.restarts"] >= 2
+    assert ev["metrics"]["fleet.scale.up_intents"] >= 3
+    assert ev["metrics"]["fleet.scale.down_intents"] >= 1
