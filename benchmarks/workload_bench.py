@@ -1,0 +1,222 @@
+"""Mixed-workload serving evidence (ISSUE 20 -> BENCH_SESSION_r15.json):
+ONE replica serving all four workload kinds CONCURRENTLY — generate,
+constrained (TokenMaskSpec-masked logits), embed (prompt-only, zero
+decode slots), and beam (k siblings over refcount-shared prompt
+pages) — with zero post-warm compiles across the whole churn.
+
+Why this is the interesting number: every kind rides mechanism the
+engine already warms (the slot/width/chunk ladder plus the opt-in
+embed lane), so kind-mixing must cost NO new compiled shapes — the
+workload layer is scheduling + host-side masking + page refcounts,
+never a new program. The bench drives a seeded mix from worker
+threads, then asserts:
+
+  * ``serving.decode.compiles`` delta == 0 post-warm (the r07 pin);
+  * every ``serving.workload.<kind>.ms`` latency histogram populated;
+  * embeddings completed while ``live slots`` stayed untouched by
+    them (the embed lane is counter-pinned out of the decode slots);
+  * beams shared prompt pages (``prefix_shared_pages`` observed > 0
+    during the churn) and every constrained output satisfied its
+    mask's language.
+
+Evidence JSON goes to stdout AND the repo root (or ``--out PATH``) so
+the session artifact convention (BENCH_SESSION_rNN.json) holds.
+"""
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _timing import framework_metrics  # noqa: E402
+
+SMOKE = "--smoke" in sys.argv
+REQUESTS = int(os.environ.get("WL_REQUESTS", "24" if SMOKE else "64"))
+WORKERS = int(os.environ.get("WL_WORKERS", "6"))
+PAGE = int(os.environ.get("WL_PAGE", "4"))
+MAXSEQ = int(os.environ.get("WL_MAXSEQ", "32"))
+BEAM_K = int(os.environ.get("WL_BEAM_K", "3"))
+
+KINDS = ("generate", "constrained", "embed", "beam")
+
+
+def _counters(*names):
+    from paddle_tpu.observability import metrics
+
+    snap = metrics.snapshot()
+    return {n: snap.get(n, 0) for n in names}
+
+
+def _mask_accepts(mask_spec, tokens):
+    """Replay ``tokens`` through the mask automaton: every step must
+    be allowed (the constrained-output check, independent of the
+    engine's own masking)."""
+    auto = mask_spec.compile()
+    state = auto.start
+    for t in tokens:
+        if not bool(auto.allowed(state, 32)[int(t)]):
+            return False
+        state = auto.step(state, int(t))
+        if state is None:
+            return len(tokens) and t == tokens[-1]
+    return True
+
+
+def main() -> int:
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving import DecodeEngine, DecoderSpec
+    from paddle_tpu.serving.workloads import TokenMaskSpec, run_workload
+
+    out_path = None
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_path = out_path or os.path.join(repo, "BENCH_SESSION_r15.json")
+
+    spec = DecoderSpec(vocab=32, d_model=16, n_layers=2, n_heads=2,
+                       n_kv_heads=1, seed=7)
+    # enough pages that admission throttles but never starves: the mix
+    # includes beams that hold k children at once
+    eng = DecodeEngine(spec, name="bench_mix", slots=[1, 2, 4],
+                       page_size=PAGE, num_pages=256, max_seq_len=MAXSEQ,
+                       prefill_chunk=8, prefix_cache=True,
+                       embeddings=True)
+    rng = np.random.RandomState(41)
+    masks = [TokenMaskSpec.regex("5 ( 7 | 9 ) + 11"),
+             TokenMaskSpec.regex("( 1 | 2 | 3 ) * 4"),
+             TokenMaskSpec.one_of([[8, 9, 10], [8, 6, 4, 2]])]
+
+    def job(i):
+        kind = KINDS[i % len(KINDS)]
+        prompt = [int(t) for t in
+                  rng.randint(0, 32, size=int(rng.randint(4, 12)))]
+        if kind == "generate":
+            w = {"kind": "generate", "prompt": prompt,
+                 "max_new_tokens": 6, "temperature": 0.8, "top_k": 8,
+                 "seed": 100 + i}
+        elif kind == "constrained":
+            w = {"kind": "constrained", "prompt": prompt,
+                 "mask": masks[i % len(masks)].to_dict(),
+                 "max_new_tokens": 8, "seed": 200 + i}
+        elif kind == "embed":
+            w = {"kind": "embed", "prompt": prompt}
+        else:
+            w = {"kind": "beam", "prompt": prompt, "k": BEAM_K,
+                 "max_new_tokens": 4}
+        return i, kind, w, run_workload(eng, w)
+
+    names = ("serving.decode.compiles", "serving.decode.requests",
+             "serving.decode.embed.requests", "serving.decode.masked_tokens")
+    shared_seen = [0]
+    live_during_embed = []
+    stop_probe = threading.Event()
+
+    def probe():
+        # sample the sharing + slot-occupancy evidence WHILE the churn
+        # runs — both are transient (beams free their pages at
+        # completion, embed slots drain)
+        while not stop_probe.is_set():
+            st = eng.stats()
+            ps = st.get("prefix") or {}
+            shared_seen[0] = max(shared_seen[0],
+                                 int(ps.get("shared", 0)))
+            if st["live_embed"]:
+                live_during_embed.append(
+                    (st["live"], st["live_embed"]))
+            time.sleep(0.002)
+
+    try:
+        before = _counters(*names)
+        shapes_before = len(eng.stats()["compiled_shapes"])
+        prober = threading.Thread(target=probe, daemon=True)
+        prober.start()
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=WORKERS) as pool:
+            results = list(pool.map(job, range(REQUESTS)))
+        wall_s = time.perf_counter() - t0
+        stop_probe.set()
+        prober.join(timeout=2)
+        after = _counters(*names)
+        shapes_after = len(eng.stats()["compiled_shapes"])
+    finally:
+        eng.stop()
+
+    by_kind = {k: 0 for k in KINDS}
+    mask_ok = True
+    embed_dims = set()
+    beam_shared_pages = []
+    beam_cached = []
+    for i, kind, w, r in results:
+        by_kind[kind] += 1
+        if kind == "constrained":
+            mask_ok = mask_ok and _mask_accepts(
+                TokenMaskSpec.from_dict(w["mask"]), r["tokens"])
+        elif kind == "embed":
+            embed_dims.add(len(r["embedding"]))
+        elif kind == "beam":
+            beam_shared_pages.append(r["shared_prompt_pages"])
+            beam_cached.extend(r["cached_tokens"])
+
+    snap = metrics.snapshot()
+    hist = {k: snap.get(f"serving.workload.{k}.ms") for k in KINDS}
+    compiles = after["serving.decode.compiles"] \
+        - before["serving.decode.compiles"]
+
+    checks = {
+        "post_warm_compiles_zero": compiles == 0
+        and shapes_after == shapes_before,
+        "all_kinds_served": all(by_kind[k] > 0 for k in KINDS),
+        "per_kind_histograms_populated": all(
+            h and h["count"] >= by_kind[k]
+            for k, h in hist.items()),
+        "constrained_outputs_in_language": mask_ok,
+        "embed_dims_consistent": embed_dims == {spec.d_model},
+        "beam_pages_shared": max(beam_shared_pages or [0]) > 0
+        and shared_seen[0] > 0,
+        "beam_children_prefix_hits": all(c > 0 for c in beam_cached),
+        "embed_rode_zero_decode_slots":
+            after["serving.decode.embed.requests"]
+            - before["serving.decode.embed.requests"] == by_kind["embed"],
+    }
+    evidence = {
+        "what": "workload_bench: one replica, four workload kinds "
+                "concurrently (generate/constrained/embed/beam), zero "
+                "post-warm compiles (ISSUE 20)",
+        "smoke": SMOKE,
+        "spec": spec.to_dict(),
+        "requests": REQUESTS,
+        "workers": WORKERS,
+        "beam_k": BEAM_K,
+        "by_kind": by_kind,
+        "wall_s": round(wall_s, 3),
+        "post_warm_compiles": compiles,
+        "masked_tokens": after["serving.decode.masked_tokens"]
+        - before["serving.decode.masked_tokens"],
+        "max_shared_prompt_pages_observed": shared_seen[0],
+        "beam_shared_prompt_pages": beam_shared_pages,
+        "beam_child_cached_tokens_min":
+            min(beam_cached) if beam_cached else None,
+        "embed_slot_samples": live_during_embed[:8],
+        "per_kind_latency_ms": hist,
+        "checks": checks,
+        "ok": all(checks.values()),
+        "framework_metrics": framework_metrics(),
+    }
+    print(json.dumps(evidence))
+    with open(out_path, "w") as f:
+        json.dump(evidence, f, indent=1, sort_keys=True)
+        f.write("\n")
+    if not evidence["ok"]:
+        failing = [k for k, v in checks.items() if not v]
+        print(f"FAILING CHECKS: {failing}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

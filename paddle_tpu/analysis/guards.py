@@ -79,7 +79,9 @@ _MUTATORS = {
 _CONTAINER_CTORS = {"dict", "list", "set", "deque", "OrderedDict",
                     "defaultdict", "Counter"}
 
-_DECL_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+# the declaration may ride a comment with leading prose
+# ("# rid -> state; guarded-by: _mu"), not only start it
+_DECL_RE = re.compile(r"#.*?\bguarded-by:\s*([A-Za-z_]\w*)")
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow-unguarded(?:\(([^)]*)\))?")
 _ALIAS_RE = re.compile(r"#\s*lint:\s*lock-alias\b")
 

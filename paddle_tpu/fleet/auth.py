@@ -30,6 +30,15 @@ Key absent AND allowlist empty = OPEN MODE: verification is skipped
 entirely and the fleet behaves bit-identically to the unsigned PR 11
 protocol (old members and old controllers interoperate).
 
+KEY ROTATION (ISSUE 20): every verifier accepts a DUAL-KEY window —
+``intent_key_prev()`` (``PADDLE_TPU_FLEET_KEY_PREV`` env /
+``FLAGS["fleet_intent_key_prev"]``) is tried when the current key's
+HMAC fails, so a fleet rotates without a global stop: (1) set
+key_prev=old, key=new on every verifier, (2) flip producers to the new
+key, (3) clear key_prev once ``fleet.auth.verified.prev_key`` stops
+moving. Producers only ever sign with the CURRENT key, and the nonce
+replay window is shared across both keys.
+
 Refusals are typed (``IntentRefused``, with a machine-readable
 ``reason``) and counted: ``fleet.auth.refused`` totals them and
 ``fleet.auth.refused.<reason>`` splits them by cause; accepted
@@ -49,11 +58,15 @@ from ..serving.errors import ServingError
 
 __all__ = ["IntentRefused", "NonceWindow", "canonical_intent",
            "sign_intent", "signed_fields", "verify_intent",
-           "check_allowlist", "intent_key", "intent_allowlist",
-           "PATH_FIELDS"]
+           "check_allowlist", "intent_key", "intent_key_prev",
+           "intent_allowlist", "PATH_FIELDS"]
 
 _m_verified = _metrics.counter("fleet.auth.verified")
 _m_refused = _metrics.counter("fleet.auth.refused")
+# intents that verified ONLY under the previous key during a rotation
+# window — a rotation is complete (prev key safe to drop) when this
+# stops moving
+_m_verified_prev = _metrics.counter("fleet.auth.verified.prev_key")
 
 # payload fields that name filesystem paths a replica will open —
 # exactly the deploy surface the allowlist fences
@@ -95,6 +108,22 @@ def intent_key() -> Optional[str]:
     from ..fluid.flags import FLAGS
 
     key = os.environ.get("PADDLE_TPU_FLEET_KEY") or FLAGS["fleet_intent_key"]
+    return str(key) if key else None
+
+
+def intent_key_prev() -> Optional[str]:
+    """The PREVIOUS fleet key, accepted (verify-only) during a key
+    rotation window (``PADDLE_TPU_FLEET_KEY_PREV`` env or
+    ``FLAGS["fleet_intent_key_prev"]``). Rotation protocol: set
+    key_prev = old key, key = new key on every verifier FIRST, then
+    flip producers to the new key, then clear key_prev. Producers
+    always SIGN with the current key — the previous key can only ever
+    accept old signatures, never mint new ones. Irrelevant in open
+    mode (no current key = no verification at all)."""
+    from ..fluid.flags import FLAGS
+
+    key = (os.environ.get("PADDLE_TPU_FLEET_KEY_PREV")
+           or FLAGS["fleet_intent_key_prev"])
     return str(key) if key else None
 
 
@@ -188,10 +217,18 @@ class NonceWindow:
 
 
 def verify_intent(key: Optional[str], intent: Dict[str, Any],
-                  window: Optional[NonceWindow] = None) -> None:
+                  window: Optional[NonceWindow] = None,
+                  prev_key: Optional[str] = None) -> None:
     """Verify one intent record against `key` (no-op when key is
     falsy — open mode). Raises IntentRefused (counted) on an unsigned,
-    tampered, or replayed intent."""
+    tampered, or replayed intent.
+
+    ``prev_key`` (ISSUE 20) is the dual-key rotation window: a
+    signature that fails the current key is retried against the
+    previous one, so intents signed before a mid-flight key flip still
+    land while producers catch up. The nonce window is SHARED across
+    both keys — re-signing a captured intent's nonce under either key
+    is still a replay."""
     if not key:
         return
     action = str(intent.get("action"))
@@ -204,10 +241,19 @@ def verify_intent(key: Optional[str], intent: Dict[str, Any],
                      f"intent #{intent.get('seq')} ({action} {model}) "
                      "carries no signature but this fleet requires one")
     want = sign_intent(key, action, model, payload, str(nonce))
+    via_prev = False
     if not hmac.compare_digest(str(sig), want):
-        raise refuse("bad_signature",
-                     f"intent #{intent.get('seq')} ({action} {model}) "
-                     "signature does not match its canonical payload")
+        want_prev = (sign_intent(prev_key, action, model, payload,
+                                 str(nonce)) if prev_key else None)
+        if want_prev is None or \
+                not hmac.compare_digest(str(sig), want_prev):
+            raise refuse(
+                "bad_signature",
+                f"intent #{intent.get('seq')} ({action} {model}) "
+                "signature matches neither the current fleet key"
+                + (" nor the rotation window's previous key"
+                   if prev_key else ""))
+        via_prev = True
     if window is not None and not window.admit(
             str(nonce), int(intent.get("seq") or 0)):
         raise refuse("replayed",
@@ -215,6 +261,8 @@ def verify_intent(key: Optional[str], intent: Dict[str, Any],
                      f"reuses nonce {nonce!r} — replay of an already-"
                      "verified intent")
     _m_verified.inc()
+    if via_prev:
+        _m_verified_prev.inc()
 
 
 def check_allowlist(allow: List[str], intent: Dict[str, Any]) -> None:

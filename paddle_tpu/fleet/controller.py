@@ -395,7 +395,8 @@ class FleetController:
         if sig is not None:
             record["sig"] = str(sig)
         _auth.verify_intent(_auth.intent_key(), record,
-                            window=self._nonces)
+                            window=self._nonces,
+                            prev_key=_auth.intent_key_prev())
         with self._mu:
             self._next_seq += 1
             seq = record["seq"] = self._next_seq
@@ -475,7 +476,8 @@ class FleetController:
         if sig is not None:
             record["sig"] = str(sig)
         _auth.verify_intent(_auth.intent_key(), record,
-                            window=self._nonces)
+                            window=self._nonces,
+                            prev_key=_auth.intent_key_prev())
         with self._mu:
             self._next_scale_seq += 1
             seq = record["seq"] = self._next_scale_seq

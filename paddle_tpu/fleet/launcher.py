@@ -212,7 +212,8 @@ class ReplicaLauncher:
                 # re-verify even though the controller checked at
                 # append — a spoofed controller must not command spawns
                 _auth.verify_intent(_auth.intent_key(), intent,
-                                    window=self._nonces)
+                                    window=self._nonces,
+                                    prev_key=_auth.intent_key_prev())
             except _auth.IntentRefused as e:
                 _log.error("fleet launcher: scale intent #%d REFUSED: "
                            "%s", seq, e)

@@ -335,7 +335,8 @@ class FleetMember:
             # A refusal is typed + counted by auth; the seq still
             # advances — same poisoned-intent discipline as below.
             _auth.verify_intent(_auth.intent_key(), intent,
-                                window=self._nonces)
+                                window=self._nonces,
+                                prev_key=_auth.intent_key_prev())
             _auth.check_allowlist(_auth.intent_allowlist(), intent)
         except _auth.IntentRefused as e:
             _log.error("fleet member %s: intent #%s REFUSED: %s",

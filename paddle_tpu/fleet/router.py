@@ -528,6 +528,36 @@ class FleetRouter:
             lambda cli, _rid: cli.generate(str(model), prompt, **kw),
             prompt=prompt)
 
+    def workload(self, model: str, workload: Dict[str, Any]
+                 ) -> Dict[str, Any]:
+        """Route one typed workload (ISSUE 20) with KIND-AWARE
+        admission math: the page-pool check a candidate must pass
+        depends on what the kind will actually reserve — embed holds
+        exactly the prompt's pages (max_new = 0), beam holds the
+        parent's prompt + 1 plus k COW tails over SHARED prompt pages
+        (so ~prompt + k×max_new new tokens, not k×(prompt + max_new)),
+        generate/constrained the usual prompt + max_new. Prefix-warmth
+        ranking applies to all kinds — a replica whose cache covers the
+        prompt prefills only the suffix, for beams twice over (every
+        child forks from it). Dedup-safe like generate: a retransmit is
+        answered from the replica's reply cache."""
+        from ..serving.workloads import parse_workload
+
+        w = parse_workload(workload)  # refuse bad kinds BEFORE routing
+        wire = w.to_dict()
+        prompt = [int(t) for t in wire["prompt"]]
+        if w.kind == "embed":
+            need = len(prompt)
+        elif w.kind == "beam":
+            need = (len(prompt) + 1
+                    + int(wire["k"]) * int(wire["max_new_tokens"]))
+        else:
+            need = len(prompt) + int(wire["max_new_tokens"])
+        return self._route(
+            str(model), need,
+            lambda cli, _rid: cli.workload(str(model), wire),
+            prompt=prompt)
+
     def replicas(self) -> List[str]:
         """Live replica ids (cached discovery view)."""
         return sorted(self.refresh())

@@ -272,6 +272,12 @@ FLAGS: Dict[str, Any] = _Flags({
     # dirname/draft_checkpoint_dir payload path must resolve under
     # (PADDLE_TPU_FLEET_ALLOW env wins; '' = unrestricted)
     "fleet_intent_key": "",
+    # previous fleet key, ACCEPTED (verify-only) during a key rotation
+    # window (PADDLE_TPU_FLEET_KEY_PREV env wins). Producers always
+    # sign with fleet_intent_key; set this to the old key on every
+    # verifier before flipping producers, clear it when
+    # fleet.auth.verified.prev_key stops moving. '' = no window
+    "fleet_intent_key_prev": "",
     "fleet_intent_allowlist": "",
 })
 

@@ -391,6 +391,82 @@ def run_selftest(verbose: bool = True) -> int:
         finally:
             s_on.stop()
 
+        # -- 7. typed workloads (ISSUE 20) -------------------------------
+        from .workloads import TokenMaskSpec, parse_workload, run_workload
+
+        weng = DecodeEngine(spec, name="workloads", slots=[1, 2],
+                            page_size=4, num_pages=64, max_seq_len=32,
+                            prefill_chunk=4, prefix_cache=True,
+                            embeddings=True)
+        try:
+            wl_shapes = len(weng.stats()["compiled_shapes"])
+            # constrained decode: output in the mask's language, ends
+            # when the automaton exhausts
+            mask = TokenMaskSpec.regex("5 ( 7 | 9 ) 11")
+            c1 = weng.generate([1, 2], max_new_tokens=8, mask=mask)
+            check(len(c1["tokens"]) == 3 and c1["tokens"][0] == 5
+                  and c1["tokens"][1] in (7, 9) and c1["tokens"][2] == 11,
+                  f"constrained decode stayed in the mask language "
+                  f"({c1['tokens']})")
+            # batch-composition independence: same (seed, mask, prompt)
+            # under concurrent load, bitwise-identical tokens
+            cs1 = weng.generate([1, 2], max_new_tokens=8,
+                                mask=mask.to_dict(), temperature=0.9,
+                                top_k=8, seed=5)
+            bg = [weng.submit([9, 9, int(i)], max_new_tokens=6)
+                  for i in range(3)]
+            cs2 = weng.generate([1, 2], max_new_tokens=8,
+                                mask=mask.to_dict(), temperature=0.9,
+                                top_k=8, seed=5)
+            check(all(r.ev.wait(120) for r in bg)
+                  and cs2["tokens"] == cs1["tokens"],
+                  "constrained sampling batch-composition-independent "
+                  "(idle == loaded, bitwise)")
+            # embeddings: zero decode slots consumed
+            dreq = _metrics.counter("serving.decode.requests")
+            base_dreq = dreq.value()
+            embeds = [weng.submit_embed(list(range(2 + i)))
+                      for i in range(4)]
+            ok = all(e.ev.wait(120) and e.error is None for e in embeds)
+            live_g = _metrics.gauge(
+                "serving.decode.live_slots.workloads.v1")
+            check(ok and all(
+                len(e.result["embedding"]) == spec.d_model
+                and len(e.result["logprobs"]) == len(e.prompt) - 1
+                for e in embeds),
+                "embeddings pooled d_model dims + per-token logprobs")
+            check(dreq.value() == base_dreq and live_g.value() == 0,
+                  "embeddings completed with decode live_slots "
+                  "untouched (zero slots, zero decode requests)")
+            # beam: page sharing proven by counters, tokens by equality
+            bres = run_workload(weng, {
+                "kind": "beam", "prompt": [3, 1, 4, 1, 5, 9, 2, 6],
+                "k": 3, "max_new_tokens": 5})
+            check(bres["kind"] == "beam" and len(bres["beams"]) == 3
+                  and bres["shared_prompt_pages"] > 0
+                  and all(c > 0 for c in bres["cached_tokens"]),
+                  f"beam children shared prompt pages "
+                  f"({bres['shared_prompt_pages']} refcounted, "
+                  f"{bres['cached_tokens']} cached tokens)")
+            inds = [weng.generate([3, 1, 4, 1, 5, 9, 2, 6, b[0]],
+                                  max_new_tokens=4)["tokens"]
+                    for b in bres["beams"]]
+            check(all(b[1:] == ind
+                      for b, ind in zip(bres["beams"], inds)),
+                  "temp-0 beams bitwise equal independent decodes")
+            # dispatch layer: unknown kinds refuse before any engine work
+            try:
+                parse_workload({"kind": "nope", "prompt": [1]})
+                check(False, "unknown workload kind refused")
+            except ValueError:
+                check(True, "unknown workload kind refused (ValueError)")
+            check(len(weng.stats()["compiled_shapes"]) == wl_shapes,
+                  "workload mix performed 0 post-warm compiles")
+            check(weng.cache.allocator.stats()["pages_used"] == 0,
+                  "workload mix returned every KV page")
+        finally:
+            weng.stop()
+
         # decode over RPC with a hot-swap
         srv2 = ServingServer()
         addr2 = srv2.serve()
@@ -424,6 +500,29 @@ def run_selftest(verbose: bool = True) -> int:
             out3 = cli2.generate("dec_ck", [3, 1], max_new_tokens=4)
             check(out3["tokens"] == out["tokens"],
                   "checkpoint_dir deploy serves bitwise the same model")
+            # typed workloads over RPC (ISSUE 20): one "workload"
+            # method, kind-dispatched server-side
+            cli2.load_decoder("wl", spec.to_dict(), slots=[1, 2],
+                              page_size=4, num_pages=32, max_seq_len=16,
+                              prefix_cache=True, embeddings=True)
+            from .workloads import TokenMaskSpec as _TMS
+
+            wc = cli2.constrained("wl", [1, 2],
+                                  _TMS.regex("5 ( 7 | 9 ) 11"),
+                                  max_new_tokens=6)
+            check(wc["kind"] == "constrained"
+                  and wc["tokens"][0] == 5 and wc["tokens"][-1] == 11,
+                  "RPC constrained workload decoded in-language")
+            we = cli2.embed("wl", [1, 2, 3, 4])
+            check(len(we["embedding"]) == spec.d_model
+                  and len(we["logprobs"]) == 3,
+                  "RPC embed workload returned pooled states + "
+                  "logprobs")
+            wb = cli2.beam("wl", [3, 1, 4, 1, 5, 9], k=2,
+                           max_new_tokens=4)
+            check(len(wb["beams"]) == 2
+                  and wb["shared_prompt_pages"] > 0,
+                  "RPC beam workload shared prompt pages")
         finally:
             cli2.close()
             srv2.shutdown()

@@ -202,6 +202,61 @@ class ServingClient:
         except RuntimeError as e:
             _raise_typed(e)
 
+    # -- typed workloads (ISSUE 20) ---------------------------------------
+    def workload(self, model: str, workload: Dict[str, Any]
+                 ) -> Dict[str, Any]:
+        """Run one typed workload — a dict with a ``kind`` field
+        ('generate' | 'constrained' | 'embed' | 'beam'; see
+        serving.workloads.parse_workload for each kind's fields) — on a
+        loaded decoder. Unknown kinds/fields refuse server-side before
+        any engine work. Transport retries are dedup-safe: a
+        retransmitted workload (beam included) is answered from the
+        server's reply cache, never re-decoded."""
+        try:
+            return self._rpc.call("workload", model, dict(workload))
+        except RuntimeError as e:
+            _raise_typed(e)
+
+    def constrained(self, model: str, prompt: Sequence[int], mask: Any,
+                    max_new_tokens: int = 16,
+                    deadline_ms: Optional[float] = None,
+                    temperature: float = 0.0, top_k: int = 0,
+                    seed: int = 0) -> Dict[str, Any]:
+        """Grammar-constrained decode: ``mask`` is a TokenMaskSpec or
+        its wire dict; disallowed tokens are masked from the logits
+        before the per-(seed, position) choice, so output is exactly as
+        deterministic as unconstrained generate."""
+        if hasattr(mask, "to_dict"):
+            mask = mask.to_dict()
+        return self.workload(model, {
+            "kind": "constrained", "prompt": [int(t) for t in prompt],
+            "mask": dict(mask), "max_new_tokens": int(max_new_tokens),
+            "deadline_ms": deadline_ms,
+            "temperature": float(temperature), "top_k": int(top_k),
+            "seed": int(seed)})
+
+    def embed(self, model: str, prompt: Sequence[int],
+              deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+        """Prompt-only embedding/scoring: mean-pooled final hidden
+        state + per-token logprobs, served from the decoder's embed
+        lane (load it with ``embeddings=True``) without occupying any
+        decode slot."""
+        return self.workload(model, {
+            "kind": "embed", "prompt": [int(t) for t in prompt],
+            "deadline_ms": deadline_ms})
+
+    def beam(self, model: str, prompt: Sequence[int], k: int = 2,
+             max_new_tokens: int = 16,
+             deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+        """n-best decode: the k best single-token forks, each decoded
+        greedily to ``max_new_tokens``, sharing the prompt's KV pages
+        via the server decoder's prefix index (load with
+        ``prefix_cache=True``)."""
+        return self.workload(model, {
+            "kind": "beam", "prompt": [int(t) for t in prompt],
+            "k": int(k), "max_new_tokens": int(max_new_tokens),
+            "deadline_ms": deadline_ms})
+
     def load_decoder(self, model: str,
                      spec: Optional[Dict[str, Any]] = None,
                      version: Optional[int] = None,
@@ -217,7 +272,8 @@ class ServingClient:
                      draft_spec: Optional[Dict[str, Any]] = None,
                      draft_checkpoint_dir: Optional[str] = None,
                      spec_k: Optional[int] = None,
-                     mesh_axes: Optional[str] = None
+                     mesh_axes: Optional[str] = None,
+                     embeddings: bool = False
                      ) -> Dict[str, Any]:
         """Deploy a DecodeEngine; hot-swaps like load_model. From a
         ``spec`` dict (see serving.decode.DecoderSpec) the server
@@ -239,7 +295,10 @@ class ServingClient:
         replica SPAN chips — params shard per the decoder rules and the
         paged KV pool shards over the kv-head axis; '' pins single-chip
         even when the checkpoint recorded a mesh, None defers to the
-        checkpoint's recording, then the server's FLAGS."""
+        checkpoint's recording, then the server's FLAGS.
+        ``embeddings=True`` (ISSUE 20) warms the embed lane's compiled
+        shapes so the decoder also serves prompt-only
+        embedding/scoring workloads."""
         try:
             return self._rpc.call(
                 "load_decoder", model,
@@ -254,7 +313,8 @@ class ServingClient:
                 (None if draft_checkpoint_dir is None
                  else str(draft_checkpoint_dir)),
                 None if spec_k is None else int(spec_k),
-                None if mesh_axes is None else str(mesh_axes))
+                None if mesh_axes is None else str(mesh_axes),
+                bool(embeddings))
         except RuntimeError as e:
             _raise_typed(e)
 

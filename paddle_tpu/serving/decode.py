@@ -148,6 +148,15 @@ _m_spec_accepted = _metrics.counter("serving.decode.spec.accepted")
 _m_spec_rejected = _metrics.counter("serving.decode.spec.rejected")
 _m_spec_accept_rate = _metrics.histogram(
     "serving.decode.spec.accept_rate")
+# workload layer (ISSUE 20): constrained decode applies a token-mask
+# automaton to the logits row before the per-(seed, position) choice
+# (masked_tokens counts them); prompt-only embedding/scoring requests
+# ride the chunked-prefill path in their OWN slot lane — decode
+# live_slots never moves for them (counter-pinned in tier-1)
+_m_masked_tokens = _metrics.counter("serving.decode.masked_tokens")
+_m_embed_requests = _metrics.counter("serving.decode.embed.requests")
+_m_embed_steps = _metrics.counter("serving.decode.embed.steps")
+_m_embed_tokens = _metrics.counter("serving.decode.embed.tokens")
 
 
 # --- the pluggable decoder model ----------------------------------------
@@ -288,7 +297,8 @@ def _pos_encoding(positions, d_model):
 
 def decoder_step_chunked(params, spec: DecoderSpec, tokens, positions,
                          q_lens, k_pool, v_pool, page_tables, kv_lens,
-                         all_lanes: bool = False):
+                         all_lanes: bool = False,
+                         return_hidden: bool = False):
     """ONE mixed decode/prefill step for a fixed-slot batch
     (ISSUE 10). Each slot carries up to C tokens of ITS sequence — a
     prefill chunk, a single decode token at C lane 0, or nothing —
@@ -318,6 +328,13 @@ def decoder_step_chunked(params, spec: DecoderSpec, tokens, positions,
     The full-lane unembed is exactly the price of verification (C =
     spec_k + 1 lanes, not the prefill chunk width); acceptance happens
     host-side in the engine.
+
+    ``return_hidden=True`` (requires ``all_lanes``) additionally
+    returns the final-norm hidden states ``[B, C, d_model]`` — the
+    EMBEDDING/SCORING form (ISSUE 20): one chunked call yields both
+    every lane's pooled-representation input and its next-token
+    distribution (per-token logprobs), so prompt-only scoring requests
+    ride the exact prefill path generation uses.
     """
     import jax
     import jax.numpy as jnp
@@ -360,7 +377,10 @@ def decoder_step_chunked(params, spec: DecoderSpec, tokens, positions,
         # verify form: every lane's logits ([B, C, vocab]) — the
         # acceptance walk needs the target's distribution at each
         # proposed position, not just the newest
-        logits = _ln(x, params["lnf"]) @ params["tok_emb"].T
+        h = _ln(x, params["lnf"])
+        logits = h @ params["tok_emb"].T
+        if return_hidden:
+            return k_pool, v_pool, logits, h
         return k_pool, v_pool, logits
     # unembed only each slot's newest lane (dead slots gather lane 0 —
     # garbage the scheduler never samples)
@@ -442,11 +462,13 @@ class _DecodeRequest:
                  "t_enq", "seq_id", "trace_ctx", "temperature", "top_k",
                  "seed", "produced", "cached_tokens", "cow", "resume_pos",
                  "published", "carry_steps", "carry_fts", "needs_alloc",
-                 "resume_dpos", "spec_proposed", "spec_accepted")
+                 "resume_dpos", "spec_proposed", "spec_accepted",
+                 "mask", "mask_state", "want_topk", "first_topk")
 
     def __init__(self, prompt: np.ndarray, max_new: int,
                  deadline: Optional[float], seq_id: int,
-                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 mask: Optional[Any] = None, want_topk: int = 0):
         self.prompt = prompt
         self.max_new = int(max_new)
         self.deadline = deadline
@@ -487,6 +509,17 @@ class _DecodeRequest:
         self.resume_dpos: Optional[int] = None
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # constrained decode (ISSUE 20): a compiled MaskAutomaton and
+        # its current state. On the REQUEST (not the slot) because the
+        # state must survive preemption round-trips — produced tokens
+        # never roll back on the plain path, so the automaton resumes
+        # exactly where it stopped. want_topk asks the answer phase to
+        # capture the FIRST generated position's top-k token order
+        # (first_topk) — the n-best/beam fork point.
+        self.mask = mask
+        self.mask_state = mask.start if mask is not None else 0
+        self.want_topk = int(want_topk)
+        self.first_topk: Optional[List[int]] = None
 
     def fail(self, err: BaseException):
         self.error = err
@@ -523,6 +556,53 @@ class _Slot:
                 else self.req.produced[idx - len(p)])
 
 
+class _EmbedRequest:
+    """A prompt-only embedding/scoring request (ISSUE 20): admitted by
+    the same reserve-at-admission math with ``max_new = 0`` (the
+    reservation is exactly the prompt's pages — there is no decode
+    tail to headroom for), prefilled by the same chunked step, and
+    NEVER occupying a decode slot: the embed lane has its own slot
+    list and gauge, so ``serving.decode.live_slots`` is pinned
+    unchanged while embeddings flow. Carries ``cow``/``seq_id``/
+    ``fail`` so ``_fail_locked`` treats both request classes
+    uniformly."""
+
+    __slots__ = ("prompt", "deadline", "ev", "result", "error", "t_enq",
+                 "seq_id", "trace_ctx", "cow", "hidden_sum", "logprobs")
+
+    def __init__(self, prompt: np.ndarray, deadline: Optional[float],
+                 seq_id: int, d_model: int):
+        self.prompt = prompt
+        self.deadline = deadline
+        self.ev = threading.Event()
+        self.result: Optional[Dict[str, Any]] = None
+        self.error: Optional[BaseException] = None
+        self.t_enq = time.monotonic()
+        self.seq_id = seq_id
+        self.trace_ctx = _tracing.wire_context()
+        self.cow: Optional[Dict[str, int]] = None
+        # float64 running sum of final-norm hidden states — mean-pooled
+        # over the prompt at completion — and the per-token logprobs
+        # (position p scores prompt[p+1]; P-1 values for a P-token
+        # prompt), both appended by the embed answer phase under _cond
+        self.hidden_sum = np.zeros(d_model, np.float64)
+        self.logprobs: List[float] = []
+
+    def fail(self, err: BaseException):
+        self.error = err
+        self.ev.set()
+
+
+class _EmbedSlot:
+    __slots__ = ("req", "pos", "pages_held", "steps")
+
+    def __init__(self, req: _EmbedRequest, pages_held: int):
+        self.req = req
+        self.pos = 0                # prompt tokens already prefilled
+        self.pages_held = pages_held
+        self.steps = 0
+
+
 # --- the engine ---------------------------------------------------------
 
 class DecodeEngine:
@@ -551,6 +631,7 @@ class DecodeEngine:
                  spec_k: Optional[int] = None,
                  mesh: Optional[Any] = None,
                  mesh_rules: Optional[Any] = None,
+                 embeddings: bool = False,
                  warm: bool = True):
         from ..fluid.flags import FLAGS, effective_flag
 
@@ -716,9 +797,15 @@ class DecodeEngine:
             self._draft_chunk_ladder = []
             self._draft_params = None  # guarded-by: _step_mu
             self._draft_cache = None  # guarded-by: _step_mu
+        # embeddings/scoring lane (ISSUE 20): opt-in because it warms
+        # its own all-lane compiled family (slots x widths x chunks) —
+        # engines that never score must not pay those compiles
+        self._embed_on = bool(embeddings)
         self._cond = threading.Condition()
         self._queue: List[_DecodeRequest] = []  # guarded-by: _cond
         self._slots: List[_Slot] = []  # guarded-by: _cond
+        self._embed_queue: List[_EmbedRequest] = []  # guarded-by: _cond
+        self._embed_slots: List[_EmbedSlot] = []  # guarded-by: _cond
         self._stopping = False  # guarded-by: _cond
         self._released = False  # guarded-by: _cond
         self._seq_counter = 0  # guarded-by: _cond
@@ -731,6 +818,10 @@ class DecodeEngine:
         # old version must not clobber the live engine's value
         self._g_live = _metrics.gauge(
             f"serving.decode.live_slots.{self.name}.v{self.version}")
+        # embed occupancy is its OWN gauge: embeddings completing with
+        # live_slots untouched is the zero-decode-slot proof
+        self._g_embed = _metrics.gauge(
+            f"serving.decode.embed_slots.{self.name}.v{self.version}")
 
         import jax
 
@@ -797,6 +888,32 @@ class DecodeEngine:
         else:
             self._verify_fn = None  # guarded-by: _step_mu
             self._draft_fn = None  # guarded-by: _step_mu
+        if self._embed_on:
+            def _embed(params, tokens, positions, q_lens, k_pool,
+                       v_pool, tables, lens):
+                return decoder_step_chunked(params, spec_ref, tokens,
+                                            positions, q_lens, k_pool,
+                                            v_pool, tables, lens,
+                                            all_lanes=True,
+                                            return_hidden=True)
+
+            embed_out = None
+            if step_out_shardings is not None:
+                from jax.sharding import NamedSharding as _NS
+                from jax.sharding import PartitionSpec as _PS
+
+                # hidden states replicate like logits: pooling and
+                # logprob scoring are host-side
+                embed_out = step_out_shardings + (
+                    _NS(self._mesh, _PS()),)
+            self._embed_fn = jax.jit(
+                _embed,
+                donate_argnums=(4, 5) if donate else (),
+                **({"out_shardings": embed_out}
+                   if embed_out is not None
+                   else {}))  # guarded-by: _step_mu
+        else:
+            self._embed_fn = None  # guarded-by: _step_mu
         # serializes warm() (caller thread) against live steps (the
         # scheduler thread): read-pools -> step -> rebind must be
         # atomic or concurrent rebinds silently drop KV writes
@@ -927,17 +1044,34 @@ class DecodeEngine:
                         self._run_verify_arrays(*dead(self._verify_lanes))
                         for c in self._draft_chunk_ladder:
                             self._run_draft_arrays(*dead(c))
+                    if self._embed_on:
+                        # the embed lane's all-lane+hidden family warms
+                        # over the same triples — a mixed churn of
+                        # generate + embeddings compiles nothing
+                        for c in self._chunk_ladder:
+                            self._run_embed_arrays(*dead(c))
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
                deadline_ms: Optional[float] = None,
                temperature: float = 0.0, top_k: int = 0,
-               seed: int = 0) -> _DecodeRequest:
+               seed: int = 0, mask: Optional[Any] = None,
+               topk_first: int = 0) -> _DecodeRequest:
         """Validate + reserve KV pages + enqueue. All refusals are
         synchronous and typed: ``ServerOverloaded`` (queue full OR page
         pool exhausted), ``RequestTooLarge`` (can't ever fit),
         ``EngineRetired``, ``ValueError`` (bad tokens / bad sampling
         params). ``temperature``/``top_k``/``seed`` select the sampling
-        policy per request (``sample_token``; 0.0 = greedy)."""
+        policy per request (``sample_token``; 0.0 = greedy).
+
+        ``mask`` (ISSUE 20) constrains generation to a
+        ``TokenMaskSpec`` language (spec object or its wire dict): the
+        automaton's allowed-set zeroes disallowed logits BEFORE the
+        per-(seed, position) choice, so constrained output is exactly
+        as deterministic and batch-composition-independent as
+        unconstrained. The sequence finishes early when the automaton
+        has no further transition. ``topk_first`` asks for the first
+        generated position's top-k token order in the result
+        (``first_topk``) — the beam fork point."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -970,6 +1104,33 @@ class DecodeEngine:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
+        topk_first = int(topk_first)
+        if topk_first < 0 or topk_first > self.spec.vocab:
+            raise ValueError(
+                f"topk_first must be in [0, {self.spec.vocab}], got "
+                f"{topk_first}")
+        automaton = None
+        if mask is not None:
+            from .workloads.masks import MaskAutomaton, TokenMaskSpec
+
+            if isinstance(mask, dict):
+                mask = TokenMaskSpec.from_dict(mask)
+            if isinstance(mask, TokenMaskSpec):
+                automaton = mask.compile()
+            elif isinstance(mask, MaskAutomaton):
+                automaton = mask
+            else:
+                raise ValueError(
+                    f"mask must be a TokenMaskSpec, its wire dict, or "
+                    f"a MaskAutomaton, got {type(mask).__name__}")
+            if automaton.max_token() >= self.spec.vocab:
+                raise ValueError(
+                    f"mask names token id {automaton.max_token()}, "
+                    f"outside this decoder's vocab "
+                    f"[0, {self.spec.vocab})")
+            if not automaton.allowed(automaton.start,
+                                     self.spec.vocab).any():
+                raise ValueError("mask allows no first token")
         deadline = (None if deadline_ms is None
                     else time.monotonic() + float(deadline_ms) / 1e3)
         with self._cond:
@@ -997,7 +1158,8 @@ class DecodeEngine:
                 raise
             req = _DecodeRequest(prompt, max_new, deadline, seq_id,
                                  temperature=temperature, top_k=top_k,
-                                 seed=seed)
+                                 seed=seed, mask=automaton,
+                                 want_topk=topk_first)
             req.cached_tokens = res["cached_tokens"]
             req.cow = res["cow"]
             self._queue.append(req)
@@ -1017,14 +1179,18 @@ class DecodeEngine:
     def generate(self, prompt: Sequence[int], max_new_tokens: int = 16,
                  deadline_ms: Optional[float] = None,
                  timeout: float = 300.0, temperature: float = 0.0,
-                 top_k: int = 0, seed: int = 0) -> Dict[str, Any]:
+                 top_k: int = 0, seed: int = 0,
+                 mask: Optional[Any] = None,
+                 topk_first: int = 0) -> Dict[str, Any]:
         """Blocking convenience: submit + wait. Returns
         ``{"tokens": [...], "prompt_len": n, "version": v,
         "steps_to_first_token": k}``.
         ``temperature``/``top_k``/``seed`` thread through to the
-        per-request sampler (0.0 = greedy, the default)."""
+        per-request sampler (0.0 = greedy, the default);
+        ``mask``/``topk_first`` to the workload layer (ISSUE 20)."""
         req = self.submit(prompt, max_new_tokens, deadline_ms=deadline_ms,
-                          temperature=temperature, top_k=top_k, seed=seed)
+                          temperature=temperature, top_k=top_k, seed=seed,
+                          mask=mask, topk_first=topk_first)
         if not req.ev.wait(timeout):
             # withdraw before raising: an abandoned sequence must not
             # keep its page reservation or burn further decode steps.
@@ -1034,6 +1200,82 @@ class DecodeEngine:
             if self.cancel(req):
                 raise ServingError(
                     f"generate on '{self.name}' timed out after "
+                    f"{timeout}s (decode scheduler wedged?)")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    @property
+    def embeddings_enabled(self) -> bool:
+        return self._embed_on
+
+    @property
+    def prefix_cache_enabled(self) -> bool:
+        return self._prefix_on
+
+    def submit_embed(self, prompt: Sequence[int],
+                     deadline_ms: Optional[float] = None
+                     ) -> _EmbedRequest:
+        """Enqueue a prompt-only embedding/scoring request (ISSUE 20).
+        Reservation is the reserve-at-admission math with
+        ``max_new = 0``: exactly the prompt's pages, taken NOW, typed
+        ``ServerOverloaded`` on refusal. The request rides the chunked
+        prefill path in the embed lane and never holds a decode
+        slot."""
+        if not self._embed_on:
+            raise ServingError(
+                f"decoder '{self.name}' was loaded without "
+                "embeddings=True — the embed lane's compiled shapes "
+                "are not warmed")
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if int(prompt.min()) < 0 or int(prompt.max()) >= self.spec.vocab:
+            raise ValueError(
+                f"prompt token ids must be in [0, {self.spec.vocab})")
+        if int(prompt.size) > self.max_seq_len:
+            raise RequestTooLarge(
+                f"prompt ({prompt.size}) exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        deadline = (None if deadline_ms is None
+                    else time.monotonic() + float(deadline_ms) / 1e3)
+        with self._cond:
+            if self._stopping:
+                raise EngineRetired(
+                    f"decoder '{self.name}' v{self.version} is retiring")
+            if len(self._embed_queue) >= self._max_queue:
+                _m_overloads.inc()
+                raise ServerOverloaded(
+                    f"decoder '{self.name}' embed queue is full "
+                    f"({self._max_queue} deep)")
+            self._seq_counter += 1
+            seq_id = self._seq_counter
+            try:
+                self.cache.allocator.alloc(seq_id, int(prompt.size))
+            except ServerOverloaded:
+                _m_overloads.inc()
+                raise
+            req = _EmbedRequest(prompt, deadline, seq_id,
+                                self.spec.d_model)
+            self._embed_queue.append(req)
+            self._n_requests += 1
+            self._cond.notify()
+        _observe_shape("prefill_chunk", int(prompt.size))
+        _m_embed_requests.inc()
+        return req
+
+    def embed(self, prompt: Sequence[int],
+              deadline_ms: Optional[float] = None,
+              timeout: float = 300.0) -> Dict[str, Any]:
+        """Blocking convenience: submit_embed + wait. Returns
+        ``{"embedding": [d_model floats] (mean-pooled final hidden
+        states), "logprobs": [P-1 floats] (position p scores
+        prompt[p+1]), "prompt_len": P, "version": v, "steps": n}``."""
+        req = self.submit_embed(prompt, deadline_ms=deadline_ms)
+        if not req.ev.wait(timeout):
+            if self.cancel(req):
+                raise ServingError(
+                    f"embed on '{self.name}' timed out after "
                     f"{timeout}s (decode scheduler wedged?)")
         if req.error is not None:
             raise req.error
@@ -1054,7 +1296,10 @@ class DecodeEngine:
         with self._cond:
             if req.ev.is_set():
                 return False
-            if req in self._queue:
+            if isinstance(req, _EmbedRequest):
+                if req in self._embed_queue:
+                    self._embed_queue.remove(req)
+            elif req in self._queue:
                 self._queue.remove(req)
                 self._g_depth.set(len(self._queue))
             _m_cancels.inc()
@@ -1121,6 +1366,18 @@ class DecodeEngine:
                     self._fail_locked(r, EngineRetired(
                         f"decoder '{self.name}' v{self.version} unloaded"))
                 self._queue.clear()
+                for r in self._embed_queue:
+                    self._fail_locked(r, EngineRetired(
+                        f"decoder '{self.name}' v{self.version} unloaded"))
+                self._embed_queue.clear()
+                for s in self._embed_slots:
+                    if not s.req.ev.is_set():
+                        self._fail_locked(s.req, EngineRetired(
+                            f"decoder '{self.name}' v{self.version} "
+                            "unloaded"))
+                    else:
+                        self.cache.allocator.free(s.req.seq_id)
+                self._embed_slots = []
                 for s in self._slots:
                     # a slot _complete()d mid-step may still be in
                     # _slots (removal happens under _cond after the
@@ -1146,6 +1403,7 @@ class DecodeEngine:
         with self._step_mu:
             self._params = None
             self._step_fn = None
+            self._embed_fn = None
             self._draft_params = None
             self._verify_fn = None
             self._draft_fn = None
@@ -1165,6 +1423,7 @@ class DecodeEngine:
             # answer phase — a retired engine must not report phantom
             # live slots
             self._g_live.set(0)
+            self._g_embed.set(0)
 
     def stats(self) -> Dict[str, Any]:
         # _compiled_shapes is _step_mu state: snapshot it under ITS lock
@@ -1199,6 +1458,9 @@ class DecodeEngine:
                 "kv": self.cache.allocator.stats(),
                 "queue_depth": len(self._queue),
                 "live": len(self._slots),
+                "embeddings": self._embed_on,
+                "embed_queue": len(self._embed_queue),
+                "live_embed": len(self._embed_slots),
                 "max_queue": self._max_queue,
                 "requests": self._n_requests,
                 "steps": self._n_steps,
@@ -1249,6 +1511,17 @@ class DecodeEngine:
         if len(keep) != len(self._queue):
             self._queue[:] = keep
             self._g_depth.set(len(keep))
+        ekeep = []
+        for r in self._embed_queue:
+            if r.deadline is not None and now > r.deadline:
+                _m_deadline_miss.inc()
+                self._fail_locked(r, DeadlineExceeded(
+                    f"request to decoder '{self.name}' missed its "
+                    "deadline while queued"))
+            else:
+                ekeep.append(r)
+        if len(ekeep) != len(self._embed_queue):
+            self._embed_queue[:] = ekeep
 
     def _admit_locked(self):
         """Move queued requests into free slots. Continuous mode admits
@@ -1311,47 +1584,75 @@ class DecodeEngine:
             self._slots.append(slot)
             _m_admitted.inc()
             _m_queue_wait.observe((time.monotonic() - req.t_enq) * 1e3)
+        # embed admission: its own slot lane, capped by the same ladder
+        # max — decode slots and live_slots are untouched. Reservation
+        # happened at submit (the prompt's pages, never grown), so
+        # admission is pure bookkeeping.
+        while self._embed_queue and \
+                len(self._embed_slots) < self._max_slots:
+            ereq = self._embed_queue.pop(0)
+            if ereq.ev.is_set():
+                continue
+            self._embed_slots.append(_EmbedSlot(
+                ereq, self.cache.allocator.held_pages(ereq.seq_id)))
+            _m_admitted.inc()
+            _m_queue_wait.observe((time.monotonic() - ereq.t_enq) * 1e3)
         self._g_depth.set(len(self._queue))
         self._g_live.set(len(self._slots))
+        self._g_embed.set(len(self._embed_slots))
 
-    def _next_live(self) -> Optional[List[_Slot]]:
+    def _next_live(self
+                   ) -> Optional[Tuple[List[_Slot], List[_EmbedSlot]]]:
         # lint: allow-blocking — Condition.wait on the engine's own
         # condition is the scheduler's idle state by design
         with self._cond:
             while True:
                 self._drop_expired_locked(time.monotonic())
                 self._admit_locked()
-                if self._slots:
-                    return list(self._slots)
-                if self._stopping and not self._queue:
+                if self._slots or self._embed_slots:
+                    return list(self._slots), list(self._embed_slots)
+                if self._stopping and not self._queue \
+                        and not self._embed_queue:
                     return None
-                # no live slots here implies the queue is (almost
+                # no live slots here implies the queues are (almost
                 # always) empty too — admission can't fail with every
                 # slot free — so idle blocks untimed on submit()/stop()
                 # notifies instead of polling 20x/s per loaded decoder;
                 # the timed wait survives only for the defensive case
                 # of a non-empty queue, whose deadlines need the poll
-                self._cond.wait(0.05 if self._queue else None)
+                self._cond.wait(0.05 if (self._queue
+                                         or self._embed_queue)
+                                else None)
 
     def _loop(self):
         while True:
-            live = self._next_live()
-            if live is None:
+            nxt = self._next_live()
+            if nxt is None:
                 return
+            live, elive = nxt
             try:
-                self._step(live)
+                if live:
+                    self._step(live)
+                if elive:
+                    # the embed lane runs AFTER the decode step each
+                    # round: decode tokens never stall behind scoring,
+                    # and a mixed churn interleaves the two lanes 1:1
+                    self._embed_step(elive)
             except BaseException as e:  # a broken step fails ITS slots
                 _log.error("decode step on %s v%d failed: %s: %s",
                            self.name, self.version, type(e).__name__, e)
                 err = (e if isinstance(e, ServingError) else
                        ServingError(f"{type(e).__name__}: {e}"))
                 with self._cond:
-                    for s in live:
+                    for s in live + elive:
                         if not s.req.ev.is_set():
                             self._fail_locked(s.req, err)
                     self._slots = [s for s in self._slots
                                    if s not in live]
+                    self._embed_slots = [s for s in self._embed_slots
+                                         if s not in elive]
                     self._g_live.set(len(self._slots))
+                    self._g_embed.set(len(self._embed_slots))
                     if self._donate:
                         # the raising step already consumed the donated
                         # pools — k/v are deleted buffers and every
@@ -1364,15 +1665,20 @@ class DecodeEngine:
                             "the failed step — retiring the engine",
                             self.name, self.version)
                         self._stopping = True
-                        for s in self._slots:
+                        for s in self._slots + self._embed_slots:
                             if not s.req.ev.is_set():
                                 self._fail_locked(s.req, err)
                         self._slots = []
+                        self._embed_slots = []
                         for r in self._queue:
                             self._fail_locked(r, err)
                         self._queue.clear()
+                        for r in self._embed_queue:
+                            self._fail_locked(r, err)
+                        self._embed_queue.clear()
                         self._g_depth.set(0)
                         self._g_live.set(0)
+                        self._g_embed.set(0)
                         self._cond.notify_all()
                         return
 
@@ -1385,7 +1691,10 @@ class DecodeEngine:
         triples."""
         with self._step_mu:
             key = (len(tokens), tables.shape[1], tokens.shape[1])
-            if self._spec_k:
+            if self._spec_k or self._embed_on:
+                # tagged whenever a second compiled family exists —
+                # bare triples and tagged tuples must never mix in one
+                # set (stats() sorts it)
                 key = ("target",) + key
             if key not in self._compiled_shapes:
                 self._compiled_shapes.add(key)
@@ -1432,6 +1741,25 @@ class DecodeEngine:
                 self._draft_cache.k, self._draft_cache.v, tables, lens)
             self._draft_cache.rebind(k, v)
             return logits
+
+    def _run_embed_arrays(self, tokens, positions, q_lens, tables,
+                          lens):
+        """One EMBED step (ISSUE 20): the all-lane + hidden form
+        against the shared target pool — every prompt lane's logits
+        ``[B, C, vocab]`` (per-token scoring) and final-norm hidden
+        states ``[B, C, d_model]`` (pooling) in one call."""
+        with self._step_mu:
+            key = ("embed", len(tokens), tables.shape[1],
+                   tokens.shape[1])
+            if key not in self._compiled_shapes:
+                self._compiled_shapes.add(key)
+                _m_compiles.inc()
+            _m_embed_steps.inc()
+            k, v, logits, hidden = self._embed_fn(
+                self._params, tokens, positions, q_lens, self.cache.k,
+                self.cache.v, tables, lens)
+            self.cache.rebind(k, v)
+            return logits, hidden
 
     def _prepare(self, live: List[_Slot]
                  ) -> Tuple[List[_Slot], List[int]]:
@@ -1608,7 +1936,11 @@ class DecodeEngine:
         overshoot max_new — so the reservation-bound write at
         ``pos + k_eff`` also never passes the sequence cap)."""
         if not self._spec_k or s.req.ev.is_set() or \
-                s.pos < len(s.req.prompt):
+                s.pos < len(s.req.prompt) or s.req.mask is not None:
+            # masked requests never ride speculation: the draft
+            # proposes from UNMASKED logits, so acceptance would decay
+            # to ~0 while still paying the draft steps — and the grant
+            # math below assumes plain slots advance one position
             return 0
         total = len(s.req.prompt) + s.req.max_new
         return max(0, min(self._spec_k, total - s.pos - 2))
@@ -1655,6 +1987,31 @@ class DecodeEngine:
             return int(np.argmax(row))
         return sample_token(row, req.temperature, req.top_k, req.seed,
                             position)
+
+    def _masked_choice(self, req: _DecodeRequest, row,
+                       position: int) -> Tuple[int, bool]:
+        """Constrained decode's per-token core (ISSUE 20): zero the
+        disallowed lanes to -inf, make THE SAME deterministic
+        per-(seed, position) choice the unconstrained path makes, then
+        advance the automaton. Masking composes cleanly with the
+        sampler — softmax renormalizes over the survivors — so a
+        masked request's tokens are a pure function of (seed, mask,
+        prompt, params), independent of batch composition (tier-1
+        asserts bitwise equality across differently-loaded engines).
+        Returns ``(token, exhausted)``; exhausted means the automaton
+        has no further transition — the constraint is complete and the
+        sequence finishes regardless of max_new."""
+        allowed = req.mask.allowed(req.mask_state, self.spec.vocab)
+        masked = np.where(allowed, np.asarray(row, np.float64), -np.inf)
+        tok = self._choose(masked, req, position)
+        ns = req.mask.step(req.mask_state, tok)
+        # an allowed token always has a transition; belt-and-braces for
+        # a buggy automaton: treat a dead step as exhaustion
+        if ns is None:
+            return tok, True
+        req.mask_state = ns
+        _m_masked_tokens.inc()
+        return tok, not req.mask.allowed(ns, self.spec.vocab).any()
 
     def _check_reservation(self, s: _Slot, end_tokens: int):
         """The reservation (grown by _prepare in demand mode) must
@@ -1795,7 +2152,8 @@ class DecodeEngine:
         # speculation is off) ride the PR 9 chunked step unchanged
         spec_rows = [i for i, s in enumerate(live)
                      if self._spec_k and not s.req.ev.is_set()
-                     and s.pos >= len(s.req.prompt)]
+                     and s.pos >= len(s.req.prompt)
+                     and s.req.mask is None]
         spec_set = set(spec_rows)
         plain_rows = [i for i in range(len(live)) if i not in spec_set]
         w_need = max(s.pages_held for s in live)
@@ -1947,6 +2305,7 @@ class DecodeEngine:
                         # for lane — its watermark advances in lockstep
                         s.dpos = s.pos
                     tok = None
+                    mask_done = False
                     if s.pos >= len(s.req.prompt):
                         # logits_np[row] is the slot's newest lane (the
                         # step unembeds only lane q_len-1): prompt
@@ -1956,11 +2315,27 @@ class DecodeEngine:
                         # the (seed, position) pair that makes sampling
                         # independent of batch composition AND chunking
                         row = plain_row_of[id(s)]
-                        tok = (int(sampled[row])
-                               if s.req.temperature <= 0.0
-                               else sample_token(
-                                   logits_np[row], s.req.temperature,
-                                   s.req.top_k, s.req.seed, s.pos))
+                        if s.req.want_topk and s.req.first_topk is None:
+                            # the beam fork point (ISSUE 20): the FIRST
+                            # generated position's token order by
+                            # logit, stable-sorted so ties break
+                            # deterministically; order[0] == argmax, so
+                            # beam 0 is the greedy continuation
+                            order = np.argsort(
+                                -np.asarray(logits_np[row], np.float64),
+                                kind="stable")
+                            s.req.first_topk = [
+                                int(t) for t in order[:s.req.want_topk]]
+                        if s.req.mask is not None:
+                            tok, mask_done = self._masked_choice(
+                                s.req, logits_np[row], s.pos)
+                        else:
+                            tok = (int(sampled[row])
+                                   if s.req.temperature <= 0.0
+                                   else sample_token(
+                                       logits_np[row],
+                                       s.req.temperature,
+                                       s.req.top_k, s.req.seed, s.pos))
                         s.req.produced.append(tok)
                         produced_any = True
                         _m_tokens.inc()
@@ -1968,6 +2343,7 @@ class DecodeEngine:
                             s.first_token_steps = s.steps
                             _m_first_token_steps.observe(s.steps)
                     finished = (len(s.req.produced) >= s.req.max_new
+                                or mask_done
                                 or (tok is not None
                                     and self.spec.eos_id is not None
                                     and tok == self.spec.eos_id))
@@ -2026,7 +2402,123 @@ class DecodeEngine:
                 round(s.req.spec_accepted / s.req.spec_proposed, 4)
                 if s.req.spec_proposed else None),
         }
+        if s.req.want_topk:
+            # the beam fork point rides the ordinary result dict —
+            # absent unless asked for, so every pre-existing result
+            # shape is untouched
+            s.req.result["first_topk"] = list(s.req.first_topk or [])
         if s.req.spec_proposed:
             _m_spec_accept_rate.observe(
                 s.req.spec_accepted / s.req.spec_proposed)
+        s.req.ev.set()
+
+    # -- the embed lane ---------------------------------------------------
+    def _embed_step(self, live: List[_EmbedSlot]):
+        """One chunked-prefill step for the embedding/scoring lane
+        (ISSUE 20): the same Sarathi-style token budget, page tables,
+        and compiled ladders as generation — but the all-lane + hidden
+        step form, and nothing is ever sampled: every lane feeds the
+        pooled-hidden accumulator and the per-token logprobs. Decode
+        slots are untouched by construction (separate slot list)."""
+        # named chaos seam for the embed cadence (mirrors
+        # serving.decode.step); the workload layer's per-kind site
+        # (serving.workload.embed) lives at the dispatch boundary
+        _faults.fire("serving.decode.embed")
+        budget = self._prefill_chunk
+        grants = []
+        for s in live:
+            remaining = len(s.req.prompt) - s.pos
+            g = max(1, min(remaining, budget))
+            budget = max(0, budget - g)
+            grants.append(g)
+        s_bucket = _bucket_for(self._slot_ladder, len(live))
+        c_bucket = _bucket_for(self._chunk_ladder, max(grants))
+        w_need = max(s.pages_held for s in live)
+        w_bucket = _bucket_for(self._width_ladder, w_need)
+        tokens = np.zeros((s_bucket, c_bucket), np.int32)
+        positions = np.zeros((s_bucket, c_bucket), np.int32)
+        q_lens = np.zeros(s_bucket, np.int32)
+        lens = np.zeros(s_bucket, np.int32)
+        with self._cond:
+            for i, (s, g) in enumerate(zip(live, grants)):
+                if s.req.ev.is_set():
+                    continue  # canceled: pages freed, all-garbage row
+                for j in range(g):
+                    tokens[i, j] = int(s.req.prompt[s.pos + j])
+                    positions[i, j] = s.pos + j
+                q_lens[i] = g
+                lens[i] = s.pos + g
+                if int(lens[i]) > s.pages_held * self.cache.page_size:
+                    raise ServingError(
+                        f"embed chunk grant escaped seq "
+                        f"{s.req.seq_id}'s page reservation")
+        tables = self.cache.table_array(
+            [s.req.seq_id for s in live], w_bucket, rows=s_bucket)
+        t0 = time.perf_counter()
+        with _tracing.adopt(live[0].req.trace_ctx), \
+                _tracing.span("serving.decode.embed", model=self.name,
+                              version=self.version, width=w_bucket,
+                              live=len(live)):
+            logits, hidden = self._run_embed_arrays(
+                tokens, positions, q_lens, tables, lens)
+        logits_np = np.asarray(logits)  # [B, C, vocab]
+        hidden_np = np.asarray(hidden)  # [B, C, d_model]
+        _m_step_ms.observe((time.perf_counter() - t0) * 1e3)
+        now = time.monotonic()
+        done: List[_EmbedSlot] = []
+        notes: Dict[int, int] = {}
+        with self._cond:
+            self._n_steps += 1
+            for i, s in enumerate(live):
+                if s.req.ev.is_set():
+                    done.append(s)
+                    continue
+                s.steps += 1
+                g = grants[i]
+                prompt = s.req.prompt
+                s.req.hidden_sum += np.asarray(
+                    hidden_np[i, :g], np.float64).sum(axis=0)
+                lg = np.asarray(logits_np[i, :g], np.float64)
+                # float64 log-softmax per lane; lane j (absolute
+                # position pos+j) scores the NEXT prompt token — the
+                # final lane has no successor inside the prompt
+                mx = lg.max(axis=-1)
+                lse = mx + np.log(
+                    np.exp(lg - mx[:, None]).sum(axis=-1))
+                for j in range(g):
+                    nxt = s.pos + j + 1
+                    if nxt < len(prompt):
+                        s.req.logprobs.append(
+                            float(lg[j, int(prompt[nxt])] - lse[j]))
+                s.pos += g
+                _m_embed_tokens.inc(g)
+                notes[s.req.seq_id] = s.pos
+                if s.pos >= len(prompt):
+                    done.append(s)
+                    self._complete_embed(s)
+                elif s.req.deadline is not None and now > s.req.deadline:
+                    _m_deadline_miss.inc()
+                    done.append(s)
+                    self._fail_locked(s.req, DeadlineExceeded(
+                        f"embed request to decoder '{self.name}' "
+                        f"lapsed mid-prefill at {s.pos} tokens"))
+            self.cache.allocator.note_tokens_many(notes)
+            if done:
+                self._embed_slots = [s for s in self._embed_slots
+                                     if s not in done]
+                self._g_embed.set(len(self._embed_slots))
+                self._cond.notify_all()
+
+    def _complete_embed(self, s: _EmbedSlot):
+        self.cache.allocator.free(s.req.seq_id)
+        _m_completions.inc()
+        _m_total.observe((time.monotonic() - s.req.t_enq) * 1e3)
+        p = len(s.req.prompt)
+        s.req.result = {
+            "embedding": [float(x) for x in s.req.hidden_sum / p],
+            "logprobs": list(s.req.logprobs),
+            "prompt_len": p,
+            "version": self.version,
+            "steps": int(s.steps),
+        }
         s.req.ev.set()

@@ -208,6 +208,14 @@ class PrefixIndex:
     def pages_retained_locked(self) -> int:
         return len(self._entries)
 
+    def shared_pages_locked(self) -> int:
+        """Pages mapped by two or more live sequences right now —
+        refs >= 2 (publisher + at least one sharer, or several
+        sharers). The allocator-counter proof that n-best/beam
+        siblings (ISSUE 20) SHARE their prompt pages through the
+        refcount rather than copying them."""
+        return sum(1 for e in self._entries.values() if e.refs >= 2)
+
     def evictable_count_locked(self) -> int:
         """Entries a cascading leaf-first eviction could reclaim right
         now: refcount-0 entries with no referenced descendant (an
@@ -574,6 +582,8 @@ class PageAllocator:
                 out["prefix_pages"] = self.prefix.pages_retained_locked()
                 out["prefix_reclaimable"] = \
                     self.prefix.evictable_count_locked()
+                out["prefix_shared_pages"] = \
+                    self.prefix.shared_pages_locked()
             return out
 
     def prefix_stats(self, roots_cap: int = 32) -> Optional[Dict[str, Any]]:
@@ -588,6 +598,7 @@ class PageAllocator:
                 "pages": self.prefix.pages_retained_locked(),
                 "tokens": self.prefix.cached_tokens_locked(),
                 "page_size": self.page_size,
+                "shared": self.prefix.shared_pages_locked(),
                 "roots": self.prefix.roots_locked(roots_cap),
             }
 

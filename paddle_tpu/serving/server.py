@@ -151,6 +151,7 @@ class ServingServer:
         handlers = {
             "infer": self._infer,
             "generate": self._generate,
+            "workload": self._workload,
             "generate_stream_start": self._generate_stream_start,
             "generate_stream_next": self._generate_stream_next,
             "generate_stream_close": self._generate_stream_close,
@@ -283,6 +284,28 @@ class ServingServer:
                         prompt, max_new_tokens=max_new_tokens,
                         deadline_ms=deadline_ms, temperature=temperature,
                         top_k=top_k, seed=seed)})
+
+    def _workload(self, model: str, workload: Dict[str, Any]
+                  ) -> Dict[str, Any]:
+        """Typed-workload dispatch (ISSUE 20): one RPC, one ``kind``
+        field selecting generate/constrained/embed/beam. Parse STRICTLY
+        before touching any engine (an unknown kind or misspelled field
+        must refuse, not silently decode unconstrained), then run under
+        the same swap-resubmit contract as _generate. Deliberately NOT
+        in the transport's idempotent set: a retransmit after a lost
+        reply must be answered from the dedup cache
+        (rpc.server.dedup_hits), not recomputed — beams and embeddings
+        are exactly the requests expensive enough to make recompute-on-
+        retry a real cost."""
+        from .workloads import parse_workload, run_workload
+
+        w = parse_workload(workload)
+        return self._on_engine(
+            model, True,
+            "model '{model}' is not a decoder — workloads need a "
+            "DecodeEngine",
+            lambda engine: {"model": str(model),
+                            **run_workload(engine, w)})
 
     # -- streaming generate (ISSUE 12) ------------------------------------
     def _sweep_streams(self):
@@ -474,7 +497,8 @@ class ServingServer:
                       draft_spec: Optional[Dict[str, Any]] = None,
                       draft_checkpoint_dir: Optional[str] = None,
                       spec_k: Optional[int] = None,
-                      mesh_axes: Optional[str] = None
+                      mesh_axes: Optional[str] = None,
+                      embeddings: bool = False
                       ) -> Dict[str, Any]:
         """Build + warm (every slot/width shape) + atomically install a
         DecodeEngine. ``checkpoint_dir`` loads REAL weights (and the
@@ -531,7 +555,8 @@ class ServingServer:
                                  else str(reservation)),
                     draft_spec=use_draft, draft_params=draft_params,
                     spec_k=(None if spec_k is None else int(spec_k)),
-                    mesh=mesh_arg, mesh_rules=mesh_rules_arg)
+                    mesh=mesh_arg, mesh_rules=mesh_rules_arg,
+                    embeddings=bool(embeddings))
 
             engine = self._registry.deploy(model, build)
             return engine.stats()

@@ -1,0 +1,20 @@
+"""Typed serving workloads on the shared KV substrate (ISSUE 20).
+
+One ``kind`` field on the wire selects among four request classes —
+``generate``, ``constrained`` (TokenMaskSpec-masked logits),
+``embed`` (prompt-only pooled hidden states + logprobs, zero decode
+slots), and ``beam`` (k siblings over refcount-shared prompt pages).
+See docs/SERVING.md § Workloads.
+"""
+from .base import (BeamWorkload, ConstrainedWorkload, EmbedWorkload,
+                   GenerateWorkload, WORKLOAD_KINDS, Workload,
+                   parse_workload, run_workload)
+from .beam import beam_search
+from .masks import MaskAutomaton, MaskError, TokenMaskSpec
+
+__all__ = [
+    "Workload", "GenerateWorkload", "ConstrainedWorkload",
+    "EmbedWorkload", "BeamWorkload", "WORKLOAD_KINDS",
+    "parse_workload", "run_workload", "beam_search",
+    "TokenMaskSpec", "MaskAutomaton", "MaskError",
+]

@@ -214,6 +214,120 @@ def test_member_refuses_poison_with_zero_state_change(fleet_key,
         ctl.shutdown()
 
 
+# --- key rotation (ISSUE 20) ---------------------------------------------
+
+def test_key_rotation_dual_window_unit(monkeypatch):
+    """The dual-key verify window: an old-key signature lands on a
+    rotated verifier (counted), the nonce window is shared across both
+    keys, and clearing the prev key ends the window."""
+    monkeypatch.setenv("PADDLE_TPU_FLEET_KEY", "key-old")
+    fields = fauth.signed_fields("unload_model", "m", {})
+    intent = {"action": "unload_model", "model": "m", "payload": {},
+              **fields}
+    win = fauth.NonceWindow()
+    prev0 = _ctr("fleet.auth.verified.prev_key")
+    # verifier already rotated (current=new, prev=old): still lands
+    fauth.verify_intent("key-new", intent, window=win,
+                        prev_key="key-old")
+    assert _ctr("fleet.auth.verified.prev_key") == prev0 + 1
+    # shared nonce window: re-signing the captured nonce under the NEW
+    # key is still a replay, not a fresh intent
+    resig = fauth.sign_intent("key-new", "unload_model", "m", {},
+                              fields["nonce"])
+    with pytest.raises(IntentRefused) as e:
+        fauth.verify_intent("key-new", dict(intent, sig=resig),
+                            window=win, prev_key="key-old")
+    assert e.value.reason == "replayed"
+    # rotation complete (prev cleared): old signatures stop verifying
+    with pytest.raises(IntentRefused) as e:
+        fauth.verify_intent("key-new", intent,
+                            window=fauth.NonceWindow())
+    assert e.value.reason == "bad_signature"
+    # config resolution: env wins, flag is the fallback
+    monkeypatch.setenv("PADDLE_TPU_FLEET_KEY_PREV", "key-old")
+    assert fauth.intent_key_prev() == "key-old"
+    monkeypatch.delenv("PADDLE_TPU_FLEET_KEY_PREV")
+    assert fauth.intent_key_prev() is None
+
+
+def test_key_rotation_mid_soak_no_stop(monkeypatch, tmp_path):
+    """Rotate the fleet key UNDER a live controller+member with
+    intents in flight: (1) soak on key A, (2) flip verifiers to key B
+    with prev=A while a producer still signs with A — the straggler
+    intent lands via the rotation window on BOTH verifiers
+    (controller append AND member re-verify), (3) producers catch up
+    to B and keep landing. No refusals, no convergence stall, and
+    `fleet.auth.verified.prev_key` pins the window traffic."""
+    monkeypatch.setenv("PADDLE_TPU_FLEET_KEY", "key-A")
+    monkeypatch.setenv("PADDLE_TPU_FLEET_ALLOW", str(tmp_path))
+    from paddle_tpu.serving.__main__ import make_model_dir
+
+    d1, _probe, _ref = make_model_dir(str(tmp_path / "v1"))
+
+    def load_payload(version):
+        return {"dirname": d1, "version": version, "buckets": [4],
+                "max_wait_ms": 1.0}
+
+    ctl = FleetController(lease_ttl=30.0, sweep_interval=0)
+    ctl_addr = ctl.serve()
+    srv = ServingServer()
+    srv.serve()
+    member = FleetMember(srv, ctl_addr, replica_id="r0",
+                         beat_interval=0.05)
+    try:
+        assert member.wait_registered(30.0)
+        refused0 = _ctr("fleet.auth.refused")
+        prev0 = _ctr("fleet.auth.verified.prev_key")
+        # phase 1: soak on key A
+        p1 = load_payload(1)
+        f = fauth.signed_fields("load_model", "m", dict(p1))
+        seq = ctl._add_intent("load_model", "m", dict(p1),
+                              f["nonce"], f["sig"])["seq"]
+        assert member.wait_converged(seq=seq, timeout=60.0)
+        assert _ctr("fleet.auth.verified.prev_key") == prev0
+        # phase 2: verifiers rotate FIRST (key=B, prev=A); one producer
+        # has not flipped yet and still signs with A
+        monkeypatch.setenv("PADDLE_TPU_FLEET_KEY", "key-B")
+        monkeypatch.setenv("PADDLE_TPU_FLEET_KEY_PREV", "key-A")
+        p2 = load_payload(2)
+        nonce = fauth.make_nonce()
+        straggler_sig = fauth.sign_intent("key-A", "load_model", "m",
+                                          dict(p2), nonce)
+        seq = ctl._add_intent("load_model", "m", dict(p2), nonce,
+                              straggler_sig)["seq"]
+        assert member.wait_converged(seq=seq, timeout=60.0)
+        # both verifiers (controller append + member re-verify) went
+        # through the rotation window
+        assert _ctr("fleet.auth.verified.prev_key") >= prev0 + 2
+        # phase 3: producers caught up — signed_fields now mints key-B
+        # signatures and they verify under the CURRENT key
+        prev_after_window = _ctr("fleet.auth.verified.prev_key")
+        p3 = load_payload(3)
+        f = fauth.signed_fields("load_model", "m", dict(p3))
+        seq = ctl._add_intent("load_model", "m", dict(p3),
+                              f["nonce"], f["sig"])["seq"]
+        assert member.wait_converged(seq=seq, timeout=60.0)
+        assert _ctr("fleet.auth.verified.prev_key") == prev_after_window
+        # the soak never refused anything and the model really rolled
+        assert _ctr("fleet.auth.refused") == refused0
+        assert srv.registry.get("m").version == 3
+        # epilogue: window closed (prev cleared) — a late key-A intent
+        # is refused typed on the controller, zero state change
+        monkeypatch.delenv("PADDLE_TPU_FLEET_KEY_PREV")
+        p4 = load_payload(4)
+        nonce = fauth.make_nonce()
+        late = fauth.sign_intent("key-A", "load_model", "m",
+                                 dict(p4), nonce)
+        with pytest.raises(IntentRefused) as e:
+            ctl._add_intent("load_model", "m", dict(p4), nonce, late)
+        assert e.value.reason == "bad_signature"
+        assert srv.registry.get("m").version == 3
+    finally:
+        member.stop(deregister=False)
+        srv.shutdown()
+        ctl.shutdown()
+
+
 # --- compaction ----------------------------------------------------------
 
 def test_compaction_keeps_log_o_live_models_verbatim():

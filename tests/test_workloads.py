@@ -1,0 +1,449 @@
+"""Typed serving workloads (ISSUE 20): grammar-constrained decoding,
+prompt-only embeddings/scoring, and n-best/beam on the shared KV
+substrate.
+
+Coverage map:
+  - TokenMaskSpec: regex parse (alternation, grouping, star/plus/opt,
+    classes incl. negation, wildcard), one_of chains, wire roundtrip
+    with strict unknown-key refusal, automaton allowed/step/max_token;
+  - constrained decode: output provably inside the mask's language,
+    early finish on automaton exhaustion, deterministic given (seed,
+    position), and THE tier-1 acceptance: bitwise-identical tokens for
+    the same (seed, mask, prompt) across differently-loaded engine
+    mixes (idle / generate churn / embed+beam churn);
+  - embeddings: typed gating (engine must opt in), pooled d_model
+    dims + per-token logprobs, chunk-size invariance (allclose — the
+    float64 pooling order shifts with chunk splits, bitwise is decode's
+    bar, not pooling's), ZERO decode slots consumed (live_slots gauge
+    sampled DURING the churn), every page returned;
+  - beam: typed refusal without a prefix cache, page sharing proven by
+    allocator counters (prefix_shared_pages, per-child cached_tokens),
+    temp-0 beams bitwise-equal to independent decodes on a FRESH
+    cacheless engine, beams[0] == the plain greedy continuation;
+  - dispatch: parse_workload strict on kind AND fields, run_workload
+    per-kind counters/histograms populate;
+  - chaos: a workload reply (embed and beam) killed mid-frame is
+    answered from the dedup cache on retransmit — zero re-decoding,
+    counter-exact;
+  - sanitizer: the embed lane's scheduler state (_embed_queue /
+    _embed_slots guarded-by declarations) churns green under
+    PADDLE_TPU_SANITIZE=guards.
+
+Time budget: this file is in tier-1, so it shares ONE module-scoped
+engine across most tests and builds every engine with ``warm=False`` —
+programs compile on first use and land in the process-wide jit cache,
+which test_prefix_preempt.py (same spec, same shape family, earlier in
+alphabetical order) has already seeded by the time tier-1 gets here.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import metrics
+from paddle_tpu.serving import (DecodeEngine, DecoderSpec,
+                                ServingClient, ServingServer)
+from paddle_tpu.serving.errors import ServingError
+from paddle_tpu.serving.workloads import (MaskError, TokenMaskSpec,
+                                          beam_search, parse_workload,
+                                          run_workload)
+
+
+def _spec():
+    return DecoderSpec(vocab=32, d_model=16, n_layers=2, n_heads=2,
+                       n_kv_heads=1, seed=7)
+
+
+def _engine(name="wl", **kw):
+    # shape family deliberately matches test_prefix_preempt.py's (see
+    # module docstring); warm=False so refusal-only engines never
+    # compile anything at all
+    kw.setdefault("slots", [1, 2])
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 24)
+    kw.setdefault("max_seq_len", 20)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("warm", False)
+    return DecodeEngine(_spec(), name=name, **kw)
+
+
+@pytest.fixture(scope="module")
+def wl():
+    """The shared all-kinds engine (embeddings + prefix cache on)."""
+    eng = _engine("wlmod", embeddings=True, prefix_cache=True)
+    yield eng
+    eng.stop()
+
+
+# --- TokenMaskSpec / automaton ------------------------------------------
+
+def test_mask_regex_language_membership():
+    auto = TokenMaskSpec.regex("5 ( 7 | 9 ) + 11").compile()
+
+    def accepts(toks):
+        s = auto.start
+        for t in toks:
+            if not bool(auto.allowed(s, 32)[t]):
+                return False
+            s = auto.step(s, t)
+            if s is None:
+                return False
+        return auto.accepting(s)
+
+    assert accepts([5, 7, 11])
+    assert accepts([5, 9, 7, 9, 11])
+    assert not accepts([5, 11])          # + needs at least one
+    assert not accepts([7, 9, 11])       # must start with 5
+    assert not accepts([5, 7])           # not yet accepting
+    assert auto.max_token() == 11
+
+
+def test_mask_classes_star_opt_and_wildcard():
+    auto = TokenMaskSpec.regex("[ 1 2 3 ] * 4 . ?").compile()
+    s = auto.start
+    allowed = auto.allowed(s, 8)
+    assert set(np.nonzero(allowed)[0]) == {1, 2, 3, 4}
+    for t in (2, 2, 1, 4):
+        s = auto.step(s, t)
+    assert auto.accepting(s)             # optional tail
+    assert bool(auto.allowed(s, 8).all())  # '.' allows everything
+    neg = TokenMaskSpec.regex("[^ 0 1 ] 3").compile()
+    first = neg.allowed(neg.start, 6)
+    assert not first[0] and not first[1] and first[2] and first[5]
+
+
+def test_mask_one_of_and_wire_roundtrip():
+    spec = TokenMaskSpec.one_of([[8, 9, 10], [8, 6]])
+    auto = spec.compile()
+    s = auto.start
+    assert set(np.nonzero(auto.allowed(s, 32))[0]) == {8}
+    s2 = auto.step(s, 8)
+    assert set(np.nonzero(auto.allowed(s2, 32))[0]) == {6, 9}
+    # wire roundtrip compiles to the same language
+    again = TokenMaskSpec.from_dict(spec.to_dict()).compile()
+    assert set(np.nonzero(again.allowed(again.start, 32))[0]) == {8}
+    with pytest.raises(ValueError, match="unknown"):
+        TokenMaskSpec.from_dict({"kind": "regex", "pattern": "1",
+                                 "bogus": True})
+    with pytest.raises(MaskError):
+        TokenMaskSpec.regex("5 ( 7").compile()   # unbalanced
+    with pytest.raises(MaskError):
+        TokenMaskSpec.regex("* 5").compile()     # dangling repeat
+
+
+# --- constrained decode --------------------------------------------------
+
+def test_constrained_decode_stays_in_language_and_exhausts(wl):
+    out = wl.generate([1, 2], max_new_tokens=8,
+                      mask=TokenMaskSpec.regex("5 ( 7 | 9 ) 11"))
+    assert len(out["tokens"]) == 3
+    assert out["tokens"][0] == 5 and out["tokens"][2] == 11
+    assert out["tokens"][1] in (7, 9)
+    # masked-token accounting moved
+    assert metrics.counter("serving.decode.masked_tokens").value() > 0
+    # a mask that can run longer than max_new is truncated by max_new,
+    # not by the automaton
+    out2 = wl.generate([1, 2], max_new_tokens=3,
+                       mask=TokenMaskSpec.regex("( 5 | 6 ) *"))
+    assert len(out2["tokens"]) == 3
+    assert all(t in (5, 6) for t in out2["tokens"])
+    assert wl.cache.allocator.stats()["pages_used"] == 0
+
+
+def test_constrained_batch_composition_independent(wl):
+    """THE tier-1 acceptance (ISSUE 20): same (seed, mask, prompt) →
+    bitwise-identical tokens whether the engine is idle, churning
+    generates, or churning embeds+beams around it."""
+    mask = TokenMaskSpec.regex("( 5 | 9 | 13 ) + 2")
+
+    def constrained():
+        return wl.generate([4, 9, 1], max_new_tokens=6, mask=mask,
+                           temperature=0.9, top_k=8, seed=123)
+
+    idle = constrained()
+    # mix 1: concurrent plain generates
+    bg = [wl.submit([7, int(i), 3], max_new_tokens=5,
+                    temperature=0.5, seed=i) for i in range(3)]
+    loaded = constrained()
+    assert all(r.ev.wait(120) and r.error is None for r in bg)
+    # mix 2: embeds + a beam in flight
+    ereqs = [wl.submit_embed(list(range(1, 6 + i))) for i in range(2)]
+    bt = threading.Thread(
+        target=lambda: beam_search(wl, [3, 1, 4, 1, 5], k=2,
+                                   max_new_tokens=4))
+    bt.start()
+    mixed = constrained()
+    bt.join(timeout=120)
+    assert all(e.ev.wait(120) and e.error is None for e in ereqs)
+    assert loaded["tokens"] == idle["tokens"]
+    assert mixed["tokens"] == idle["tokens"]
+    assert idle["tokens"] and all(
+        t in (5, 9, 13, 2) for t in idle["tokens"])
+
+
+def test_constrained_submit_validation(wl):
+    with pytest.raises(ValueError, match="outside this decoder"):
+        wl.generate([1], max_new_tokens=2,
+                    mask=TokenMaskSpec.regex("99"))
+    # a class negating the WHOLE vocab compiles but can never emit
+    empty = "[^ " + " ".join(str(i) for i in range(32)) + " ]"
+    with pytest.raises(ValueError, match="no first token"):
+        wl.generate([1], max_new_tokens=2,
+                    mask=TokenMaskSpec.regex(empty))
+    with pytest.raises(MaskError, match="non-empty"):
+        TokenMaskSpec.one_of([[]])
+    with pytest.raises(ValueError, match="mask must be"):
+        wl.generate([1], max_new_tokens=2, mask=42)
+
+
+# --- embeddings ----------------------------------------------------------
+
+def test_embed_requires_opt_in(wl):
+    eng = _engine("embed_off")  # warm=False: refusal-only, no compile
+    try:
+        with pytest.raises(ServingError, match="embeddings=True"):
+            eng.embed([1, 2, 3])
+    finally:
+        eng.stop()
+    out = wl.embed([1, 2, 3, 4, 5])
+    assert len(out["embedding"]) == 16
+    assert len(out["logprobs"]) == 4
+    assert all(lp <= 0.0 for lp in out["logprobs"])
+    assert out["prompt_len"] == 5
+    # deterministic: same prompt, same pooled state
+    again = wl.embed([1, 2, 3, 4, 5])
+    assert again["embedding"] == out["embedding"]
+    assert again["logprobs"] == out["logprobs"]
+    assert wl.cache.allocator.stats()["pages_used"] == 0
+
+
+def test_embed_chunk_invariant_and_zero_decode_slots(wl):
+    """The pooled embedding must not depend on how prefill was chunked
+    (allclose: float64 summation groups differ), and an embed churn
+    must never occupy a decode slot (gauge sampled DURING)."""
+    prompt = list(range(2, 18))
+    e2 = _engine("emb_c8", embeddings=True, prefill_chunk=8, slots=[1])
+    try:
+        a = wl.embed(prompt)
+        b = e2.embed(prompt)
+        np.testing.assert_allclose(a["embedding"], b["embedding"],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(a["logprobs"], b["logprobs"],
+                                   rtol=1e-5, atol=1e-6)
+        assert a["steps"] == 4 and b["steps"] == 2  # ceil(16/chunk)
+    finally:
+        e2.stop()
+
+    live = metrics.gauge("serving.decode.live_slots.wlmod.v1")
+    seen = []
+    stop = threading.Event()
+
+    def probe():
+        while not stop.is_set():
+            seen.append(live.value())
+            time.sleep(0.001)
+
+    t = threading.Thread(target=probe)
+    t.start()
+    reqs = [wl.submit_embed(prompt[: 4 + i]) for i in range(8)]
+    assert all(r.ev.wait(120) and r.error is None for r in reqs)
+    stop.set()
+    t.join(timeout=5)
+    assert seen and max(seen) == 0  # no embed ever held a slot
+    assert wl.cache.allocator.stats()["pages_used"] == 0
+
+
+# --- beam ----------------------------------------------------------------
+
+def test_beam_requires_prefix_cache():
+    eng = _engine("beam_cold", prefix_cache=False)
+    try:
+        with pytest.raises(ServingError, match="prefix cache"):
+            beam_search(eng, [1, 2, 3], k=2, max_new_tokens=3)
+    finally:
+        eng.stop()
+
+
+def test_beam_shares_pages_and_matches_independent_decodes(wl):
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    out = beam_search(wl, prompt, k=3, max_new_tokens=5)
+    assert len(out["beams"]) == 3
+    assert len({tuple(b) for b in out["beams"]}) == 3  # distinct heads
+    # sharing proof: pages refcounted >= 2 while children lived, and
+    # every child answered its whole prompt from the index
+    assert out["shared_prompt_pages"] >= 1
+    assert all(c >= len(prompt) - 3 for c in out["cached_tokens"])
+    assert wl.cache.allocator.stats()["pages_used"] == 0
+    # beams[0] is exactly the plain greedy continuation
+    greedy = wl.generate(prompt, max_new_tokens=5)
+    assert out["beams"][0] == greedy["tokens"]
+    # bitwise vs a FRESH engine with no prefix cache at all: sharing
+    # is invisible to the numerics
+    ref = _engine("beam_ref", prefix_cache=False)
+    try:
+        for b in out["beams"]:
+            ind = ref.generate(prompt + [b[0]], max_new_tokens=4)
+            assert b[1:] == ind["tokens"]
+    finally:
+        ref.stop()
+
+
+def test_beam_k_validation(wl):
+    with pytest.raises(ValueError, match="k must be >= 1"):
+        beam_search(wl, [1], k=0, max_new_tokens=2)
+    with pytest.raises(ValueError, match="exceeds vocab"):
+        beam_search(wl, [1], k=33, max_new_tokens=2)
+
+
+# --- dispatch ------------------------------------------------------------
+
+def test_parse_workload_strict():
+    w = parse_workload({"kind": "beam", "prompt": [1, 2], "k": 2})
+    assert w.kind == "beam" and w.k == 2
+    assert parse_workload({"prompt": [1]}).kind == "generate"  # default
+    with pytest.raises(ValueError, match="unknown workload kind"):
+        parse_workload({"kind": "classify", "prompt": [1]})
+    with pytest.raises(ValueError, match="unknown field"):
+        parse_workload({"kind": "embed", "prompt": [1], "seed": 3})
+    with pytest.raises(ValueError, match="non-empty 'prompt'"):
+        parse_workload({"kind": "generate"})
+    with pytest.raises(ValueError, match="must be a dict"):
+        parse_workload([1, 2])
+    # roundtrip: to_dict parses back to the same kind/fields
+    again = parse_workload(w.to_dict())
+    assert again.k == 2 and again.prompt == [1, 2]
+
+
+def test_run_workload_populates_per_kind_series(wl):
+    c0 = metrics.counter("serving.workload.embed.requests").value()
+    out = run_workload(wl, {"kind": "embed", "prompt": [1, 2, 3]})
+    assert out["kind"] == "embed"
+    assert metrics.counter(
+        "serving.workload.embed.requests").value() == c0 + 1
+    snap = metrics.snapshot()
+    assert snap["serving.workload.embed.ms"]["count"] >= 1
+
+
+# --- the workload fault site (chaos seam) --------------------------------
+
+@pytest.mark.chaos
+def test_workload_fault_site_is_injectable(wl):
+    """`serving.workload.<kind>` is a real fault site: a chaos plan
+    targeting one kind fails exactly that kind and leaves the engine
+    clean for the others."""
+    from paddle_tpu.distributed import faults
+
+    with faults.scoped("error@serving.workload.embed:0") as plan:
+        with pytest.raises(faults.InjectedFault):
+            run_workload(wl, {"kind": "embed", "prompt": [1, 2]})
+        out = run_workload(wl, {"kind": "generate", "prompt": [1, 2],
+                                "max_new_tokens": 2})
+    assert len(out["tokens"]) == 2
+    assert [(k, s) for k, s, _i in plan.injected()] == \
+        [("error", "serving.workload.embed")]
+    assert wl.cache.allocator.stats()["pages_used"] == 0
+
+
+# --- chaos: retransmit-without-recompute ---------------------------------
+
+@pytest.fixture(scope="module")
+def workload_server():
+    srv = ServingServer()
+    addr = srv.serve()
+    cli = ServingClient(addr)
+    cli.load_decoder("wl", _spec().to_dict(), slots=[1, 2], page_size=4,
+                     num_pages=24, max_seq_len=20, prefill_chunk=4,
+                     prefix_cache=True, embeddings=True)
+    yield srv, cli
+    cli.close()
+    srv.shutdown()
+
+
+@pytest.mark.chaos
+def test_embed_reply_dropped_retry_is_dedup_exact(workload_server):
+    """Kill the embed workload's REPLY mid-frame: the retransmit is
+    answered from the dedup cache WITHOUT re-running the prefill —
+    the embed request/step counters prove the lane ran exactly once."""
+    from paddle_tpu.distributed import faults
+
+    srv, cli = workload_server
+    metrics.reset_metrics()
+    with faults.scoped("drop@recv.workload:0") as plan:
+        out = cli.embed("wl", [1, 2, 3, 4, 5, 6, 7, 8])
+    assert [(k, s) for k, s, _i in plan.injected()] == \
+        [("drop", "recv.workload")]
+    assert len(out["embedding"]) == 16
+    assert metrics.counter("rpc.client.retries").value() == 1
+    assert metrics.counter("rpc.server.dedup_hits").value() == 1
+    assert metrics.counter(
+        "serving.decode.embed.requests").value() == 1
+    # ceil(8/4) = 2 chunked steps, run ONCE
+    assert metrics.counter("serving.decode.embed.steps").value() == 2
+    assert metrics.counter(
+        "serving.workload.embed.requests").value() == 1
+
+
+@pytest.mark.chaos
+def test_beam_reply_dropped_retry_is_dedup_exact(workload_server):
+    """Same pin for beam — the expensive kind (parent + k children):
+    the retransmit must not re-decode any of them."""
+    from paddle_tpu.distributed import faults
+
+    srv, cli = workload_server
+    metrics.reset_metrics()
+    with faults.scoped("drop@recv.workload:0") as plan:
+        out = cli.beam("wl", [3, 1, 4, 1, 5], k=2, max_new_tokens=3)
+    assert [(k, s) for k, s, _i in plan.injected()] == \
+        [("drop", "recv.workload")]
+    assert len(out["beams"]) == 2
+    assert metrics.counter("rpc.client.retries").value() == 1
+    assert metrics.counter("rpc.server.dedup_hits").value() == 1
+    # parent + 2 children admitted exactly once each
+    assert metrics.counter("serving.decode.requests").value() == 3
+    assert metrics.counter("serving.decode.completions").value() == 3
+    assert metrics.counter(
+        "serving.workload.beam.requests").value() == 1
+
+
+# --- sanitizer: the embed lane's guarded state ---------------------------
+
+@pytest.fixture
+def guard_sanitizer(monkeypatch):
+    from paddle_tpu.analysis import sanitize
+    from paddle_tpu.fluid.flags import FLAGS
+
+    monkeypatch.setenv("PADDLE_TPU_SANITIZE", "guards")
+    monkeypatch.setitem(FLAGS, "sanitize", "guards")
+    assert sanitize.enabled()
+    installed = sanitize.install()
+    sanitize.clear_violations()
+    try:
+        yield installed
+    finally:
+        sanitize.uninstall()
+        sanitize.clear_violations()
+
+
+def test_workload_mix_green_under_guard_sanitizer(guard_sanitizer):
+    """The new scheduler state (_embed_queue/_embed_slots and the
+    embed-lane step) churns with every declared guard asserted at
+    every attribute access — concurrently with decode + beam traffic
+    so the cross-lane locking is actually exercised."""
+    from paddle_tpu.analysis import sanitize
+
+    eng = _engine("san_wl", embeddings=True, prefix_cache=True)
+    try:
+        ereqs = [eng.submit_embed([1, 2, 3, int(i) + 1])
+                 for i in range(4)]
+        dreqs = [eng.submit([5, int(i)], max_new_tokens=4)
+                 for i in range(3)]
+        beam = beam_search(eng, [3, 1, 4, 1], k=2, max_new_tokens=3)
+        assert all(r.ev.wait(120) and r.error is None
+                   for r in ereqs + dreqs)
+        assert len(beam["beams"]) == 2
+        assert eng.stats()["live_embed"] == 0
+        assert sanitize.violations() == []
+    finally:
+        eng.stop()
+    assert sanitize.violations() == []
